@@ -1,0 +1,30 @@
+"""singa_tpu — a TPU-native deep-learning framework with the
+capabilities of Apache SINGA (reference: mlinking/singa).
+
+Layer map (mirrors SURVEY.md §1, re-designed TPU-first):
+
+    examples/            train scripts (MLP/CNN/RNN/ONNX)
+    sonnx                ONNX import/export over the op registry
+    model / layer / opt  training API (Model.compile, Layer, SGD..DistOpt)
+    autograd             Operator registry + tape-free backward()
+    tensor / device      Tensor over jax.Array; TpuDevice over PJRT
+    ops/                 op catalogue as XLA HLO + Pallas kernels
+    parallel/            mesh, DP/TP/SP shardings, ring attention
+    io/ + native/        record IO, snapshot, C++ runtime pieces
+"""
+
+__version__ = "0.1.0"
+
+from . import device  # noqa: F401
+from . import tensor  # noqa: F401
+from .device import (  # noqa: F401
+    CppCPU,
+    Device,
+    Platform,
+    TpuDevice,
+    create_cpu_device,
+    create_tpu_device,
+    create_tpu_device_on,
+    get_default_device,
+)
+from .tensor import Tensor  # noqa: F401

@@ -1,0 +1,47 @@
+"""Test configuration: force an 8-virtual-device CPU mesh.
+
+Mirrors the reference's `test/python/cuda_helper.py` pattern (build
+cpu/gpu device pairs, skip what's absent) but goes further: XLA's CPU
+backend can simulate an 8-device TPU slice, so the collective /
+sharding paths are CI-testable without hardware — something the
+reference's NCCL backend could not do (SURVEY.md §4.3).
+
+Wrinkle: this environment's `sitecustomize` registers the real-TPU
+"axon" PJRT plugin at interpreter start and forces
+`jax_platforms="axon,cpu"` via jax.config (overriding env vars). We
+undo it in-process: point jax at CPU, request 8 virtual host devices,
+and clear any initialized backends so the CPU client is (re)built with
+the new flags.
+"""
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+from jax.extend.backend import clear_backends  # noqa: E402
+
+clear_backends()
+assert len(jax.devices()) == 8, (
+    f"expected 8 virtual CPU devices, got {jax.devices()}"
+)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_dev():
+    from singa_tpu import device
+
+    return device.create_cpu_device()
+
+
+@pytest.fixture(scope="session")
+def default_dev():
+    from singa_tpu import device
+
+    return device.get_default_device()

@@ -1,0 +1,77 @@
+"""Device/Platform tests. Reference model: `test_platform.cc` +
+`python/singa/device.py` surface."""
+import numpy as np
+
+from singa_tpu import device, tensor
+
+
+def test_default_device_is_cpu():
+    d = device.get_default_device()
+    assert isinstance(d, device.CppCPU)
+    assert d.lang == "cpp"
+    # Singleton.
+    assert device.get_default_device() is d
+
+
+def test_create_accel_device():
+    d = device.create_tpu_device()
+    assert d.lang == "tpu"
+    t = tensor.from_numpy(np.ones((2, 2), np.float32), device=d)
+    np.testing.assert_array_equal(t.to_numpy(), np.ones((2, 2)))
+
+
+def test_reference_alias_names():
+    # Migration shims: reference spells these create_cuda_gpu*.
+    assert device.create_cuda_gpu is device.create_tpu_device
+    d = device.create_cuda_gpu()
+    assert d.lang == "tpu"
+
+
+def test_device_query_and_counts():
+    q = device.Platform.DeviceQuery()
+    assert "device(s)" in q
+    assert device.Platform.GetNumCPUs() >= 1
+
+
+def test_multiple_virtual_devices():
+    # conftest forces 8 virtual CPU devices: the mesh substrate.
+    devs = device.create_tpu_devices(8)
+    assert len(devs) == 8
+    ids = {d.id for d in devs}
+    assert len(ids) == 8
+
+
+def test_sync_noexcept():
+    d = device.get_default_device()
+    d.Sync()
+
+
+def test_to_device_roundtrip():
+    host = device.get_default_device()
+    accel = device.create_tpu_device()
+    a = np.random.RandomState(0).randn(4, 4).astype(np.float32)
+    t = tensor.from_numpy(a, device=host)
+    t.to_device(accel)
+    assert t.device is accel
+    t.to_host()
+    np.testing.assert_array_equal(t.to_numpy(), a)
+
+
+def test_profiling_table():
+    d = device.get_default_device()
+    d.ResetTimeProfiling()
+    d.SetVerbosity(1)
+    d.SetSkipIteration(0)
+    with d.TimeOp("Add"):
+        pass
+    out = d.PrintTimeProfiling()
+    assert "Add" in out
+    d.SetVerbosity(0)
+
+
+def test_graph_flag():
+    d = device.get_default_device()
+    assert not d.graph_enabled
+    d.EnableGraph(True)
+    assert d.graph_enabled
+    d.EnableGraph(False)

@@ -1,0 +1,198 @@
+"""Tensor semantics vs numpy.
+
+Reference test model: `test/python/test_tensor.py` + the C++
+`test_tensor.cc`/`test_tensor_math.cc` (small deterministic fixtures,
+per-backend duplication, exact/1e-5 tolerances — SURVEY.md §4.1).
+"""
+import numpy as np
+import pytest
+
+from singa_tpu import tensor
+from singa_tpu.tensor import Tensor
+
+
+@pytest.fixture
+def ab():
+    rng = np.random.RandomState(0)
+    a = rng.randn(3, 4).astype(np.float32)
+    b = rng.randn(3, 4).astype(np.float32)
+    return a, b
+
+
+def test_construct_zero():
+    t = Tensor((2, 3))
+    assert t.shape == (2, 3)
+    np.testing.assert_array_equal(t.to_numpy(), np.zeros((2, 3), np.float32))
+
+
+def test_from_to_numpy(ab):
+    a, _ = ab
+    t = tensor.from_numpy(a)
+    np.testing.assert_array_equal(t.to_numpy(), a)
+    assert t.dtype == np.float32
+
+
+def test_from_numpy_downcasts_int64():
+    t = tensor.from_numpy(np.array([1, 2, 3], dtype=np.int64))
+    assert t.dtype == np.int32
+
+
+def test_copy_from_numpy(ab):
+    a, b = ab
+    t = tensor.from_numpy(a)
+    t.copy_from_numpy(b)
+    np.testing.assert_array_equal(t.to_numpy(), b)
+
+
+def test_arith_ops(ab):
+    a, b = ab
+    ta, tb = tensor.from_numpy(a), tensor.from_numpy(b)
+    np.testing.assert_allclose((ta + tb).to_numpy(), a + b, rtol=1e-6)
+    np.testing.assert_allclose((ta - tb).to_numpy(), a - b, rtol=1e-6)
+    np.testing.assert_allclose((ta * tb).to_numpy(), a * b, rtol=1e-6)
+    np.testing.assert_allclose((ta / tb).to_numpy(), a / b, rtol=1e-5)
+    np.testing.assert_allclose((ta + 2.0).to_numpy(), a + 2.0, rtol=1e-6)
+    np.testing.assert_allclose((3.0 - ta).to_numpy(), 3.0 - a, rtol=1e-6)
+    np.testing.assert_allclose((-ta).to_numpy(), -a)
+
+
+def test_inplace_ops(ab):
+    a, b = ab
+    ta = tensor.from_numpy(a)
+    ta += tensor.from_numpy(b)
+    np.testing.assert_allclose(ta.to_numpy(), a + b, rtol=1e-6)
+
+
+def test_unary_catalogue(ab):
+    a, _ = ab
+    ta = tensor.from_numpy(np.abs(a) + 0.1)
+    np.testing.assert_allclose(tensor.exp(ta).to_numpy(), np.exp(np.abs(a) + 0.1), rtol=1e-5)
+    np.testing.assert_allclose(tensor.log(ta).to_numpy(), np.log(np.abs(a) + 0.1), rtol=1e-5)
+    np.testing.assert_allclose(tensor.sqrt(ta).to_numpy(), np.sqrt(np.abs(a) + 0.1), rtol=1e-5)
+    tb = tensor.from_numpy(a)
+    np.testing.assert_allclose(tensor.tanh(tb).to_numpy(), np.tanh(a), rtol=1e-5)
+    np.testing.assert_allclose(
+        tensor.sigmoid(tb).to_numpy(), 1 / (1 + np.exp(-a)), rtol=1e-5
+    )
+    np.testing.assert_allclose(tensor.relu(tb).to_numpy(), np.maximum(a, 0))
+    np.testing.assert_allclose(tensor.abs(tb).to_numpy(), np.abs(a))
+    np.testing.assert_allclose(tensor.sign(tb).to_numpy(), np.sign(a))
+
+
+def test_matmul():
+    rng = np.random.RandomState(1)
+    a = rng.randn(4, 5).astype(np.float32)
+    b = rng.randn(5, 6).astype(np.float32)
+    out = tensor.mult(tensor.from_numpy(a), tensor.from_numpy(b))
+    np.testing.assert_allclose(out.to_numpy(), a @ b, rtol=1e-4, atol=1e-5)
+
+
+def test_reductions(ab):
+    a, _ = ab
+    ta = tensor.from_numpy(a)
+    np.testing.assert_allclose(tensor.sum(ta).to_numpy(), a.sum(), rtol=1e-5)
+    np.testing.assert_allclose(tensor.sum_rows(ta).to_numpy(), a.sum(0), rtol=1e-5)
+    np.testing.assert_allclose(tensor.sum_columns(ta).to_numpy(), a.sum(1), rtol=1e-5)
+    np.testing.assert_allclose(tensor.row_max(ta).to_numpy(), a.max(1))
+    np.testing.assert_allclose(tensor.average(ta).to_numpy(), a.mean(), rtol=1e-5)
+
+
+def test_softmax(ab):
+    a, _ = ab
+    got = tensor.softmax(tensor.from_numpy(a)).to_numpy()
+    e = np.exp(a - a.max(1, keepdims=True))
+    np.testing.assert_allclose(got, e / e.sum(1, keepdims=True), rtol=1e-5)
+    np.testing.assert_allclose(got.sum(1), np.ones(3), rtol=1e-5)
+
+
+def test_shape_ops(ab):
+    a, _ = ab
+    ta = tensor.from_numpy(a)
+    assert ta.reshape((4, 3)).shape == (4, 3)
+    np.testing.assert_array_equal(ta.T.to_numpy(), a.T)
+    cat = tensor.concatenate([ta, ta], axis=0)
+    assert cat.shape == (6, 4)
+    parts = tensor.split(ta, 2, axis=1)
+    assert parts[0].shape == (3, 2)
+    st = tensor.stack([ta, ta], axis=0)
+    assert st.shape == (2, 3, 4)
+
+
+def test_row_column_helpers(ab):
+    a, _ = ab
+    ta = tensor.from_numpy(a)
+    v = tensor.from_numpy(np.arange(4, dtype=np.float32))
+    np.testing.assert_allclose(
+        tensor.add_row(v, ta).to_numpy(), a + np.arange(4), rtol=1e-6
+    )
+    c = tensor.from_numpy(np.arange(3, dtype=np.float32))
+    np.testing.assert_allclose(
+        tensor.add_column(c, ta).to_numpy(), a + np.arange(3)[:, None], rtol=1e-6
+    )
+
+
+def test_axpy(ab):
+    a, b = ab
+    ta, tb = tensor.from_numpy(a), tensor.from_numpy(b)
+    tensor.axpy(0.5, ta, tb)
+    np.testing.assert_allclose(tb.to_numpy(), b + 0.5 * a, rtol=1e-6)
+
+
+def test_random_fills():
+    t = Tensor((1000,))
+    t.device.SetRandSeed(42)
+    t.gaussian(1.0, 2.0)
+    x = t.to_numpy()
+    assert abs(x.mean() - 1.0) < 0.3
+    assert abs(x.std() - 2.0) < 0.3
+    t.uniform(-1, 1)
+    x = t.to_numpy()
+    assert x.min() >= -1 and x.max() <= 1
+    t.bernoulli(0.3)
+    x = t.to_numpy()
+    assert set(np.unique(x)).issubset({0.0, 1.0})
+    assert abs(x.mean() - 0.3) < 0.1
+
+
+def test_rng_reproducible():
+    t1, t2 = Tensor((10,)), Tensor((10,))
+    t1.device.SetRandSeed(7)
+    t1.gaussian(0, 1)
+    t2.device.SetRandSeed(7)
+    t2.gaussian(0, 1)
+    np.testing.assert_array_equal(t1.to_numpy(), t2.to_numpy())
+
+
+def test_astype():
+    t = tensor.from_numpy(np.array([1.7, -2.3], np.float32))
+    ti = t.as_type(tensor.int32)
+    assert ti.dtype == np.int32
+    th = t.as_type(tensor.float16)
+    assert th.dtype == np.float16
+
+
+def test_one_hot_and_gather():
+    idx = tensor.from_numpy(np.array([0, 2, 1], np.int32))
+    oh = tensor.one_hot(idx, 3)
+    np.testing.assert_array_equal(oh.to_numpy(), np.eye(3, dtype=np.float32)[[0, 2, 1]])
+    src = tensor.from_numpy(np.arange(12, dtype=np.float32).reshape(4, 3))
+    g = tensor.gather(src, np.array([1, 3]), axis=0)
+    np.testing.assert_array_equal(g.to_numpy(), np.arange(12, dtype=np.float32).reshape(4, 3)[[1, 3]])
+
+
+def test_cross_entropy_helpers():
+    logits = np.array([[2.0, 1.0, 0.1], [0.5, 2.5, 0.0]], np.float32)
+    p = tensor.softmax(tensor.from_numpy(logits))
+    labels = tensor.from_numpy(np.array([0, 1], np.int32))
+    ce = tensor.compute_cross_entropy(p, labels).to_numpy()
+    pn = p.to_numpy()
+    expect = -np.log(pn[[0, 1], [0, 1]])
+    np.testing.assert_allclose(ce, expect, rtol=1e-5)
+    g = tensor.softmax_cross_entropy_bwd(p, labels).to_numpy()
+    onehot = np.eye(3, dtype=np.float32)[[0, 1]]
+    np.testing.assert_allclose(g, pn - onehot, rtol=1e-5)
+
+
+def test_scalar_item():
+    t = tensor.from_numpy(np.array(3.5, np.float32))
+    assert float(t) == 3.5
