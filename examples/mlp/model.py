@@ -1,0 +1,57 @@
+"""MLP as a Model (Layer API). Reference: `examples/mlp/model.py`."""
+import argparse
+
+import numpy as np
+
+from singa_tpu import device, layer, model, opt, tensor
+from singa_tpu import autograd
+
+
+class MLP(model.Model):
+    def __init__(self, perceptron_size=100, num_classes=10):
+        super().__init__()
+        self.linear1 = layer.Linear(perceptron_size)
+        self.relu = layer.ReLU()
+        self.linear2 = layer.Linear(num_classes)
+
+    def forward(self, x):
+        return self.linear2(self.relu(self.linear1(x)))
+
+    def train_one_batch(self, x, y):
+        out = self.forward(x)
+        loss = autograd.softmax_cross_entropy(out, y)
+        self._optimizer.backward_and_update(loss)
+        return out, loss
+
+
+def create_model(**kwargs):
+    return MLP(**kwargs)
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=300)
+    p.add_argument("--graph", action="store_true", default=True)
+    p.add_argument("--no-graph", dest="graph", action="store_false")
+    args = p.parse_args()
+
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from native import gen_data
+
+    dev = device.create_tpu_device()
+    dev.SetRandSeed(0)
+    x_np, y_np = gen_data()
+    tx = tensor.from_numpy(x_np, device=dev)
+    ty = tensor.from_numpy(y_np, device=dev)
+
+    m = create_model(perceptron_size=3, num_classes=2)
+    m.set_optimizer(opt.SGD(0.05, momentum=0.9))
+    m.compile([tx], is_train=True, use_graph=args.graph)
+    for epoch in range(args.epochs):
+        out, loss = m(tx, ty)
+        if epoch % 50 == 0:
+            print(f"epoch {epoch} loss {float(loss.to_numpy()):.4f}")
+    print(f"final loss {float(loss.to_numpy()):.4f}")

@@ -1,0 +1,1114 @@
+"""Autograd engine + differentiable op registry.
+
+Reference parity: `python/singa/autograd.py` — the `Operator` base,
+~100 op classes, and the tape-free `backward()` that walks the
+creator-pointer DAG with dependency counting (SURVEY.md §3.2). The
+engine semantics are preserved exactly:
+
+  - no global tape: the graph IS the `Tensor.creator` links built
+    during forward;
+  - `backward(y, dy)` counts each op's downstream consumers, processes
+    ops whose outputs are fully accumulated (FIFO queue), and yields
+    `(param, grad)` pairs in deterministic order — the property the
+    reference relies on for bitwise loss parity;
+  - module-level `training` flag gates Dropout/BatchNorm behavior.
+
+TPU-native redesign of the op bodies: the reference hand-writes every
+`backward()` against C++ kernels. Here each op declares a pure jax
+`fn`; `Operator.forward` runs it under `jax.vjp`, so backward is the
+XLA-transposed program — always consistent with forward, fused by XLA,
+and differentiable to any order. Ops with reference-specific gradient
+semantics (fused SoftMaxCrossEntropy, Dropout's cached mask, BN's
+running stats) override `backward()` by hand, matching
+`python/singa/autograd.py`'s definitions.
+
+Integer/index arguments (Gather indices, one-hot depth, axes) are op
+*attributes*, not DAG inputs — same design as the reference, and it
+keeps `jax.vjp` over float leaves only.
+"""
+from __future__ import annotations
+
+import math
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from . import tensor as tensor_mod
+from .ops import native
+from .tensor import Tensor
+
+# Module-level training flag. Reference: `autograd.training`.
+training = False
+
+
+def _to_tensor(x) -> Tensor:
+    if isinstance(x, Tensor):
+        return x
+    return tensor_mod.from_numpy(np.asarray(x))
+
+
+class Operator:
+    """Base differentiable op. Reference: `autograd.Operator`.
+
+    Subclasses either
+      - define `fn(self, *xs) -> array | tuple` (pure jax): backward is
+        derived via `jax.vjp`; or
+      - override `forward(self, *xs)` and `backward(self, *dys)`
+        directly (reference style) for fused/custom gradients.
+    One instance per call-site invocation (instances cache inputs/vjp).
+    """
+
+    _count = 0
+
+    def __init__(self):
+        self.name = f"{type(self).__name__}#{Operator._count}"
+        Operator._count += 1
+        self.inputs: List[Tensor] = []
+        self.requires_grad = False
+        self.num_outputs = 1
+        self._vjp = None
+
+    # -- public ----------------------------------------------------------
+    def __call__(self, *xs):
+        xs = [_to_tensor(x) for x in xs]
+        self.inputs = xs
+        self.requires_grad = any(t.requires_grad for t in xs)
+        dev = xs[0].device if xs else None
+        self.device = dev
+        if dev is not None and dev._verbosity > 0:
+            with dev.TimeOp(type(self).__name__):
+                ys = self.forward(*[t.data for t in xs])
+        else:
+            ys = self.forward(*[t.data for t in xs])
+        multiple = isinstance(ys, tuple)
+        ys = ys if multiple else (ys,)
+        self.num_outputs = len(ys)
+        self._out_shapes = [(y.shape, y.dtype) for y in ys]
+        outs = []
+        for i, y in enumerate(ys):
+            t = tensor_mod.from_raw(y, dev)
+            if self.requires_grad:
+                t.requires_grad = True
+                t.creator = self
+                t.creator_index = i
+            outs.append(t)
+        return tuple(outs) if multiple else outs[0]
+
+    # -- default implementations via jax.vjp ------------------------------
+    def forward(self, *xs):
+        if self.requires_grad:
+            ys, self._vjp = jax.vjp(self.fn, *xs)
+            return ys
+        return self.fn(*xs)
+
+    def backward(self, *dys):
+        assert self._vjp is not None, f"{self.name}: backward before forward"
+        cot = dys[0] if self.num_outputs == 1 else tuple(dys)
+        grads = self._vjp(cot)
+        return grads if len(grads) > 1 else grads[0]
+
+    def fn(self, *xs):  # pragma: no cover - must be overridden
+        raise NotImplementedError(type(self).__name__)
+
+
+def _ones_like(arr):
+    return jnp.ones_like(arr)
+
+
+def backward(y: Tensor, dy=None):
+    """Reference: `autograd.backward(y, dy)` — dependency-counting
+    reverse topological walk over creator links. Returns the list of
+    `(param_tensor, grad_tensor)` pairs for tensors with
+    `stores_grad=True`, in deterministic (queue) order, and assigns
+    nothing implicitly — the caller (optimizer) applies updates.
+    """
+    return list(iter_backward(y, dy))
+
+
+def iter_backward(y: Tensor, dy=None):
+    """Generator form (the reference's `backward` is consumed as
+    `for p, g in autograd.backward(loss)`)."""
+    if y.creator is None:
+        return
+    if dy is None:
+        dy_arr = _ones_like(y.data)
+    else:
+        dy_arr = dy.data if isinstance(dy, Tensor) else jnp.asarray(dy)
+
+    # Pass 1: count downstream consumer edges for every op in the DAG.
+    consumers: Dict[Operator, int] = {}
+    seen = set()
+    stack = [y.creator]
+    while stack:
+        op = stack.pop()
+        if id(op) in seen:
+            continue
+        seen.add(id(op))
+        for x in op.inputs:
+            src = x.creator
+            if src is not None and x.requires_grad:
+                consumers[src] = consumers.get(src, 0) + 1
+                stack.append(src)
+
+    # Pass 2: FIFO walk from y's creator, accumulating output cotangents.
+    pending: Dict[int, List] = {}  # id(op) -> per-output grad accumulators
+    opmap: Dict[int, Operator] = {}
+
+    def _acc(op: Operator, idx: int, g):
+        slot = pending.setdefault(id(op), [None] * op.num_outputs)
+        opmap[id(op)] = op
+        slot[idx] = g if slot[idx] is None else slot[idx] + g
+
+    root = y.creator
+    _acc(root, getattr(y, "creator_index", 0), dy_arr)
+    ready = deque([root])
+    remaining = dict(consumers)
+    # param grads may accumulate across multiple uses of the same param
+    emitted: Dict[int, int] = {}
+    results: List[Tuple[Tensor, Tensor]] = []
+
+    while ready:
+        op = ready.popleft()
+        grads_out = [
+            g if g is not None else jnp.zeros(shape, dtype)
+            for g, (shape, dtype) in zip(pending.pop(id(op)), op._out_shapes)
+        ]
+        in_grads = op.backward(*grads_out)
+        if not isinstance(in_grads, (tuple, list)):
+            in_grads = (in_grads,)
+        assert len(in_grads) == len(op.inputs), (
+            f"{op.name}: backward returned {len(in_grads)} grads for "
+            f"{len(op.inputs)} inputs"
+        )
+        for x, g in zip(op.inputs, in_grads):
+            if g is None or not x.requires_grad:
+                continue
+            if x.stores_grad:
+                gt = tensor_mod.from_raw(g, x.device)
+                if id(x) in emitted:
+                    prev = results[emitted[id(x)]][1]
+                    results[emitted[id(x)]] = (
+                        x,
+                        tensor_mod.from_raw(prev.data + g, x.device),
+                    )
+                else:
+                    emitted[id(x)] = len(results)
+                    results.append((x, gt))
+            src = x.creator
+            if src is not None and x.requires_grad:
+                _acc(src, getattr(x, "creator_index", 0), g)
+                remaining[src] -= 1
+                if remaining[src] == 0:
+                    ready.append(src)
+    for pair in results:
+        yield pair
+
+
+def gradients(y: Tensor, dy=None) -> Dict[Tensor, Tensor]:
+    """Reference: `autograd.gradients` — param tensor → grad map."""
+    return {p: g for p, g in iter_backward(y, dy)}
+
+
+# ===========================================================================
+# Op registry.  Order follows the reference's autograd.py catalogue.
+# ===========================================================================
+
+
+class Dummy(Operator):
+    """Leaf marker. Reference: `autograd.Dummy` (wraps graph inputs)."""
+
+    def __init__(self, tensor_: Tensor, name=None):
+        super().__init__()
+        self.tensor = tensor_
+
+    def fn(self, x):
+        return x
+
+
+# ---- unary activations ----------------------------------------------------
+class ReLU(Operator):
+    def fn(self, x):
+        return jax.nn.relu(x)
+
+
+class Sigmoid(Operator):
+    def fn(self, x):
+        return jax.nn.sigmoid(x)
+
+
+class Tanh(Operator):
+    def fn(self, x):
+        return jnp.tanh(x)
+
+
+class SoftMax(Operator):
+    def __init__(self, axis: int = 1):
+        super().__init__()
+        self.axis = axis
+
+    def fn(self, x):
+        return jax.nn.softmax(x, axis=self.axis)
+
+
+class LogSoftMax(Operator):
+    def __init__(self, axis: int = 1):
+        super().__init__()
+        self.axis = axis
+
+    def fn(self, x):
+        return jax.nn.log_softmax(x, axis=self.axis)
+
+
+class Abs(Operator):
+    def fn(self, x):
+        return jnp.abs(x)
+
+
+class Exp(Operator):
+    def fn(self, x):
+        return jnp.exp(x)
+
+
+class Log(Operator):
+    def fn(self, x):
+        return jnp.log(x)
+
+
+class Sqrt(Operator):
+    def fn(self, x):
+        return jnp.sqrt(x)
+
+
+class Square(Operator):
+    def fn(self, x):
+        return jnp.square(x)
+
+
+class Sign(Operator):
+    def fn(self, x):
+        return jnp.sign(x)
+
+
+class Negative(Operator):
+    def fn(self, x):
+        return -x
+
+
+class Reciprocal(Operator):
+    def fn(self, x):
+        return 1.0 / x
+
+
+class Erf(Operator):
+    def fn(self, x):
+        return jax.scipy.special.erf(x)
+
+
+class Ceil(Operator):
+    def fn(self, x):
+        return jnp.ceil(x)
+
+
+class Floor(Operator):
+    def fn(self, x):
+        return jnp.floor(x)
+
+
+class Round(Operator):
+    def fn(self, x):
+        return jnp.round(x)
+
+
+class Clip(Operator):
+    def __init__(self, min=None, max=None):  # noqa: A002
+        super().__init__()
+        self.min, self.max = min, max
+
+    def fn(self, x):
+        return jnp.clip(x, self.min, self.max)
+
+
+class Cos(Operator):
+    def fn(self, x):
+        return jnp.cos(x)
+
+
+class Sin(Operator):
+    def fn(self, x):
+        return jnp.sin(x)
+
+
+class Tan(Operator):
+    def fn(self, x):
+        return jnp.tan(x)
+
+
+class Acos(Operator):
+    def fn(self, x):
+        return jnp.arccos(x)
+
+
+class Asin(Operator):
+    def fn(self, x):
+        return jnp.arcsin(x)
+
+
+class Atan(Operator):
+    def fn(self, x):
+        return jnp.arctan(x)
+
+
+class Cosh(Operator):
+    def fn(self, x):
+        return jnp.cosh(x)
+
+
+class Sinh(Operator):
+    def fn(self, x):
+        return jnp.sinh(x)
+
+
+class Tanh_(Tanh):
+    pass
+
+
+class Acosh(Operator):
+    def fn(self, x):
+        return jnp.arccosh(x)
+
+
+class Asinh(Operator):
+    def fn(self, x):
+        return jnp.arcsinh(x)
+
+
+class Atanh(Operator):
+    def fn(self, x):
+        return jnp.arctanh(x)
+
+
+class Elu(Operator):
+    def __init__(self, alpha: float = 1.0):
+        super().__init__()
+        self.alpha = alpha
+
+    def fn(self, x):
+        return jax.nn.elu(x, alpha=self.alpha)
+
+
+class SeLU(Operator):
+    def __init__(self, alpha: float = 1.67326, gamma: float = 1.0507):
+        super().__init__()
+        self.alpha, self.gamma = alpha, gamma
+
+    def fn(self, x):
+        return self.gamma * jnp.where(
+            x > 0, x, self.alpha * (jnp.exp(x) - 1.0)
+        )
+
+
+class LeakyRelu(Operator):
+    def __init__(self, a: float = 0.01):
+        super().__init__()
+        self.a = a
+
+    def fn(self, x):
+        return jnp.where(x >= 0, x, self.a * x)
+
+
+class HardSigmoid(Operator):
+    def __init__(self, alpha: float = 0.2, gamma: float = 0.5):
+        super().__init__()
+        self.alpha, self.gamma = alpha, gamma
+
+    def fn(self, x):
+        return jnp.clip(self.alpha * x + self.gamma, 0.0, 1.0)
+
+
+class SoftPlus(Operator):
+    def fn(self, x):
+        return jax.nn.softplus(x)
+
+
+class SoftSign(Operator):
+    def fn(self, x):
+        return x / (1.0 + jnp.abs(x))
+
+
+class Gelu(Operator):
+    def fn(self, x):
+        return jax.nn.gelu(x, approximate=False)
+
+
+class Cast(Operator):
+    def __init__(self, to):
+        super().__init__()
+        self.to = to
+
+    def forward(self, x):
+        self._from_dtype = x.dtype
+        return x.astype(self.to)
+
+    def backward(self, dy):
+        return dy.astype(self._from_dtype)
+
+
+# ---- binary ---------------------------------------------------------------
+class Add(Operator):
+    def fn(self, a, b):
+        return a + b
+
+
+class Sub(Operator):
+    def fn(self, a, b):
+        return a - b
+
+
+class Mul(Operator):
+    def fn(self, a, b):
+        return a * b
+
+
+class Div(Operator):
+    def fn(self, a, b):
+        return a / b
+
+
+class Pow(Operator):
+    def fn(self, a, b):
+        return a ** b
+
+
+class Minimum(Operator):
+    def fn(self, a, b):
+        return jnp.minimum(a, b)
+
+
+class Maximum(Operator):
+    def fn(self, a, b):
+        return jnp.maximum(a, b)
+
+
+class Less(Operator):
+    """Non-differentiable comparison (reference returns mask, no grad)."""
+
+    def forward(self, a, b):
+        self.requires_grad = False
+        return (a < b).astype(jnp.float32)
+
+    def backward(self, dy):
+        raise AssertionError("Less has no gradient")
+
+
+class Greater(Operator):
+    def forward(self, a, b):
+        self.requires_grad = False
+        return (a > b).astype(jnp.float32)
+
+    def backward(self, dy):
+        raise AssertionError("Greater has no gradient")
+
+
+class Equal(Operator):
+    def forward(self, a, b):
+        self.requires_grad = False
+        return (a == b).astype(jnp.float32)
+
+    def backward(self, dy):
+        raise AssertionError("Equal has no gradient")
+
+
+# ---- matmul family --------------------------------------------------------
+class Mult(Operator):
+    """GEMM/batched matmul. Reference: `autograd.Mult` → `singa::Mult`."""
+
+    def fn(self, a, b):
+        return jnp.matmul(a, b, precision=tensor_mod.get_matmul_precision())
+
+
+class Gemm(Operator):
+    """ONNX-style GEMM: alpha*A'B' + beta*C. Reference: `autograd.Gemm`."""
+
+    def __init__(self, alpha=1.0, beta=1.0, transA=0, transB=0):
+        super().__init__()
+        self.alpha, self.beta = alpha, beta
+        self.transA, self.transB = transA, transB
+
+    def fn(self, a, b, *c):
+        A = a.T if self.transA else a
+        B = b.T if self.transB else b
+        y = self.alpha * jnp.matmul(
+            A, B, precision=tensor_mod.get_matmul_precision()
+        )
+        if c:
+            y = y + self.beta * c[0]
+        return y
+
+
+class AddBias(Operator):
+    """Reference: `autograd.AddBias` — row/column bias add on a matrix."""
+
+    def __init__(self, axis: int = 0):
+        super().__init__()
+        self.axis = axis  # 0: per-column bias (add to each row)
+
+    def fn(self, x, b):
+        return x + b[None, :] if self.axis == 0 else x + b[:, None]
+
+
+# ---- shape ops ------------------------------------------------------------
+class Reshape(Operator):
+    def __init__(self, shape):
+        super().__init__()
+        self.shape = tuple(int(s) for s in shape)
+
+    def fn(self, x):
+        return jnp.reshape(x, self.shape)
+
+
+class Flatten(Operator):
+    """Reference: `autograd.Flatten(axis)` — collapse dims from `axis`."""
+
+    def __init__(self, axis: int = 1):
+        super().__init__()
+        self.axis = axis
+
+    def fn(self, x):
+        a = self.axis if self.axis >= 0 else self.axis + x.ndim
+        lead = int(np.prod(x.shape[:a])) if a > 0 else 1
+        return jnp.reshape(x, (lead, -1))
+
+
+class Transpose(Operator):
+    def __init__(self, axes=None):
+        super().__init__()
+        self.axes = tuple(axes) if axes is not None else None
+
+    def fn(self, x):
+        return jnp.transpose(x, self.axes)
+
+
+class Concat(Operator):
+    def __init__(self, axis: int = 0):
+        super().__init__()
+        self.axis = axis
+
+    def fn(self, *xs):
+        return jnp.concatenate(xs, axis=self.axis)
+
+
+class Slice(Operator):
+    """ONNX-style slice. Reference: `autograd.Slice`."""
+
+    def __init__(self, starts, ends, axes=None, steps=None):
+        super().__init__()
+        self.starts, self.ends = list(starts), list(ends)
+        self.axes = list(axes) if axes is not None else list(range(len(starts)))
+        self.steps = list(steps) if steps is not None else [1] * len(starts)
+
+    def fn(self, x):
+        idx = [slice(None)] * x.ndim
+        for s, e, a, st in zip(self.starts, self.ends, self.axes, self.steps):
+            idx[a] = slice(s, e, st)
+        return x[tuple(idx)]
+
+
+class SplitOp(Operator):
+    """Reference: `autograd.Split` — multi-output."""
+
+    def __init__(self, axis: int, parts):
+        super().__init__()
+        self.axis = axis
+        self.parts = parts  # list of sizes
+
+    def fn(self, x):
+        splits = np.cumsum(self.parts)[:-1].tolist()
+        return tuple(jnp.split(x, splits, axis=self.axis))
+
+
+class Gather(Operator):
+    def __init__(self, axis: int, indices):
+        super().__init__()
+        self.axis = axis
+        self.indices = jnp.asarray(np.asarray(indices), dtype=jnp.int32)
+
+    def fn(self, x):
+        return jnp.take(x, self.indices, axis=self.axis)
+
+
+class Tile(Operator):
+    def __init__(self, repeats):
+        super().__init__()
+        self.repeats = repeats
+
+    def fn(self, x):
+        return jnp.tile(x, self.repeats)
+
+
+class Squeeze(Operator):
+    def __init__(self, axis=None):
+        super().__init__()
+        self.axis = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+
+    def fn(self, x):
+        return jnp.squeeze(x, axis=self.axis)
+
+
+class Unsqueeze(Operator):
+    def __init__(self, axis):
+        super().__init__()
+        self.axis = axis if isinstance(axis, (list, tuple)) else [axis]
+
+    def fn(self, x):
+        y = x
+        for a in sorted(self.axis):
+            y = jnp.expand_dims(y, a)
+        return y
+
+
+class Pad(Operator):
+    """Reference: `autograd.Pad(mode, pads)` — ONNX pad layout
+    [b0, b1, ..., e0, e1, ...]."""
+
+    def __init__(self, mode: str, pads, constant: float = 0.0):
+        super().__init__()
+        self.mode = {"constant": "constant", "reflect": "reflect", "edge": "edge"}[
+            mode
+        ]
+        self.pads = list(pads)
+        self.constant = constant
+
+    def fn(self, x):
+        n = x.ndim
+        widths = [(self.pads[i], self.pads[i + n]) for i in range(n)]
+        if self.mode == "constant":
+            return jnp.pad(x, widths, mode="constant", constant_values=self.constant)
+        return jnp.pad(x, widths, mode=self.mode)
+
+
+class Expand(Operator):
+    def __init__(self, shape):
+        super().__init__()
+        self.shape = tuple(shape)
+
+    def fn(self, x):
+        return jnp.broadcast_to(x, jnp.broadcast_shapes(x.shape, self.shape))
+
+
+class UpSample(Operator):
+    """Nearest-neighbor upsample by integer scales (NCHW).
+    Reference: `autograd.UpSample`."""
+
+    def __init__(self, scales):
+        super().__init__()
+        self.scales = [int(s) for s in scales]
+
+    def fn(self, x):
+        y = x
+        for axis, s in enumerate(self.scales):
+            if s != 1:
+                y = jnp.repeat(y, s, axis=axis)
+        return y
+
+
+class DepthToSpace(Operator):
+    def __init__(self, blocksize: int, mode: str = "DCR"):
+        super().__init__()
+        self.b = blocksize
+        self.mode = mode
+
+    def fn(self, x):
+        n, c, h, w = x.shape
+        b = self.b
+        if self.mode == "DCR":
+            y = x.reshape(n, b, b, c // (b * b), h, w)
+            y = y.transpose(0, 3, 4, 1, 5, 2)
+        else:  # CRD
+            y = x.reshape(n, c // (b * b), b, b, h, w)
+            y = y.transpose(0, 1, 4, 2, 5, 3)
+        return y.reshape(n, c // (b * b), h * b, w * b)
+
+
+class SpaceToDepth(Operator):
+    def __init__(self, blocksize: int):
+        super().__init__()
+        self.b = blocksize
+
+    def fn(self, x):
+        n, c, h, w = x.shape
+        b = self.b
+        y = x.reshape(n, c, h // b, b, w // b, b)
+        y = y.transpose(0, 3, 5, 1, 2, 4)
+        return y.reshape(n, c * b * b, h // b, w // b)
+
+
+class Where(Operator):
+    def __init__(self, condition):
+        super().__init__()
+        self.cond = condition.data if isinstance(condition, Tensor) else jnp.asarray(
+            condition
+        )
+
+    def fn(self, a, b):
+        return jnp.where(self.cond != 0, a, b)
+
+
+class OneHot(Operator):
+    """Non-differentiable. Reference: `autograd.OneHot`."""
+
+    def __init__(self, depth: int, axis: int = -1):
+        super().__init__()
+        self.depth, self.axis = depth, axis
+
+    def forward(self, x):
+        self.requires_grad = False
+        return jax.nn.one_hot(x.astype(jnp.int32), self.depth, axis=self.axis)
+
+    def backward(self, dy):
+        raise AssertionError("OneHot has no gradient")
+
+
+class Embedding(Operator):
+    """Reference: `autograd.Embedding` — lookup rows of W by index.
+
+    Indices are an attribute (int tensor), W is the differentiable
+    input; backward scatter-adds into W rows (here via vjp of take)."""
+
+    def __init__(self, indices):
+        super().__init__()
+        idx = indices.data if isinstance(indices, Tensor) else jnp.asarray(indices)
+        self.indices = idx.astype(jnp.int32)
+
+    def fn(self, w):
+        return jnp.take(w, self.indices, axis=0)
+
+
+# ---- reductions -----------------------------------------------------------
+class ReduceSum(Operator):
+    def __init__(self, axes=None, keepdims=False):
+        super().__init__()
+        self.axes = tuple(axes) if axes is not None else None
+        self.keepdims = bool(keepdims)
+
+    def fn(self, x):
+        return jnp.sum(x, axis=self.axes, keepdims=self.keepdims)
+
+
+class ReduceMean(Operator):
+    def __init__(self, axes=None, keepdims=False):
+        super().__init__()
+        self.axes = tuple(axes) if axes is not None else None
+        self.keepdims = bool(keepdims)
+
+    def fn(self, x):
+        return jnp.mean(x, axis=self.axes, keepdims=self.keepdims)
+
+
+class Max(Operator):
+    def __init__(self, axes=None, keepdims=False):
+        super().__init__()
+        self.axes = tuple(axes) if axes is not None else None
+        self.keepdims = bool(keepdims)
+
+    def fn(self, x):
+        return jnp.max(x, axis=self.axes, keepdims=self.keepdims)
+
+
+class Min(Operator):
+    def __init__(self, axes=None, keepdims=False):
+        super().__init__()
+        self.axes = tuple(axes) if axes is not None else None
+        self.keepdims = bool(keepdims)
+
+    def fn(self, x):
+        return jnp.min(x, axis=self.axes, keepdims=self.keepdims)
+
+
+class GlobalAveragePool(Operator):
+    """Reference: `autograd.GlobalAveragePool` (NCHW → NC11)."""
+
+    def fn(self, x):
+        return jnp.mean(x, axis=tuple(range(2, x.ndim)), keepdims=True)
+
+
+# ---- losses ---------------------------------------------------------------
+class SoftMaxCrossEntropy(Operator):
+    """Fused softmax + CE, mean over batch. Hand-written backward
+    (softmax(x) - onehot(t)) / N — matches the reference's fused
+    KernelSoftmaxCrossEntropy and keeps grad accumulation deterministic.
+    Reference: `autograd.SoftMaxCrossEntropy`.
+    """
+
+    def __init__(self, t):
+        super().__init__()
+        tt = t.data if isinstance(t, Tensor) else jnp.asarray(t)
+        self.t = tt
+
+    def forward(self, x):
+        t = self.t
+        if t.ndim == x.ndim - 1 or (t.ndim == x.ndim and t.shape[-1] == 1):
+            t = jax.nn.one_hot(
+                t.reshape(t.shape[: x.ndim - 1]).astype(jnp.int32),
+                x.shape[-1],
+                dtype=x.dtype,
+            )
+        self._onehot = t
+        logp = jax.nn.log_softmax(x, axis=-1)
+        self._p = jnp.exp(logp)
+        n = x.shape[0] if x.ndim > 1 else 1
+        self._n = n
+        return -jnp.sum(t * logp) / n
+
+    def backward(self, dy):
+        return dy * (self._p - self._onehot) / self._n
+
+
+class MeanSquareError(Operator):
+    """Reference: `autograd.MeanSquareError` — mean over batch of
+    0.5*||x-t||^2 per example... SINGA computes sum((x-t)^2)/(2*batch)
+    with grad (x-t)/batch."""
+
+    def __init__(self, t):
+        super().__init__()
+        self.t = t.data if isinstance(t, Tensor) else jnp.asarray(t)
+
+    def forward(self, x):
+        self._diff = x - self.t
+        n = x.shape[0] if x.ndim > 0 else 1
+        self._n = n
+        return jnp.sum(jnp.square(self._diff)) / (2.0 * n)
+
+    def backward(self, dy):
+        return dy * self._diff / self._n
+
+
+class BinaryCrossEntropy(Operator):
+    """Reference: `autograd.BinaryCrossEntropy` (probabilities in)."""
+
+    def __init__(self, t):
+        super().__init__()
+        self.t = t.data if isinstance(t, Tensor) else jnp.asarray(t)
+
+    def fn(self, x):
+        eps = 1e-7
+        xc = jnp.clip(x, eps, 1.0 - eps)
+        n = x.shape[0] if x.ndim > 0 else 1
+        return -jnp.sum(
+            self.t * jnp.log(xc) + (1.0 - self.t) * jnp.log(1.0 - xc)
+        ) / n
+
+
+# ---- stateful-ish NN ops --------------------------------------------------
+class Dropout(Operator):
+    """Reference: `autograd.Dropout(ratio)` — mask cached for backward;
+    identity in eval mode (gated by module `training` flag)."""
+
+    def __init__(self, ratio: float = 0.5, rng_key=None):
+        super().__init__()
+        self.ratio = ratio
+        self._key = rng_key
+
+    def forward(self, x):
+        if not training or self.ratio == 0.0:
+            self._mask = None
+            return x
+        key = self._key
+        if key is None:
+            from .device import get_default_device
+
+            key = get_default_device().next_key()
+        keep = 1.0 - self.ratio
+        self._mask = jax.random.bernoulli(key, keep, x.shape).astype(x.dtype) / keep
+        return x * self._mask
+
+    def backward(self, dy):
+        return dy if self._mask is None else dy * self._mask
+
+
+class _Conv2d(Operator):
+    """Reference: `autograd._Conv2d` → `GpuConvForward/Backward` (N12)."""
+
+    def __init__(self, handle: native.ConvHandle):
+        super().__init__()
+        self.handle = handle
+
+    def fn(self, x, w, *b):
+        return native.conv2d(self.handle, x, w, b[0] if b else None)
+
+
+class _BatchNorm2d(Operator):
+    """Reference: `autograd._BatchNorm2d` → `GpuBatchNormForward*` (N13).
+
+    Training mode: normalizes by batch stats; exposes
+    `new_running_mean/var` on the op instance after forward (the Layer
+    reads them and rebinds its state tensors — the reference mutates
+    them inside cuDNN instead). Inference: uses running stats.
+    """
+
+    def __init__(self, handle: native.BatchNormHandle, running_mean, running_var):
+        super().__init__()
+        self.handle = handle
+        self.rm = running_mean.data if isinstance(running_mean, Tensor) else running_mean
+        self.rv = running_var.data if isinstance(running_var, Tensor) else running_var
+        self.new_running_mean = None
+        self.new_running_var = None
+
+    def forward(self, x, scale, bias):
+        if training:
+            def fwd(x_, s_, b_):
+                y, mean, var, nrm, nrv = native.batchnorm_training(
+                    self.handle, x_, s_, b_, self.rm, self.rv
+                )
+                return y, (nrm, nrv)
+
+            if self.requires_grad:
+                y, vjp, (nrm, nrv) = jax.vjp(fwd, x, scale, bias, has_aux=True)
+                self._vjp = vjp
+            else:
+                y, (nrm, nrv) = fwd(x, scale, bias)
+            self.new_running_mean = nrm
+            self.new_running_var = nrv
+            return y
+        if self.requires_grad:
+            y, self._vjp = jax.vjp(
+                lambda x_, s_, b_: native.batchnorm_inference(
+                    self.handle, x_, s_, b_, self.rm, self.rv
+                ),
+                x,
+                scale,
+                bias,
+            )
+            return y
+        return native.batchnorm_inference(
+            self.handle, x, scale, bias, self.rm, self.rv
+        )
+
+    def backward(self, dy):
+        return self._vjp(dy)
+
+
+class _Pooling2d(Operator):
+    """Reference: `autograd._Pooling2d` → `GpuPoolingForward` (N14)."""
+
+    def __init__(self, handle: native.PoolingHandle):
+        super().__init__()
+        self.handle = handle
+
+    def fn(self, x):
+        return native.pooling(self.handle, x)
+
+
+# ===========================================================================
+# Functional wrappers (reference exposes these lowercase helpers).
+# ===========================================================================
+def relu(x):
+    return ReLU()(x)
+
+
+def sigmoid(x):
+    return Sigmoid()(x)
+
+
+def tanh(x):
+    return Tanh()(x)
+
+
+def softmax(x, axis=1):
+    return SoftMax(axis)(x)
+
+
+def add(a, b):
+    return Add()(a, b)
+
+
+def sub(a, b):
+    return Sub()(a, b)
+
+
+def mul(a, b):
+    return Mul()(a, b)
+
+
+def div(a, b):
+    return Div()(a, b)
+
+
+def pow(a, b):  # noqa: A001
+    return Pow()(a, b)
+
+
+def matmul(a, b):
+    return Mult()(a, b)
+
+
+def gemm(a, b, c=None, alpha=1.0, beta=1.0, transA=0, transB=0):
+    op = Gemm(alpha, beta, transA, transB)
+    return op(a, b, c) if c is not None else op(a, b)
+
+
+def add_bias(x, b, axis=0):
+    return AddBias(axis)(x, b)
+
+
+def reshape(x, shape):
+    return Reshape(shape)(x)
+
+
+def flatten(x, axis=1):
+    return Flatten(axis)(x)
+
+
+def transpose(x, axes=None):
+    return Transpose(axes)(x)
+
+
+def cat(xs, axis=0):
+    return Concat(axis)(*xs)
+
+
+def dropout(x, ratio=0.5):
+    return Dropout(ratio)(x)
+
+
+def reduce_sum(x, axes=None, keepdims=False):
+    return ReduceSum(axes, keepdims)(x)
+
+
+def reduce_mean(x, axes=None, keepdims=False):
+    return ReduceMean(axes, keepdims)(x)
+
+
+def softmax_cross_entropy(x, t):
+    return SoftMaxCrossEntropy(t)(x)
+
+
+def mse_loss(x, t):
+    return MeanSquareError(t)(x)
+
+
+def binary_cross_entropy(x, t):
+    return BinaryCrossEntropy(t)(x)
+
+
+def conv2d(handle, x, w, b=None):
+    return _Conv2d(handle)(x, w, b) if b is not None else _Conv2d(handle)(x, w)
+
+
+def pooling_2d(handle, x):
+    return _Pooling2d(handle)(x)
+
+
+def gather(x, indices, axis=0):
+    return Gather(axis, indices)(x)
+
+
+def embedding(w, indices):
+    return Embedding(indices)(w)
+
+
+def cast(x, to):
+    return Cast(to)(x)

@@ -1,0 +1,203 @@
+"""Convolution / BatchNorm / Pooling over XLA HLO.
+
+Reference parity:
+  - `src/model/operation/convolution.{h,cc}`: `ConvHandle`,
+    `CudnnConvHandle`, `GpuConvForward/Backward{x,W,b}` → here one
+    `ConvHandle` + `conv2d` via `lax.conv_general_dilated` (backward
+    comes from `jax.vjp`, which XLA lowers to the transposed convs the
+    reference hand-dispatches to cuDNN algos).
+  - `src/model/operation/batchnorm.{h,cc}`: `BatchNormHandle`,
+    `GpuBatchNormForwardTraining/Inference/Backward` → fused-in-XLA
+    normalization; running-stat update semantics preserved
+    (running = (1-momentum)*running + momentum*batch, cuDNN-style
+    exponentialAverageFactor).
+  - `src/model/operation/pooling.{h,cc}`: `PoolingHandle`,
+    `GpuPoolingForward/Backward` max/avg → `lax.reduce_window`.
+
+Layout: NCHW at the API (reference layout); XLA relayouts for the MXU
+internally. Conv accumulates in fp32; input/filter dtype is whatever
+the caller passes (bf16 under mixed-precision policy).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_Pair = Union[int, Tuple[int, int]]
+
+
+def _pair(v: _Pair) -> Tuple[int, int]:
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+class ConvHandle:
+    """Shape/config metadata for a 2-d convolution.
+
+    Reference: `ConvHandle` / `CudnnConvHandle` (algo selection and
+    workspace fields dropped — XLA owns algorithm choice).
+    """
+
+    def __init__(
+        self,
+        in_channels: int,
+        out_channels: int,
+        kernel_size: _Pair,
+        stride: _Pair = 1,
+        padding: _Pair = 0,
+        dilation: _Pair = 1,
+        groups: int = 1,
+        bias: bool = True,
+    ):
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        self.kernel_size = _pair(kernel_size)
+        self.stride = _pair(stride)
+        self.padding = _pair(padding)
+        self.dilation = _pair(dilation)
+        self.groups = groups
+        self.bias = bias
+        if in_channels % groups or out_channels % groups:
+            raise ValueError(
+                f"channels ({in_channels}->{out_channels}) not divisible by groups={groups}"
+            )
+
+    def out_shape(self, h: int, w: int) -> Tuple[int, int]:
+        kh, kw = self.kernel_size
+        sh, sw = self.stride
+        ph, pw = self.padding
+        dh, dw = self.dilation
+        oh = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+        ow = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+        return oh, ow
+
+
+@partial(jax.jit, static_argnums=(0,), inline=True)
+def _conv2d_nobias(handle: ConvHandle, x, w):
+    ph, pw = handle.padding
+    return lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=handle.stride,
+        padding=((ph, ph), (pw, pw)),
+        rhs_dilation=handle.dilation,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=handle.groups,
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype)
+
+
+def conv2d(handle: ConvHandle, x, w, b=None):
+    """Reference: `GpuConvForward(x, W, b, handle)`.
+
+    x: (N, C, H, W); w: (O, C/groups, kh, kw); b: (O,) or None.
+    """
+    y = _conv2d_nobias(handle, x, w)
+    if b is not None:
+        y = y + b.reshape(1, -1, 1, 1)
+    return y
+
+
+class BatchNormHandle:
+    """Reference: `BatchNormHandle` / `CudnnBatchNormHandle`.
+
+    `factor` is cuDNN's exponentialAverageFactor (SINGA passes the
+    layer momentum): running = (1-factor)*running + factor*batch.
+    """
+
+    def __init__(self, factor: float = 0.9, eps: float = 1e-5):
+        self.factor = factor
+        self.eps = eps
+
+
+def batchnorm_training(handle: BatchNormHandle, x, scale, bias, running_mean, running_var):
+    """Reference: `GpuBatchNormForwardTraining`.
+
+    Per-channel (axis 1) normalization over (N, H, W). Returns
+    (y, batch_mean, batch_var, new_running_mean, new_running_var);
+    batch stats are returned because the reference caches them for
+    backward (here `jax.vjp` handles that, but the layer still updates
+    running state from them).
+    """
+    axes = tuple(i for i in range(x.ndim) if i != 1)
+    mean = jnp.mean(x, axis=axes)
+    # cuDNN uses biased variance for normalization.
+    var = jnp.var(x, axis=axes)
+    shape = [1, -1] + [1] * (x.ndim - 2)
+    inv = lax.rsqrt(var + handle.eps).reshape(shape)
+    y = (x - mean.reshape(shape)) * inv * scale.reshape(shape) + bias.reshape(shape)
+    f = handle.factor
+    new_rm = (1.0 - f) * running_mean + f * mean
+    new_rv = (1.0 - f) * running_var + f * var
+    return y, mean, var, new_rm, new_rv
+
+
+def batchnorm_inference(handle: BatchNormHandle, x, scale, bias, running_mean, running_var):
+    """Reference: `GpuBatchNormForwardInference`."""
+    shape = [1, -1] + [1] * (x.ndim - 2)
+    inv = lax.rsqrt(running_var + handle.eps).reshape(shape)
+    return (x - running_mean.reshape(shape)) * inv * scale.reshape(shape) + bias.reshape(
+        shape
+    )
+
+
+class PoolingHandle:
+    """Reference: `PoolingHandle` / `CudnnPoolingHandle`."""
+
+    def __init__(
+        self,
+        kernel_size: _Pair,
+        stride: _Pair = None,
+        padding: _Pair = 0,
+        is_max: bool = True,
+        count_include_pad: bool = False,
+    ):
+        self.kernel_size = _pair(kernel_size)
+        self.stride = _pair(stride) if stride is not None else self.kernel_size
+        self.padding = _pair(padding)
+        self.is_max = is_max
+        self.count_include_pad = count_include_pad
+
+    def out_shape(self, h: int, w: int) -> Tuple[int, int]:
+        kh, kw = self.kernel_size
+        sh, sw = self.stride
+        ph, pw = self.padding
+        return (h + 2 * ph - kh) // sh + 1, (w + 2 * pw - kw) // sw + 1
+
+
+@partial(jax.jit, static_argnums=(0,), inline=True)
+def pooling(handle: PoolingHandle, x):
+    """Reference: `GpuPoolingForward` (max/avg) → `lax.reduce_window`."""
+    kh, kw = handle.kernel_size
+    sh, sw = handle.stride
+    ph, pw = handle.padding
+    window = (1, 1, kh, kw)
+    strides = (1, 1, sh, sw)
+    pads = ((0, 0), (0, 0), (ph, ph), (pw, pw))
+    if handle.is_max:
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, init, lax.max, window, strides, pads)
+    s = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
+    if handle.count_include_pad or (ph == 0 and pw == 0):
+        return s / (kh * kw)
+    # Divide by the true (unpadded) window size per position.
+    counts = lax.reduce_window(
+        jnp.ones_like(x), 0.0, lax.add, window, strides, pads
+    )
+    return s / counts
+
+
+# PoolingHandle/ConvHandle/BatchNormHandle participate in jit static args;
+# give them stable hash/eq by config so executable caching works.
+def _cfg(obj):
+    return tuple(sorted((k, v) for k, v in vars(obj).items()))
+
+
+for _cls in (ConvHandle, BatchNormHandle, PoolingHandle):
+    _cls.__hash__ = lambda self: hash((type(self).__name__, _cfg(self)))
+    _cls.__eq__ = lambda self, other: (
+        type(self) is type(other) and _cfg(self) == _cfg(other)
+    )

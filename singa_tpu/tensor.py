@@ -84,6 +84,7 @@ class Tensor:
         "requires_grad",
         "stores_grad",
         "creator",
+        "creator_index",  # which output of `creator` this tensor is
         "name",
     )
 
@@ -112,6 +113,7 @@ class Tensor:
         self.requires_grad = requires_grad
         self.stores_grad = stores_grad
         self.creator = creator
+        self.creator_index = 0
         self.name = name
 
     # ---- metadata -------------------------------------------------------
@@ -182,6 +184,7 @@ class Tensor:
         t.requires_grad = self.requires_grad
         t.stores_grad = self.stores_grad
         t.creator = None
+        t.creator_index = 0
         t.name = self.name
         return t
 
@@ -330,6 +333,7 @@ def _wrap(arr, like: Tensor) -> Tensor:
     t.requires_grad = False
     t.stores_grad = False
     t.creator = None
+    t.creator_index = 0
     t.name = None
     return t
 
@@ -341,6 +345,7 @@ def _wrap_dev(arr, dev: Device) -> Tensor:
     t.requires_grad = False
     t.stores_grad = False
     t.creator = None
+    t.creator_index = 0
     t.name = None
     return t
 

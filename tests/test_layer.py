@@ -1,0 +1,105 @@
+"""Layer tests: lazy shape inference, param naming, get/set states.
+Reference model: `test/python/test_layer.py`."""
+import numpy as np
+
+from singa_tpu import autograd, layer, tensor
+
+
+def x2d(shape=(4, 8), seed=0):
+    return tensor.from_numpy(np.random.RandomState(seed).randn(*shape).astype(np.float32))
+
+
+def test_linear_lazy_init_and_shapes():
+    lin = layer.Linear(5)
+    x = x2d()
+    y = lin(x)
+    assert y.shape == (4, 5)
+    assert lin.W.shape == (8, 5)
+    assert lin.b.shape == (5,)
+    assert lin.W.stores_grad and lin.W.requires_grad
+
+
+def test_param_naming_hierarchy():
+    class Net(layer.Layer):
+        def __init__(self):
+            super().__init__(name="net")
+            self.fc1 = layer.Linear(4)
+            self.fc2 = layer.Linear(2)
+
+        def forward(self, x):
+            return self.fc2(self.fc1(x))
+
+    net = Net()
+    net(x2d())
+    params = net.get_params()
+    assert set(params) == {"net.fc1.W", "net.fc1.b", "net.fc2.W", "net.fc2.b"}
+
+
+def test_set_params_roundtrip():
+    lin = layer.Linear(3, name="lin")
+    lin(x2d())
+    params = {k: v.to_numpy() for k, v in lin.get_params().items()}
+    new_w = np.ones_like(params["lin.W"])
+    lin.set_params({"lin.W": new_w})
+    np.testing.assert_array_equal(lin.W.to_numpy(), new_w)
+
+
+def test_conv_bn_pool_stack():
+    x = tensor.from_numpy(
+        np.random.RandomState(1).randn(2, 3, 8, 8).astype(np.float32)
+    )
+    conv = layer.Conv2d(6, 3, padding=1)
+    bn = layer.BatchNorm2d()
+    pool = layer.MaxPool2d(2, 2)
+    autograd.training = True
+    try:
+        y = pool(bn(conv(x)))
+        assert y.shape == (2, 6, 4, 4)
+    finally:
+        autograd.training = False
+    states = {}
+    states.update(bn.get_states())
+    # BN contributes params + running stats
+    keys = {k.split(".")[-1] for k in states}
+    assert keys == {"scale", "bias", "running_mean", "running_var"}
+
+
+def test_bn_running_stats_update_in_training():
+    x = tensor.from_numpy(
+        (np.random.RandomState(2).randn(4, 3, 5, 5) * 2 + 1).astype(np.float32)
+    )
+    bn = layer.BatchNorm2d(momentum=0.5)
+    autograd.training = True
+    try:
+        bn(x)
+    finally:
+        autograd.training = False
+    rm = bn.running_mean.to_numpy()
+    assert np.abs(rm).max() > 0.1  # moved toward batch mean (~1)
+
+
+def test_separable_conv():
+    x = tensor.from_numpy(
+        np.random.RandomState(3).randn(1, 4, 8, 8).astype(np.float32)
+    )
+    sep = layer.SeparableConv2d(8, 3, padding=1)
+    y = sep(x)
+    assert y.shape == (1, 8, 8, 8)
+    # depthwise W: (4,1,3,3); pointwise W: (8,4,1,1)
+    names = set(sep.get_params())
+    assert any("depthwise" in n for n in names)
+    assert any("pointwise" in n for n in names)
+
+
+def test_embedding_layer():
+    idx = tensor.from_numpy(np.array([0, 2, 1], np.int32))
+    emb = layer.Embedding(5, 4)
+    y = emb(idx)
+    assert y.shape == (3, 4)
+
+
+def test_sequential():
+    seq = layer.Sequential(layer.Linear(6), layer.ReLU(), layer.Linear(2))
+    y = seq(x2d())
+    assert y.shape == (4, 2)
+    assert len(seq.get_params()) == 4

@@ -1,0 +1,86 @@
+"""Optimizer update rules vs hand-computed expectations.
+Reference model: `test/python/test_opt.py`."""
+import numpy as np
+
+from singa_tpu import opt, tensor
+from singa_tpu.tensor import Tensor
+
+
+def make_param(v):
+    t = tensor.from_numpy(np.asarray(v, np.float32))
+    t.requires_grad = True
+    t.stores_grad = True
+    return t
+
+
+def test_sgd_plain():
+    p = make_param([1.0, 2.0])
+    g = tensor.from_numpy(np.array([0.5, -0.5], np.float32))
+    sgd = opt.SGD(lr=0.1)
+    sgd.update(p, g)
+    np.testing.assert_allclose(p.to_numpy(), [0.95, 2.05], rtol=1e-6)
+
+
+def test_sgd_momentum():
+    p = make_param([1.0])
+    g = tensor.from_numpy(np.array([1.0], np.float32))
+    sgd = opt.SGD(lr=0.1, momentum=0.9)
+    sgd.update(p, g)  # buf = g = 1 → p = 1 - 0.1
+    np.testing.assert_allclose(p.to_numpy(), [0.9], rtol=1e-6)
+    sgd.update(p, g)  # buf = 0.9*1 + 1 = 1.9 → p = 0.9 - 0.19
+    np.testing.assert_allclose(p.to_numpy(), [0.71], rtol=1e-6)
+
+
+def test_sgd_weight_decay():
+    p = make_param([1.0])
+    g = tensor.from_numpy(np.array([0.0], np.float32))
+    sgd = opt.SGD(lr=0.1, weight_decay=0.1)
+    sgd.update(p, g)  # g = 0 + 0.1*1 → p = 1 - 0.01
+    np.testing.assert_allclose(p.to_numpy(), [0.99], rtol=1e-6)
+
+
+def test_sgd_nesterov():
+    p = make_param([1.0])
+    g = tensor.from_numpy(np.array([1.0], np.float32))
+    sgd = opt.SGD(lr=0.1, momentum=0.9, nesterov=True)
+    sgd.update(p, g)  # buf=1; g' = 1 + 0.9 = 1.9 → p = 1 - 0.19
+    np.testing.assert_allclose(p.to_numpy(), [0.81], rtol=1e-6)
+
+
+def test_adam():
+    p = make_param([1.0])
+    g = tensor.from_numpy(np.array([0.1], np.float32))
+    adam = opt.Adam(lr=0.01)
+    adam.update(p, g)
+    # t=1: m=0.01*g? m = 0.1*0.1... m=(1-0.9)*0.1=0.01; v=(1-0.999)*0.01=1e-5
+    # mhat=0.1, vhat=0.01 → p -= 0.01*0.1/(0.1+1e-8) ≈ 0.01
+    np.testing.assert_allclose(p.to_numpy(), [0.99], rtol=1e-4)
+
+
+def test_rmsprop_adagrad_run():
+    for O in (opt.RMSProp, opt.AdaGrad):
+        p = make_param([1.0, -1.0])
+        g = tensor.from_numpy(np.array([0.1, 0.2], np.float32))
+        o = O(lr=0.01)
+        for _ in range(3):
+            o.update(p, g)
+            o.step()
+        assert np.isfinite(p.to_numpy()).all()
+
+
+def test_exponential_decay():
+    sched = opt.ExponentialDecay(0.1, decay_steps=10, decay_rate=0.5)
+    assert abs(sched(0) - 0.1) < 1e-9
+    assert abs(sched(10) - 0.05) < 1e-9
+    stair = opt.ExponentialDecay(0.1, 10, 0.5, staircase=True)
+    assert abs(stair(9) - 0.1) < 1e-9
+    assert abs(stair(10) - 0.05) < 1e-9
+
+
+def test_half_precision_grad_applies_to_fp32_param():
+    p = make_param([1.0])
+    g16 = tensor.from_numpy(np.array([0.5], np.float32)).as_type(tensor.bfloat16)
+    sgd = opt.SGD(lr=0.1)
+    sgd.update(p, g16)
+    assert p.dtype == np.float32
+    np.testing.assert_allclose(p.to_numpy(), [0.95], rtol=1e-2)
