@@ -21,6 +21,7 @@ from . import initializer  # noqa: F401
 from . import layer  # noqa: F401
 from . import model  # noqa: F401
 from . import opt  # noqa: F401
+from . import rnn  # noqa: F401
 from . import tensor  # noqa: F401
 from .model import Model  # noqa: F401
 from .device import (  # noqa: F401

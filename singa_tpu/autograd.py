@@ -1002,6 +1002,27 @@ class _Pooling2d(Operator):
         return native.pooling(self.handle, x)
 
 
+class _RNN(Operator):
+    """Reference: `autograd.CudnnRNN` → `GpuRNNForwardTraining/Backward`
+    (N15). Inputs (x, hx, cx, W-packed); outputs (y, hy, cy). Backward
+    is the XLA transpose of the scan (the reference hand-calls
+    `GpuRNNBackwardx/W`)."""
+
+    def __init__(self, handle, rng_key=None):
+        super().__init__()
+        self.handle = handle
+        self._key = rng_key
+
+    def fn(self, x, hx, cx, w):
+        from .ops import rnn as rnn_ops
+
+        train = training and self.handle.dropout > 0
+        return rnn_ops.rnn_forward(
+            self.handle, x, hx, cx, w, train,
+            self._key if train else None,
+        )
+
+
 # ===========================================================================
 # Functional wrappers (reference exposes these lowercase helpers).
 # ===========================================================================
@@ -1100,6 +1121,11 @@ def conv2d(handle, x, w, b=None):
 
 def pooling_2d(handle, x):
     return _Pooling2d(handle)(x)
+
+
+def rnn_op(handle, x, hx, cx, w, rng_key=None):
+    """Reference: `autograd.CudnnRNN` call path. Returns (y, hy, cy)."""
+    return _RNN(handle, rng_key)(x, hx, cx, w)
 
 
 def gather(x, indices, axis=0):

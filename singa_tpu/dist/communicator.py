@@ -88,6 +88,18 @@ class Communicator:
                  devices: Optional[Sequence] = None):
         from ..device import _accel_devices
 
+        if nccl_id is not None and jax.process_count() == 1:
+            # Reference: the multiprocess ctor uses the shared
+            # ncclUniqueId to join the clique. Here the token carries
+            # the PJRT coordinator address; process id/count come from
+            # the launcher env (hanging on a missing coordinator is
+            # worse than running single-host, so require both).
+            n = os.environ.get("SINGA_TPU_NUM_PROCS")
+            pid = os.environ.get("SINGA_TPU_PROC_ID")
+            if n is not None and pid is not None:
+                init_distributed(nccl_id.coordinator_address,
+                                 num_processes=int(n), process_id=int(pid))
+
         devs = list(devices) if devices is not None else _accel_devices()
         if world_size is None:
             world_size = len(devs)
@@ -97,7 +109,10 @@ class Communicator:
             )
         self.world_size = world_size
         self.local_rank = local_rank
-        self.global_rank = jax.process_index() * world_size + local_rank
+        # Rank stride is the per-process device count (reference:
+        # MPI rank * nDev + local_rank), not the global world size.
+        self.global_rank = (jax.process_index() * jax.local_device_count()
+                            + local_rank)
         self.buff_size = buff_size  # parity: fusion bucket budget (bytes)
         self.axis = axis
         self.mesh = Mesh(np.asarray(devs[:world_size]), (axis,))
@@ -125,6 +140,11 @@ class Communicator:
         fused away by XLA.
         """
         if not xs:
+            return xs
+        if not _axis_bound(self.axis):
+            # Driver regime: synch is an identity — skip the
+            # flatten/concat/split round-trip entirely.
+            self._last = xs[-1]
             return xs
         shapes = [x.shape for x in xs]
         sizes = [int(np.prod(s)) if s else 1 for s in shapes]

@@ -230,6 +230,7 @@ class DistOpt(Optimizer):
 
             communicator = Communicator(local_rank=local_rank,
                                         world_size=world_size,
+                                        nccl_id=nccl_id,
                                         buff_size=buffSize)
         self.communicator = communicator
         self.world_size = self.communicator.world_size
@@ -244,8 +245,15 @@ class DistOpt(Optimizer):
         pass  # base-class ctor writes; real states live on self.opt
 
     def update(self, param, grad):
+        """Reference: `DistOpt.update` — allreduce then average then
+        apply (same grad scaling as every backward_and_* path)."""
         self.all_reduce(grad)
         self.wait()
+        inv = self.communicator.grad_scale
+        if isinstance(grad, Tensor):
+            grad.data = grad.data * inv
+        else:
+            grad = grad * inv
         self.opt.update(param, grad)
 
     def apply(self, param, value, grad):

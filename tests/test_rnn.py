@@ -1,0 +1,169 @@
+"""RNN/LSTM/GRU parity tests.
+
+Reference test model: `test/python/test_operation.py`'s RNN cases
+check forward vs a numpy reference and backward vs numeric grads.
+Here torch (CPU) is the golden model: its LSTM/GRU use the same gate
+order (i,f,g,o / r,z,n) and linear-before-reset semantics as cuDNN,
+which is exactly the convention singa_tpu.ops.rnn documents.
+"""
+import numpy as np
+import pytest
+import torch
+
+from singa_tpu import autograd, tensor as tensor_mod
+from singa_tpu.ops.rnn import RNNHandle
+from singa_tpu.rnn import GRU, LSTM, RNN
+
+T, B, F, H = 5, 3, 4, 6
+
+
+def _pack_from_torch(handle: RNNHandle, mod) -> np.ndarray:
+    tensors = {}
+    for layer in range(handle.num_layers):
+        for d in range(handle.num_directions):
+            sfx = f"_l{layer}" + ("_reverse" if d == 1 else "")
+            tensors[("W_ih", layer, d)] = getattr(mod, "weight_ih" + sfx).detach().numpy()
+            tensors[("W_hh", layer, d)] = getattr(mod, "weight_hh" + sfx).detach().numpy()
+            if handle.bias:
+                tensors[("b_ih", layer, d)] = getattr(mod, "bias_ih" + sfx).detach().numpy()
+                tensors[("b_hh", layer, d)] = getattr(mod, "bias_hh" + sfx).detach().numpy()
+    return np.asarray(handle.pack(tensors))
+
+
+def _run_ours(handle, w_np, x_np, grad=False):
+    x = tensor_mod.from_numpy(x_np)
+    hx = tensor_mod.from_numpy(
+        np.zeros(handle.state_shape(B), np.float32))
+    cx = tensor_mod.from_numpy(
+        np.zeros(handle.state_shape(B), np.float32))
+    w = tensor_mod.from_numpy(w_np)
+    if grad:
+        for t in (x, w):
+            t.requires_grad = True
+            t.stores_grad = True
+    y, hy, cy = autograd.rnn_op(handle, x, hx, cx, w)
+    return x, w, y, hy, cy
+
+
+@pytest.mark.parametrize("bidirectional", [False, True])
+@pytest.mark.parametrize("num_layers", [1, 2])
+def test_lstm_forward_matches_torch(num_layers, bidirectional):
+    torch.manual_seed(0)
+    ref = torch.nn.LSTM(F, H, num_layers=num_layers,
+                        bidirectional=bidirectional)
+    handle = RNNHandle(F, H, num_layers, "lstm",
+                       bidirectional=bidirectional)
+    w_np = _pack_from_torch(handle, ref)
+    x_np = np.random.RandomState(1).randn(T, B, F).astype(np.float32)
+    _, _, y, hy, cy = _run_ours(handle, w_np, x_np)
+    yt, (ht, ct) = ref(torch.from_numpy(x_np))
+    np.testing.assert_allclose(y.to_numpy(), yt.detach().numpy(),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(hy.to_numpy(), ht.detach().numpy(),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(cy.to_numpy(), ct.detach().numpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("mode,torch_cls", [
+    ("gru", torch.nn.GRU),
+    ("tanh", torch.nn.RNN),
+])
+def test_other_modes_match_torch(mode, torch_cls):
+    torch.manual_seed(2)
+    ref = torch_cls(F, H)
+    handle = RNNHandle(F, H, 1, mode)
+    w_np = _pack_from_torch(handle, ref)
+    x_np = np.random.RandomState(3).randn(T, B, F).astype(np.float32)
+    _, _, y, hy, _ = _run_ours(handle, w_np, x_np)
+    yt, ht = ref(torch.from_numpy(x_np))
+    np.testing.assert_allclose(y.to_numpy(), yt.detach().numpy(),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(hy.to_numpy(), ht.detach().numpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_lstm_backward_matches_torch():
+    torch.manual_seed(4)
+    ref = torch.nn.LSTM(F, H)
+    handle = RNNHandle(F, H, 1, "lstm")
+    w_np = _pack_from_torch(handle, ref)
+    x_np = np.random.RandomState(5).randn(T, B, F).astype(np.float32)
+
+    x, w, y, _, _ = _run_ours(handle, w_np, x_np, grad=True)
+    loss = autograd.reduce_sum(y)
+    grads = {id(p): g for p, g in autograd.backward(loss)}
+
+    xt = torch.from_numpy(x_np).requires_grad_(True)
+    yt, _ = ref(xt)
+    yt.sum().backward()
+
+    np.testing.assert_allclose(grads[id(x)].to_numpy(), xt.grad.numpy(),
+                               rtol=1e-4, atol=1e-4)
+    # packed dW vs torch's per-segment grads
+    dw = np.asarray(grads[id(w)].to_numpy())
+    got = handle.unpack(dw)
+    np.testing.assert_allclose(np.asarray(got[("W_ih", 0, 0)]),
+                               ref.weight_ih_l0.grad.numpy(),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(got[("W_hh", 0, 0)]),
+                               ref.weight_hh_l0.grad.numpy(),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(got[("b_ih", 0, 0)]),
+                               ref.bias_ih_l0.grad.numpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_pack_unpack_roundtrip():
+    handle = RNNHandle(F, H, 2, "gru", bidirectional=True)
+    w = np.random.RandomState(0).randn(handle.weights_size).astype(np.float32)
+    again = np.asarray(handle.pack(handle.unpack(w)))
+    np.testing.assert_array_equal(w, again)
+
+
+def test_layer_api_shapes_and_state_carry():
+    autograd.training = False
+    x = tensor_mod.from_numpy(
+        np.random.RandomState(7).randn(T, B, F).astype(np.float32))
+    lstm = LSTM(H, num_layers=2)
+    y, (hy, cy) = lstm(x)
+    assert y.shape == (T, B, H)
+    assert hy.shape == (2, B, H) and cy.shape == (2, B, H)
+    # Char-RNN style state carry across calls
+    y2, (hy2, _) = lstm(x, hy, cy)
+    assert y2.shape == (T, B, H)
+    assert not np.allclose(y.to_numpy(), y2.to_numpy())
+
+    gru = GRU(H, batch_first=True)
+    xb = tensor_mod.from_numpy(
+        np.random.RandomState(8).randn(B, T, F).astype(np.float32))
+    yg, hg = gru(xb)
+    assert yg.shape == (B, T, H) and hg.shape == (1, B, H)
+
+    rnn = RNN(H, nonlinearity="relu", bidirectional=True)
+    yr, hr = rnn(x)
+    assert yr.shape == (T, B, 2 * H) and hr.shape == (2, B, H)
+
+
+def test_layer_trains():
+    """One SGD step on an LSTM regression decreases loss."""
+    from singa_tpu import opt
+
+    autograd.training = True
+    try:
+        rs = np.random.RandomState(9)
+        x = tensor_mod.from_numpy(rs.randn(T, B, F).astype(np.float32))
+        t = tensor_mod.from_numpy(rs.randn(T, B, H).astype(np.float32))
+        lstm = LSTM(H)
+        sgd = opt.SGD(lr=0.1)
+
+        def loss_val():
+            y, _ = lstm(x)
+            return autograd.mse_loss(y, t)
+
+        l0 = loss_val()
+        sgd.backward_and_update(l0)
+        l1 = loss_val()
+        assert float(l1.to_numpy()) < float(l0.to_numpy())
+    finally:
+        autograd.training = False
