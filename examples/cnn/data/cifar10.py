@@ -1,0 +1,46 @@
+"""CIFAR-10 loader. Reference: `examples/cnn/data/cifar10.py`.
+
+Reads the python-pickle batches from `--data-dir` (cifar-10-batches-py)
+when present; otherwise a deterministic synthetic stand-in (no network
+in this environment).
+"""
+import os
+import pickle
+
+import numpy as np
+
+NUM_CLASSES = 10
+
+
+def _load_batch(path):
+    with open(path, "rb") as f:
+        d = pickle.load(f, encoding="bytes")
+    x = d[b"data"].reshape(-1, 3, 32, 32).astype(np.float32) / 255.0
+    y = np.asarray(d.get(b"labels", d.get(b"fine_labels")), np.int32)
+    return x, y
+
+
+def synthetic(n_train=2048, n_test=512, num_classes=NUM_CLASSES, seed=1):
+    from mnist import synthetic as syn
+
+    return syn(n_train, n_test, num_classes, size=32, channels=3, seed=seed)
+
+
+def normalize(x):
+    mean = np.array([0.4914, 0.4822, 0.4465], np.float32).reshape(1, 3, 1, 1)
+    std = np.array([0.2470, 0.2435, 0.2616], np.float32).reshape(1, 3, 1, 1)
+    return (x - mean) / std
+
+
+def load(data_dir=None):
+    base = os.path.join(data_dir, "cifar-10-batches-py") if data_dir else None
+    if base and os.path.isdir(base):
+        xs, ys = zip(*[_load_batch(os.path.join(base, f"data_batch_{i}"))
+                       for i in range(1, 6)])
+        tx, ty = np.concatenate(xs), np.concatenate(ys)
+        vx, vy = _load_batch(os.path.join(base, "test_batch"))
+        return normalize(tx), ty, normalize(vx), vy
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    return synthetic()

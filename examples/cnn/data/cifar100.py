@@ -1,0 +1,25 @@
+"""CIFAR-100 loader. Reference: `examples/cnn/data/cifar100.py`."""
+import os
+
+import numpy as np
+
+NUM_CLASSES = 100
+
+
+def load(data_dir=None):
+    base = os.path.join(data_dir, "cifar-100-python") if data_dir else None
+    if base and os.path.isdir(base):
+        import sys
+
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from cifar10 import _load_batch, normalize
+
+        tx, ty = _load_batch(os.path.join(base, "train"))
+        vx, vy = _load_batch(os.path.join(base, "test"))
+        return normalize(tx), ty, normalize(vx), vy
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from mnist import synthetic
+
+    return synthetic(2048, 512, NUM_CLASSES, size=32, channels=3, seed=2)

@@ -1,0 +1,55 @@
+"""MNIST loader. Reference: `examples/cnn/data/mnist.py`.
+
+Loads the classic idx-format files from `--data-dir` when present
+(train-images-idx3-ubyte[.gz] etc.); otherwise generates a deterministic
+synthetic stand-in with the same shapes/dtypes (this environment has no
+network access to download the real set).
+"""
+import gzip
+import os
+
+import numpy as np
+
+
+def _read_idx(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic = int.from_bytes(f.read(4), "big")
+        ndim = magic & 0xFF
+        shape = [int.from_bytes(f.read(4), "big") for _ in range(ndim)]
+        return np.frombuffer(f.read(), dtype=np.uint8).reshape(shape)
+
+
+def _find(dir_, stem):
+    for sfx in ("", ".gz"):
+        p = os.path.join(dir_, stem + sfx)
+        if os.path.exists(p):
+            return p
+    return None
+
+
+def synthetic(n_train=1024, n_test=256, num_classes=10, size=28, channels=1,
+              seed=0):
+    rs = np.random.RandomState(seed)
+    def mk(n):
+        y = rs.randint(0, num_classes, n).astype(np.int32)
+        # class-dependent means so a real model can actually learn
+        x = (rs.randn(n, channels, size, size) * 0.5
+             + y[:, None, None, None] / num_classes).astype(np.float32)
+        return x, y
+    xtr, ytr = mk(n_train)
+    xte, yte = mk(n_test)
+    return xtr, ytr, xte, yte
+
+
+def load(data_dir=None):
+    """Returns (train_x NCHW float32 [0,1]-ish, train_y int32, val_x, val_y)."""
+    if data_dir:
+        ims = _find(data_dir, "train-images-idx3-ubyte")
+        if ims:
+            tx = _read_idx(ims).astype(np.float32)[:, None] / 255.0
+            ty = _read_idx(_find(data_dir, "train-labels-idx1-ubyte")).astype(np.int32)
+            vx = _read_idx(_find(data_dir, "t10k-images-idx3-ubyte")).astype(np.float32)[:, None] / 255.0
+            vy = _read_idx(_find(data_dir, "t10k-labels-idx1-ubyte")).astype(np.int32)
+            return tx, ty, vx, vy
+    return synthetic()

@@ -1,0 +1,54 @@
+"""AlexNet (CIFAR-sized). Reference: `examples/cnn/model/alexnet.py`."""
+from singa_tpu import autograd, layer, model
+
+from cnn import _dist_update
+
+
+class AlexNet(model.Model):
+    def __init__(self, num_classes=10, num_channels=3):
+        super().__init__()
+        self.num_classes = num_classes
+        self.input_size = 224
+        self.dimension = 4
+        self.conv1 = layer.Conv2d(64, 11, stride=4, padding=2)
+        self.conv2 = layer.Conv2d(192, 5, padding=2)
+        self.conv3 = layer.Conv2d(384, 3, padding=1)
+        self.conv4 = layer.Conv2d(256, 3, padding=1)
+        self.conv5 = layer.Conv2d(256, 3, padding=1)
+        self.pool1 = layer.MaxPool2d(3, 2)
+        self.pool2 = layer.MaxPool2d(3, 2)
+        self.pool5 = layer.MaxPool2d(3, 2)
+        self.avgpool = layer.AvgPool2d(6, 1)
+        self.relu = layer.ReLU()
+        self.flatten = layer.Flatten()
+        self.dropout1 = layer.Dropout(0.5)
+        self.dropout2 = layer.Dropout(0.5)
+        self.linear1 = layer.Linear(4096)
+        self.linear2 = layer.Linear(4096)
+        self.linear3 = layer.Linear(num_classes)
+        self.dist_option = "plain"
+        self.spars = None
+
+    def forward(self, x):
+        y = self.pool1(self.relu(self.conv1(x)))
+        y = self.pool2(self.relu(self.conv2(y)))
+        y = self.relu(self.conv3(y))
+        y = self.relu(self.conv4(y))
+        y = self.pool5(self.relu(self.conv5(y)))
+        y = self.avgpool(y)
+        y = self.flatten(y)
+        y = self.dropout1(y)
+        y = self.relu(self.linear1(y))
+        y = self.dropout2(y)
+        y = self.relu(self.linear2(y))
+        return self.linear3(y)
+
+    def train_one_batch(self, x, y):
+        out = self.forward(x)
+        loss = autograd.softmax_cross_entropy(out, y)
+        _dist_update(self, loss)
+        return out, loss
+
+
+def create_model(**kwargs):
+    return AlexNet(**kwargs)

@@ -1,0 +1,59 @@
+"""Small CNN. Reference: `examples/cnn/model/cnn.py` (two conv + two
+linear, the MNIST workhorse)."""
+from singa_tpu import autograd, layer, model
+
+
+class CNN(model.Model):
+    def __init__(self, num_classes=10, num_channels=1):
+        super().__init__()
+        self.num_classes = num_classes
+        self.input_size = 28
+        self.dimension = 4
+        self.conv1 = layer.Conv2d(32, 3, padding=0)
+        self.conv2 = layer.Conv2d(64, 3, padding=0)
+        self.linear1 = layer.Linear(128)
+        self.linear2 = layer.Linear(num_classes)
+        self.pooling1 = layer.MaxPool2d(2, 2, padding=0)
+        self.pooling2 = layer.MaxPool2d(2, 2, padding=0)
+        self.relu = layer.ReLU()
+        self.flatten = layer.Flatten()
+        self.dropout = layer.Dropout(0.25)
+        self.dist_option = "plain"
+        self.spars = None
+
+    def forward(self, x):
+        y = self.pooling1(self.relu(self.conv1(x)))
+        y = self.pooling2(self.relu(self.conv2(y)))
+        y = self.flatten(y)
+        y = self.relu(self.linear1(y))
+        y = self.dropout(y)
+        return self.linear2(y)
+
+    def train_one_batch(self, x, y):
+        out = self.forward(x)
+        loss = autograd.softmax_cross_entropy(out, y)
+        _dist_update(self, loss)
+        return out, loss
+
+
+def _dist_update(m, loss):
+    """Reference: `train_cnn.py` dist_option switch (plain / half /
+    partialUpdate / sparseTopK / sparseThreshold)."""
+    o = m._optimizer
+    d = getattr(m, "dist_option", "plain")
+    if d == "plain" or not hasattr(o, "backward_and_update_half"):
+        o.backward_and_update(loss)
+    elif d == "half":
+        o.backward_and_update_half(loss)
+    elif d == "partialUpdate":
+        o.backward_and_partial_update(loss)
+    elif d == "sparseTopK":
+        o.backward_and_sparse_update(loss, spars=m.spars, topK=True)
+    elif d == "sparseThreshold":
+        o.backward_and_sparse_update(loss, spars=m.spars, topK=False)
+    else:
+        raise ValueError(f"unknown dist_option {d!r}")
+
+
+def create_model(**kwargs):
+    return CNN(**kwargs)

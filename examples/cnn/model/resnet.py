@@ -1,0 +1,129 @@
+"""ResNet-18/34/50/101/152. Reference: `examples/cnn/model/resnet.py`
+(torch-style BasicBlock/Bottleneck over SINGA layers).
+
+The benchmark workload: `create_model(depth=50)` on synthetic ImageNet
+shapes is the images/sec/chip metric (BASELINE.md)."""
+from singa_tpu import autograd, layer, model
+
+from cnn import _dist_update
+
+
+class BasicBlock(layer.Layer):
+    expansion = 1
+
+    def __init__(self, planes, stride=1, downsample=None):
+        super().__init__()
+        self.conv1 = layer.Conv2d(planes, 3, stride=stride, padding=1,
+                                  bias=False)
+        self.bn1 = layer.BatchNorm2d()
+        self.conv2 = layer.Conv2d(planes, 3, padding=1, bias=False)
+        self.bn2 = layer.BatchNorm2d()
+        self.relu = layer.ReLU()
+        self.downsample = downsample
+
+    def forward(self, x):
+        residual = x if self.downsample is None else self.downsample(x)
+        y = self.relu(self.bn1(self.conv1(x)))
+        y = self.bn2(self.conv2(y))
+        return autograd.relu(autograd.add(y, residual))
+
+
+class Bottleneck(layer.Layer):
+    expansion = 4
+
+    def __init__(self, planes, stride=1, downsample=None):
+        super().__init__()
+        self.conv1 = layer.Conv2d(planes, 1, bias=False)
+        self.bn1 = layer.BatchNorm2d()
+        self.conv2 = layer.Conv2d(planes, 3, stride=stride, padding=1,
+                                  bias=False)
+        self.bn2 = layer.BatchNorm2d()
+        self.conv3 = layer.Conv2d(planes * self.expansion, 1, bias=False)
+        self.bn3 = layer.BatchNorm2d()
+        self.relu = layer.ReLU()
+        self.downsample = downsample
+
+    def forward(self, x):
+        residual = x if self.downsample is None else self.downsample(x)
+        y = self.relu(self.bn1(self.conv1(x)))
+        y = self.relu(self.bn2(self.conv2(y)))
+        y = self.bn3(self.conv3(y))
+        return autograd.relu(autograd.add(y, residual))
+
+
+class _Downsample(layer.Layer):
+    def __init__(self, planes, stride):
+        super().__init__()
+        self.conv = layer.Conv2d(planes, 1, stride=stride, bias=False)
+        self.bn = layer.BatchNorm2d()
+
+    def forward(self, x):
+        return self.bn(self.conv(x))
+
+
+_CFG = {
+    18: (BasicBlock, [2, 2, 2, 2]),
+    34: (BasicBlock, [3, 4, 6, 3]),
+    50: (Bottleneck, [3, 4, 6, 3]),
+    101: (Bottleneck, [3, 4, 23, 3]),
+    152: (Bottleneck, [3, 8, 36, 3]),
+}
+
+
+class ResNet(model.Model):
+    def __init__(self, depth=50, num_classes=1000, num_channels=3):
+        super().__init__()
+        if depth not in _CFG:
+            raise ValueError(f"depth must be one of {sorted(_CFG)}")
+        block, layers_cfg = _CFG[depth]
+        self.num_classes = num_classes
+        self.input_size = 224
+        self.dimension = 4
+        self.conv1 = layer.Conv2d(64, 7, stride=2, padding=3, bias=False)
+        self.bn1 = layer.BatchNorm2d()
+        self.relu = layer.ReLU()
+        self.maxpool = layer.MaxPool2d(3, 2, padding=1)
+        self.inplanes = 64
+        self.layer1 = self._make_layer(block, 64, layers_cfg[0])
+        self.layer2 = self._make_layer(block, 128, layers_cfg[1], stride=2)
+        self.layer3 = self._make_layer(block, 256, layers_cfg[2], stride=2)
+        self.layer4 = self._make_layer(block, 512, layers_cfg[3], stride=2)
+        # Global average pool: identical to the reference's AvgPool2d(7,1)
+        # at 224x224, but shape-agnostic (CIFAR 32x32 works unchanged).
+        self.flatten = layer.Flatten()
+        self.fc = layer.Linear(num_classes)
+        self.dist_option = "plain"
+        self.spars = None
+
+    def _make_layer(self, block, planes, blocks, stride=1):
+        downsample = None
+        if stride != 1 or self.inplanes != planes * block.expansion:
+            downsample = _Downsample(planes * block.expansion, stride)
+        layers = [block(planes, stride, downsample)]
+        self.inplanes = planes * block.expansion
+        for _ in range(1, blocks):
+            layers.append(block(planes))
+        return layer.Sequential(*layers)
+
+    def forward(self, x):
+        y = self.maxpool(self.relu(self.bn1(self.conv1(x))))
+        y = self.layer4(self.layer3(self.layer2(self.layer1(y))))
+        y = self.flatten(autograd.GlobalAveragePool()(y))
+        return self.fc(y)
+
+    def train_one_batch(self, x, y):
+        out = self.forward(x)
+        loss = autograd.softmax_cross_entropy(out, y)
+        _dist_update(self, loss)
+        return out, loss
+
+
+def create_model(depth=50, **kwargs):
+    return ResNet(depth=depth, **kwargs)
+
+
+resnet18 = lambda **kw: ResNet(18, **kw)  # noqa: E731
+resnet34 = lambda **kw: ResNet(34, **kw)  # noqa: E731
+resnet50 = lambda **kw: ResNet(50, **kw)  # noqa: E731
+resnet101 = lambda **kw: ResNet(101, **kw)  # noqa: E731
+resnet152 = lambda **kw: ResNet(152, **kw)  # noqa: E731

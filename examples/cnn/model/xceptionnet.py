@@ -1,0 +1,101 @@
+"""Xception. Reference: `examples/cnn/model/xceptionnet.py` (separable
+convs with residual skips)."""
+from singa_tpu import autograd, layer, model
+
+from cnn import _dist_update
+
+
+class Block(layer.Layer):
+    def __init__(self, out_filters, reps, strides=1,
+                 start_with_relu=True, grow_first=True):
+        super().__init__()
+        self.start_with_relu = start_with_relu
+        self.grow_first = grow_first
+        self.reps = reps
+        self.strides = strides
+        self.out_filters = out_filters
+        self.relu = layer.ReLU()
+        convs = []
+        for i in range(reps):
+            convs.append(layer.SeparableConv2d(out_filters, 3, padding=1))
+            convs.append(layer.BatchNorm2d())
+        for i, l in enumerate(convs):
+            setattr(self, f"c{i}", l)
+        self._convs = convs
+        if strides != 1:
+            self.pool = layer.MaxPool2d(3, strides, padding=1)
+        self.skip = None
+
+    def initialize(self, x):
+        in_filters = x.shape[1]
+        if self.out_filters != in_filters or self.strides != 1:
+            self.skip = layer.Conv2d(self.out_filters, 1,
+                                     stride=self.strides, bias=False)
+            self.skipbn = layer.BatchNorm2d()
+
+    def forward(self, x):
+        if self.skip is not None:
+            residual = self.skipbn(self.skip(x))
+        else:
+            residual = x
+        y = x
+        for i in range(self.reps):
+            if i > 0 or self.start_with_relu:
+                y = self.relu(y)
+            y = self._convs[2 * i](y)       # separable conv
+            y = self._convs[2 * i + 1](y)   # bn
+        if self.strides != 1:
+            y = self.pool(y)
+        return autograd.add(y, residual)
+
+
+class Xception(model.Model):
+    """Entry + middle (8 blocks) + exit flow."""
+
+    def __init__(self, num_classes=1000, num_channels=3):
+        super().__init__()
+        self.num_classes = num_classes
+        self.input_size = 299
+        self.dimension = 4
+        self.conv1 = layer.Conv2d(32, 3, stride=2, bias=False)
+        self.bn1 = layer.BatchNorm2d()
+        self.conv2 = layer.Conv2d(64, 3, bias=False)
+        self.bn2 = layer.BatchNorm2d()
+        self.relu = layer.ReLU()
+        self.block1 = Block(128, 2, 2, start_with_relu=False)
+        self.block2 = Block(256, 2, 2)
+        self.block3 = Block(728, 2, 2)
+        for i in range(4, 12):
+            setattr(self, f"block{i}", Block(728, 3, 1))
+        self.block12 = Block(1024, 2, 2, grow_first=False)
+        self.conv3 = layer.SeparableConv2d(1536, 3, padding=1)
+        self.bn3 = layer.BatchNorm2d()
+        self.conv4 = layer.SeparableConv2d(2048, 3, padding=1)
+        self.bn4 = layer.BatchNorm2d()
+        self.globalpool = layer.AvgPool2d(10, 1)
+        self.flatten = layer.Flatten()
+        self.fc = layer.Linear(num_classes)
+        self.dist_option = "plain"
+        self.spars = None
+
+    def forward(self, x):
+        y = self.relu(self.bn1(self.conv1(x)))
+        y = self.relu(self.bn2(self.conv2(y)))
+        y = self.block3(self.block2(self.block1(y)))
+        for i in range(4, 12):
+            y = getattr(self, f"block{i}")(y)
+        y = self.block12(y)
+        y = self.relu(self.bn3(self.conv3(y)))
+        y = self.relu(self.bn4(self.conv4(y)))
+        y = self.flatten(self.globalpool(y))
+        return self.fc(y)
+
+    def train_one_batch(self, x, y):
+        out = self.forward(x)
+        loss = autograd.softmax_cross_entropy(out, y)
+        _dist_update(self, loss)
+        return out, loss
+
+
+def create_model(**kwargs):
+    return Xception(**kwargs)

@@ -88,17 +88,27 @@ class Communicator:
                  devices: Optional[Sequence] = None):
         from ..device import _accel_devices
 
-        if nccl_id is not None and jax.process_count() == 1:
+        if nccl_id is not None:
             # Reference: the multiprocess ctor uses the shared
             # ncclUniqueId to join the clique. Here the token carries
             # the PJRT coordinator address; process id/count come from
             # the launcher env (hanging on a missing coordinator is
-            # worse than running single-host, so require both).
+            # worse than running single-host, so require both). NB:
+            # jax.distributed.initialize must run before anything that
+            # initializes the XLA backend — even jax.process_count()
+            # counts — so probe the distributed state directly.
             n = os.environ.get("SINGA_TPU_NUM_PROCS")
             pid = os.environ.get("SINGA_TPU_PROC_ID")
             if n is not None and pid is not None:
-                init_distributed(nccl_id.coordinator_address,
-                                 num_processes=int(n), process_id=int(pid))
+                try:
+                    from jax._src.distributed import global_state
+                    already = global_state.client is not None
+                except Exception:
+                    already = False
+                if not already:
+                    init_distributed(nccl_id.coordinator_address,
+                                     num_processes=int(n),
+                                     process_id=int(pid))
 
         devs = list(devices) if devices is not None else _accel_devices()
         if world_size is None:

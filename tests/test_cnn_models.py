@@ -1,0 +1,74 @@
+"""Model-zoo smoke tests (tiny shapes, CPU mesh).
+
+Reference: `examples/cnn` models are the acceptance workloads
+(SURVEY.md §2.3); these check construction, forward shapes, and that a
+train step decreases loss on a memorizable batch.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+_CNN = os.path.join(os.path.dirname(__file__), "..", "examples", "cnn")
+sys.path.insert(0, os.path.join(_CNN, "model"))
+sys.path.insert(0, os.path.join(_CNN, "data"))
+
+from singa_tpu import opt, tensor  # noqa: E402
+
+
+def test_cnn_trains_mnist_shapes():
+    import cnn
+
+    m = cnn.create_model(num_classes=10)
+    m.set_optimizer(opt.SGD(lr=0.003))
+    rs = np.random.RandomState(0)
+    x = tensor.from_numpy(rs.randn(4, 1, 28, 28).astype(np.float32))
+    y = tensor.from_numpy(rs.randint(0, 10, 4).astype(np.int32))
+    m.compile([x], is_train=True, use_graph=False)
+    losses = []
+    for _ in range(5):
+        out, loss = m(x, y)
+        losses.append(float(loss.to_numpy()))
+    assert out.shape == (4, 10)
+    assert losses[-1] < losses[0]
+
+
+@pytest.mark.parametrize("depth", [18, 50])
+def test_resnet_forward_shapes(depth):
+    import resnet
+
+    m = resnet.create_model(depth=depth, num_classes=7)
+    m.eval()
+    x = tensor.from_numpy(
+        np.random.RandomState(1).randn(2, 3, 32, 32).astype(np.float32))
+    out = m(x)
+    assert out.shape == (2, 7)
+    assert np.isfinite(out.to_numpy()).all()
+
+
+def test_resnet_train_step_graph_mode():
+    import resnet
+
+    m = resnet.create_model(depth=18, num_classes=5)
+    m.set_optimizer(opt.SGD(lr=0.01, momentum=0.9))
+    rs = np.random.RandomState(2)
+    x = tensor.from_numpy(rs.randn(2, 3, 32, 32).astype(np.float32))
+    y = tensor.from_numpy(rs.randint(0, 5, 2).astype(np.int32))
+    m.compile([x], is_train=True, use_graph=True)
+    _, l0 = m(x, y)
+    _, l1 = m(x, y)
+    _, l2 = m(x, y)
+    assert float(l2.to_numpy()) < float(l0.to_numpy())
+
+
+def test_data_loaders_synthetic():
+    import cifar10
+    import mnist
+
+    tx, ty, vx, vy = mnist.load(None)
+    assert tx.shape[1:] == (1, 28, 28) and tx.dtype == np.float32
+    assert ty.dtype == np.int32
+    tx, ty, vx, vy = cifar10.load(None)
+    assert tx.shape[1:] == (3, 32, 32)
+    assert int(ty.max()) <= 9
