@@ -47,9 +47,17 @@ def load(data_dir=None):
     if data_dir:
         ims = _find(data_dir, "train-images-idx3-ubyte")
         if ims:
+            def need(stem):
+                p = _find(data_dir, stem)
+                if p is None:
+                    raise FileNotFoundError(
+                        f"{data_dir} has train-images but is missing "
+                        f"{stem}[.gz] — incomplete MNIST download")
+                return p
+
             tx = _read_idx(ims).astype(np.float32)[:, None] / 255.0
-            ty = _read_idx(_find(data_dir, "train-labels-idx1-ubyte")).astype(np.int32)
-            vx = _read_idx(_find(data_dir, "t10k-images-idx3-ubyte")).astype(np.float32)[:, None] / 255.0
-            vy = _read_idx(_find(data_dir, "t10k-labels-idx1-ubyte")).astype(np.int32)
+            ty = _read_idx(need("train-labels-idx1-ubyte")).astype(np.int32)
+            vx = _read_idx(need("t10k-images-idx3-ubyte")).astype(np.float32)[:, None] / 255.0
+            vy = _read_idx(need("t10k-labels-idx1-ubyte")).astype(np.int32)
             return tx, ty, vx, vy
     return synthetic()

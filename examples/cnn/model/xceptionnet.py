@@ -28,6 +28,11 @@ class Block(layer.Layer):
 
     def initialize(self, x):
         in_filters = x.shape[1]
+        if not self.grow_first:
+            # Reference semantics: keep the input width through the
+            # first reps-1 convs and grow to out_filters on the last.
+            for i in range(self.reps - 1):
+                self._convs[2 * i].nb_kernels = in_filters
         if self.out_filters != in_filters or self.strides != 1:
             self.skip = layer.Conv2d(self.out_filters, 1,
                                      stride=self.strides, bias=False)

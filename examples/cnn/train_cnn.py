@@ -91,8 +91,13 @@ def run(args):
             seen += bs
         dt = time.time() - t0
         m.eval()
-        vx = tensor.from_numpy(vx_np[:bs], device=dev)
-        acc = accuracy(m(vx).to_numpy(), vy_np[:bs])
+        correct, n_val = 0.0, (len(vx_np) // bs) * bs
+        for b in range(len(vx_np) // bs):
+            vx = tensor.from_numpy(
+                np.ascontiguousarray(vx_np[b * bs:(b + 1) * bs]), device=dev)
+            correct += accuracy(m(vx).to_numpy(),
+                                vy_np[b * bs:(b + 1) * bs]) * bs
+        acc = correct / max(n_val, 1)
         print(f"epoch {epoch}: loss {tot_loss / nbatch:.4f} "
               f"val-acc {acc:.3f}  {seen / dt:.1f} img/s")
     return tot_loss / nbatch

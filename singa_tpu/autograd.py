@@ -902,6 +902,60 @@ class BinaryCrossEntropy(Operator):
         ) / n
 
 
+class LayerNorm(Operator):
+    """Layer normalization over the last dim (no reference equivalent —
+    SINGA predates transformer-era layers; required for the transformer
+    flagship and ONNX LayerNormalization)."""
+
+    def __init__(self, eps: float = 1e-5):
+        super().__init__()
+        self.eps = eps
+
+    def fn(self, x, g, b):
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        return (x - mu) / jnp.sqrt(var + self.eps) * g + b
+
+
+class Attention(Operator):
+    """Scaled-dot-product attention over [B, H, S, D] (no reference
+    equivalent). With a mesh whose "seq" axis is >1, runs as ring
+    attention — exact attention with the sequence sharded across chips,
+    k/v blocks streamed by `lax.ppermute` over ICI
+    (parallel/ring_attention.py); otherwise one fused XLA softmax-matmul.
+    Backward comes from `jax.vjp` through the shard_map scan."""
+
+    def __init__(self, causal: bool = True, scale=None, mesh=None,
+                 axis_name: str = "seq"):
+        super().__init__()
+        self.causal = causal
+        self.scale = scale
+        self.mesh = mesh
+        self.axis_name = axis_name
+
+    def forward(self, *xs):
+        # Ring attention needs mesh-placed operands, so it only engages
+        # inside a traced (jit mesh-mode) step; the eager path — the
+        # compile-time lazy-init forward, eval on one chip — runs the
+        # identical math as one fused local attention. Checked here
+        # (not in fn) because jax.vjp wraps fn's inputs in tracers
+        # regardless of mode.
+        self._use_ring = self.mesh is not None and any(
+            isinstance(x, jax.core.Tracer) for x in xs
+        )
+        return super().forward(*xs)
+
+    def fn(self, q, k, v):
+        from .parallel.ring_attention import plain_attention, ring_attention
+
+        if self._use_ring:
+            return ring_attention(q, k, v, self.mesh, causal=self.causal,
+                                  scale=self.scale,
+                                  axis_name=self.axis_name)
+        return plain_attention(q, k, v, causal=self.causal,
+                               scale=self.scale)
+
+
 # ---- stateful-ish NN ops --------------------------------------------------
 class Dropout(Operator):
     """Reference: `autograd.Dropout(ratio)` — mask cached for backward;
@@ -1126,6 +1180,14 @@ def pooling_2d(handle, x):
 def rnn_op(handle, x, hx, cx, w, rng_key=None):
     """Reference: `autograd.CudnnRNN` call path. Returns (y, hy, cy)."""
     return _RNN(handle, rng_key)(x, hx, cx, w)
+
+
+def layer_norm(x, g, b, eps=1e-5):
+    return LayerNorm(eps)(x, g, b)
+
+
+def attention(q, k, v, causal=True, scale=None, mesh=None, axis_name="seq"):
+    return Attention(causal, scale, mesh, axis_name)(q, k, v)
 
 
 def gather(x, indices, axis=0):

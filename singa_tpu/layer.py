@@ -397,6 +397,73 @@ class Embedding(Layer):
         return autograd.embedding(self.W, x)
 
 
+class LayerNorm(Layer):
+    """LayerNorm over the trailing dim; params gamma/beta (lazy)."""
+
+    def __init__(self, eps: float = 1e-5, name=None):
+        super().__init__(name)
+        self.eps = eps
+
+    def initialize(self, x: Tensor):
+        d = x.shape[-1]
+        g = Tensor((d,), device=x.device)
+        b = Tensor((d,), device=x.device)
+        initializer.constant(g, 1.0)
+        initializer.constant(b, 0.0)
+        self.register_param("gamma", g)
+        self.register_param("beta", b)
+
+    def forward(self, x: Tensor):
+        return autograd.layer_norm(x, self.gamma, self.beta, self.eps)
+
+
+class MultiHeadAttention(Layer):
+    """Multi-head self-attention (no reference equivalent — SINGA's
+    attention models arrive only via ONNX import). TPU-first: per-head
+    projections stay one fused GEMM on the MXU; with `mesh` carrying a
+    "seq" axis the score/softmax/value core runs as ring attention
+    (sequence parallelism), and the q/k/v/o projections pick up tensor
+    parallelism from the param sharding rules ("model" axis)."""
+
+    def __init__(self, num_heads: int, causal: bool = True, mesh=None,
+                 dropout: float = 0.0, name=None):
+        super().__init__(name)
+        self.num_heads = num_heads
+        self.causal = causal
+        self.mesh = mesh
+        self.q_proj = Linear(0)  # lazy: sized to d_model on first call
+        self.k_proj = Linear(0)
+        self.v_proj = Linear(0)
+        self.o_proj = Linear(0)
+        self.drop = Dropout(dropout) if dropout else None
+
+    def initialize(self, x: Tensor):
+        d_model = x.shape[-1]
+        if d_model % self.num_heads:
+            raise ValueError(
+                f"d_model {d_model} not divisible by heads {self.num_heads}")
+        for proj in (self.q_proj, self.k_proj, self.v_proj, self.o_proj):
+            proj.num_output = d_model
+
+    def forward(self, x: Tensor):
+        B, S, E = x.shape
+        H = self.num_heads
+        D = E // H
+
+        def split(t):  # [B,S,E] -> [B,H,S,D]
+            t = autograd.reshape(t, (B, S, H, D))
+            return autograd.transpose(t, (0, 2, 1, 3))
+
+        q = split(self.q_proj(x))
+        k = split(self.k_proj(x))
+        v = split(self.v_proj(x))
+        o = autograd.attention(q, k, v, causal=self.causal, mesh=self.mesh)
+        o = autograd.transpose(o, (0, 2, 1, 3))
+        o = autograd.reshape(o, (B, S, E))
+        o = self.o_proj(o)
+        return self.drop(o) if self.drop is not None else o
+
+
 class Sequential(Layer):
     """Convenience container (reference builds these ad hoc)."""
 

@@ -43,6 +43,7 @@ class Model(Layer):
         self._optimizer = None
         self._jit_step = None
         self._use_graph = False
+        self._mesh = self._rules = self._batch_specs = None
         self.training = True
 
     # -- configuration -----------------------------------------------------
@@ -54,12 +55,20 @@ class Model(Layer):
         return self._optimizer
 
     def compile(self, inputs: List[Tensor], is_train: bool = True,
-                use_graph: bool = False, sequential: bool = False):
+                use_graph: bool = False, sequential: bool = False,
+                mesh=None, rules=None, batch_specs=None):
         """Reference: `Model.compile` — one tracing pass to initialize
         params (lazy shape inference), then optionally arm graph mode.
 
         `sequential` is accepted for API parity (the reference uses it
         to serialize graph exec; XLA owns scheduling here).
+
+        Mesh mode (TPU-native, no reference equivalent): passing a
+        `jax.sharding.Mesh` turns the compiled step into one SPMD
+        program over the mesh — params laid out by `rules`
+        (`parallel.ShardingRules`), batch dims sharded over the "data"
+        axis (`batch_specs` overrides per-input), gradients reduced by
+        XLA over ICI. This subsumes DistOpt: same math, one program.
         """
         self.train(is_train)
         dev = inputs[0].device if inputs else None
@@ -67,7 +76,8 @@ class Model(Layer):
             dev.EnableGraph(use_graph)
         # One real forward initializes all lazy params.
         self.forward(*inputs)
-        self._use_graph = use_graph
+        self._use_graph = use_graph or mesh is not None
+        self._mesh, self._rules, self._batch_specs = mesh, rules, batch_specs
         self._jit_step = None  # (re)built lazily on first train_one_batch
         if dev is not None:
             dev.EnableGraph(False)
@@ -119,7 +129,14 @@ class Model(Layer):
         replay with donated buffers.
         """
         if self._jit_step is None:
-            self._jit_step = _JitStep(self)
+            if getattr(self, "_mesh", None) is not None:
+                from .parallel.trainer import ShardedJitStep
+
+                self._jit_step = ShardedJitStep(
+                    self, self._mesh, rules=self._rules,
+                    batch_specs=self._batch_specs)
+            else:
+                self._jit_step = _JitStep(self)
         return self._jit_step(*batch)
 
     def train_one_batch_dispatch(self, *batch: Tensor):
@@ -275,7 +292,24 @@ class _JitStep:
         # opt state) is stable from step one. step_counter is traced
         # (not static) so LR schedules don't retrigger compilation.
         self._ensure_opt_slots()
-        return jax.jit(step_fn, donate_argnums=(0, 1, 2, 3))
+        return jax.jit(step_fn, donate_argnums=(0, 1, 2, 3),
+                       **self._jit_kwargs(batch_arrays))
+
+    def _jit_kwargs(self, batch_arrays):
+        """Hook for sharded subclasses (parallel.trainer.ShardedJitStep)
+        to add in/out shardings over a mesh."""
+        return {}
+
+    def _prepare_inputs(self, pvals, svals, ovals, key, batch_arrays):
+        """Hook: place program inputs (sharded subclasses device_put
+        onto the mesh; identity on one device)."""
+        return pvals, svals, ovals, key, batch_arrays
+
+    def _restore_key(self, new_key, dev):
+        """Hook: the updated RNG key's placement. Sharded subclasses
+        bring it back to the device's own placement so later eager code
+        (fresh param init, dropout outside jit) stays single-device."""
+        return new_key
 
     def _ensure_opt_slots(self):
         """Create optimizer state slots with zero arrays so the jit
@@ -316,15 +350,18 @@ class _JitStep:
         svals = [s.data for s in self.states]
         ovals = self._opt_arrays()
         step = 0 if opt is None else opt.step_counter
+        pvals, svals, ovals, key, batch_arrays = self._prepare_inputs(
+            pvals, svals, ovals, dev._rng_key, batch_arrays
+        )
         out, new_p, new_s, new_o, new_key = self._compiled(
-            pvals, svals, ovals, dev._rng_key, step, batch_arrays
+            pvals, svals, ovals, key, step, batch_arrays
         )
         for p, v in zip(self.params, new_p):
             p.data = v
         for s, v in zip(self.states, new_s):
             s.data = v
         self._bind_opt_arrays(new_o)
-        dev._rng_key = new_key
+        dev._rng_key = self._restore_key(new_key, dev)
         if opt is not None:
             opt.step_counter = step + 1
         return jax.tree_util.tree_map(

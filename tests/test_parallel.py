@@ -1,0 +1,236 @@
+"""Multi-chip parallelism tests on the 8-virtual-device CPU mesh.
+
+The reference cannot CI-test its distributed path (NCCL needs real
+GPUs; SURVEY.md §4.3) — here DP/TP/SP all run under XLA's CPU backend,
+so collective correctness is a unit test.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from singa_tpu import autograd, layer, model, opt, tensor
+from singa_tpu.parallel import (
+    ShardingRules,
+    auto_mesh,
+    create_mesh,
+    default_balanced_mesh,
+    plain_attention,
+    ring_attention,
+)
+
+
+# ---------------------------------------------------------------------------
+# mesh construction
+# ---------------------------------------------------------------------------
+class TestMesh:
+    def test_create_axes(self):
+        mesh = create_mesh({"data": 2, "seq": 4})
+        assert mesh.shape == {"data": 2, "seq": 4}
+
+    def test_canonical_axis_order(self):
+        mesh = create_mesh({"seq": 2, "data": 4})
+        assert mesh.axis_names == ("data", "seq")
+
+    def test_size_mismatch(self):
+        with pytest.raises(ValueError):
+            create_mesh({"data": 3})
+
+    def test_auto_mesh_infers_data(self):
+        mesh = auto_mesh(8, model=2, seq=2)
+        assert mesh.shape == {"data": 2, "model": 2, "seq": 2}
+
+    def test_balanced(self):
+        mesh = default_balanced_mesh(8)
+        assert mesh.shape == {"data": 2, "model": 2, "seq": 2}
+        assert default_balanced_mesh(1).shape == {"data": 1}
+
+
+# ---------------------------------------------------------------------------
+# sharding rules
+# ---------------------------------------------------------------------------
+class TestShardingRules:
+    def test_linear_weight_sharded_on_model(self):
+        mesh = create_mesh({"data": 4, "model": 2})
+        sh = ShardingRules().sharding_for(mesh, "fc1.W", (32, 64))
+        assert sh.spec == P(None, "model")
+
+    def test_indivisible_dim_falls_back(self):
+        mesh = create_mesh({"data": 4, "model": 2})
+        sh = ShardingRules().sharding_for(mesh, "fc1.W", (32, 63))
+        assert sh.spec == P()
+
+    def test_missing_axis_falls_back(self):
+        mesh = create_mesh({"data": 8})
+        sh = ShardingRules().sharding_for(mesh, "fc1.W", (32, 64))
+        assert sh.spec == P()
+
+    def test_conv_kernel_rule(self):
+        mesh = create_mesh({"data": 4, "model": 2})
+        sh = ShardingRules().sharding_for(mesh, "conv1.W", (64, 3, 3, 3))
+        assert sh.spec == P("model")
+
+    def test_bias_replicated(self):
+        mesh = create_mesh({"data": 4, "model": 2})
+        sh = ShardingRules().sharding_for(mesh, "fc1.b", (64,))
+        assert sh.spec == P()
+
+
+# ---------------------------------------------------------------------------
+# ring attention
+# ---------------------------------------------------------------------------
+class TestRingAttention:
+    @pytest.fixture(scope="class")
+    def qkv(self):
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        shape = (2, 4, 32, 16)
+        return tuple(jax.random.normal(k, shape) for k in ks)
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_plain(self, qkv, causal):
+        q, k, v = qkv
+        mesh = create_mesh({"data": 2, "seq": 4})
+        out = ring_attention(q, k, v, mesh, causal=causal)
+        ref = plain_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+
+    def test_grads_match_plain(self, qkv):
+        q, k, v = qkv
+        mesh = create_mesh({"data": 2, "seq": 4})
+
+        def loss_ring(q, k, v):
+            return (ring_attention(q, k, v, mesh) * 0.01).sum()
+
+        def loss_plain(q, k, v):
+            return (plain_attention(q, k, v) * 0.01).sum()
+
+        gr = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+        gp = jax.grad(loss_plain, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gr, gp):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-6)
+
+    def test_no_seq_axis_falls_back(self, qkv):
+        q, k, v = qkv
+        mesh = create_mesh({"data": 8})
+        out = ring_attention(q, k, v, mesh)
+        ref = plain_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-6)
+
+    def test_head_sharded_mesh(self, qkv):
+        q, k, v = qkv
+        mesh = create_mesh({"model": 2, "seq": 4})
+        out = ring_attention(q, k, v, mesh)
+        ref = plain_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# mesh-mode training (DP / TP): one SPMD program == single-device math
+# ---------------------------------------------------------------------------
+class _MLP(model.Model):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = layer.Linear(64)
+        self.relu = layer.ReLU()
+        self.fc2 = layer.Linear(10)
+
+    def forward(self, x):
+        return self.fc2(self.relu(self.fc1(x)))
+
+    def train_one_batch(self, x, y):
+        out = self.forward(x)
+        loss = autograd.softmax_cross_entropy(out, y)
+        self._optimizer.backward_and_update(loss)
+        return out, loss
+
+
+def _train_mlp(mesh, steps=5):
+    np.random.seed(0)
+    X = np.random.randn(16, 32).astype(np.float32)
+    Y = np.random.randint(0, 10, (16,)).astype(np.int32)
+    m = _MLP()
+    m.set_optimizer(opt.SGD(lr=0.1, momentum=0.9))
+    tx, ty = tensor.from_numpy(X), tensor.from_numpy(Y)
+    m.compile([tx], is_train=True, use_graph=True, mesh=mesh)
+    rng = np.random.RandomState(42)
+    for _, p in sorted(m.get_params().items()):
+        p.data = jnp.asarray(
+            rng.randn(*p.data.shape).astype(np.float32) * 0.1)
+    return m, [float(m(tx, ty)[1].to_numpy()) for _ in range(steps)]
+
+
+class TestMeshModeTraining:
+    def test_dp_tp_matches_single_device(self):
+        _, single = _train_mlp(None)
+        _, meshed = _train_mlp(create_mesh({"data": 4, "model": 2}))
+        np.testing.assert_allclose(single, meshed, atol=1e-5)
+
+    def test_pure_dp_matches_single_device(self):
+        _, single = _train_mlp(None)
+        _, meshed = _train_mlp(create_mesh({"data": 8}))
+        np.testing.assert_allclose(single, meshed, atol=1e-5)
+
+    def test_params_actually_sharded(self):
+        mesh = create_mesh({"data": 4, "model": 2})
+        m, _ = _train_mlp(mesh)
+        w = m.get_params()["_MLP.fc1.W"].data
+        assert w.sharding.spec == P(None, "model")
+        # each device holds half the columns
+        shard, = {s.data.shape for s in w.addressable_shards}
+        assert shard == (32, 32)
+
+
+# ---------------------------------------------------------------------------
+# transformer: DP + TP + SP in one step
+# ---------------------------------------------------------------------------
+class TestTransformerParallel:
+    def test_dp_tp_sp_trains(self):
+        from singa_tpu.models.transformer import TransformerLM
+
+        mesh = create_mesh({"data": 2, "model": 2, "seq": 2})
+        np.random.seed(0)
+        B, S, V = 4, 16, 64
+        X = np.random.randint(0, V, (B, S)).astype(np.int32)
+        Y = np.random.randint(0, V, (B, S)).astype(np.int32)
+        m = TransformerLM(V, d_model=32, num_heads=4, num_layers=2,
+                          max_len=S, mesh=mesh)
+        m.set_optimizer(opt.Adam(lr=1e-2))
+        tx, ty = tensor.from_numpy(X), tensor.from_numpy(Y)
+        m.compile([tx], is_train=True, use_graph=True, mesh=mesh,
+                  batch_specs=[P("data", "seq"), P("data", "seq")])
+        losses = [float(m(tx, ty)[1].to_numpy()) for _ in range(6)]
+        assert losses[-1] < losses[0] * 0.6
+
+    def test_mesh_matches_single_device_loss(self):
+        from singa_tpu.models.transformer import TransformerLM
+
+        np.random.seed(0)
+        B, S, V = 4, 16, 32
+        X = np.random.randint(0, V, (B, S)).astype(np.int32)
+        Y = np.random.randint(0, V, (B, S)).astype(np.int32)
+
+        def run(mesh):
+            m = TransformerLM(V, d_model=32, num_heads=4, num_layers=1,
+                              max_len=S, mesh=mesh)
+            m.set_optimizer(opt.SGD(lr=0.1))
+            tx, ty = tensor.from_numpy(X), tensor.from_numpy(Y)
+            kwargs = {}
+            if mesh is not None:
+                kwargs = dict(
+                    mesh=mesh,
+                    batch_specs=[P("data", "seq"), P("data", "seq")])
+            m.compile([tx], is_train=True, use_graph=True, **kwargs)
+            rng = np.random.RandomState(7)
+            for _, p in sorted(m.get_params().items()):
+                p.data = jnp.asarray(
+                    rng.randn(*p.data.shape).astype(np.float32) * 0.05)
+            return [float(m(tx, ty)[1].to_numpy()) for _ in range(4)]
+
+        single = run(None)
+        meshed = run(create_mesh({"data": 2, "model": 2, "seq": 2}))
+        np.testing.assert_allclose(single, meshed, rtol=2e-4)
