@@ -1,0 +1,41 @@
+"""Headline benchmark: ResNet-50 synthetic-ImageNet throughput, one chip.
+
+Driver contract: print ONE JSON line
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The reference (mlinking/singa) publishes no in-tree numbers
+(BASELINE.md); its measurement tool is `examples/cnn/benchmark.py`
+(synthetic-data ResNet-50 images/sec). `vs_baseline` is therefore
+computed against an estimated V100 figure for SINGA-class frameworks
+(ResNet-50 fp32/amp, bs32, ~360 img/s) — the best available stand-in
+until a measured reference number exists.
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "examples", "cnn"))
+
+# Estimated reference throughput (see module docstring / BASELINE.md).
+REF_V100_IPS = 360.0
+
+
+def main():
+    from benchmark import run
+
+    batch = int(os.environ.get("BENCH_BATCH", "64"))
+    steps = int(os.environ.get("BENCH_STEPS", "16"))
+    ips = run(depth=50, batch_size=batch, steps=steps, warmup=4,
+              image_size=224, use_graph=True, precision="bf16",
+              verbose=False)
+    print(json.dumps({
+        "metric": "resnet50_images_per_sec_chip",
+        "value": round(ips, 2),
+        "unit": "img/s",
+        "vs_baseline": round(ips / REF_V100_IPS, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

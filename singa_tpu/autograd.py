@@ -1146,7 +1146,12 @@ def cat(xs, axis=0):
 
 
 def dropout(x, ratio=0.5):
-    return Dropout(ratio)(x)
+    # Key from the input's device (not the default device) so the mask
+    # is traced from the same RNG stream graph mode functionalizes.
+    key = None
+    if training and ratio > 0.0 and isinstance(x, Tensor):
+        key = x.device.next_key()
+    return Dropout(ratio, rng_key=key)(x)
 
 
 def reduce_sum(x, axes=None, keepdims=False):

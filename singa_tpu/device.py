@@ -67,7 +67,11 @@ class Device:
         self.id = getattr(jax_device, "id", 0)
         self.uid = Device._next_uid
         Device._next_uid += 1
-        self._rng_key = jax.random.PRNGKey(0)
+        # Commit the key to this device so every op that consumes it
+        # (and therefore every random fill) executes HERE — an
+        # uncommitted key would drag CPU-tensor RNG onto the default
+        # accelerator.
+        self._rng_key = jax.device_put(jax.random.PRNGKey(0), jax_device)
         # Graph-capture flag, consulted by Model.compile (reference:
         # Device::EnableGraph / graph_enabled_).
         self._graph_enabled = False
@@ -81,7 +85,8 @@ class Device:
     # ---- RNG ------------------------------------------------------------
     def SetRandSeed(self, seed: int) -> None:
         """Reference: `Device::SetRandSeed` (curand seed → threefry key)."""
-        self._rng_key = jax.random.PRNGKey(seed)
+        self._rng_key = jax.device_put(jax.random.PRNGKey(seed),
+                                       self.jax_device)
 
     set_rand_seed = SetRandSeed
 

@@ -309,13 +309,12 @@ class Dropout(Layer):
     def __init__(self, ratio: float = 0.5, name=None):
         super().__init__(name)
         self.ratio = ratio
-        self._device = None
-
-    def initialize(self, x: Tensor):
-        self._device = x.device
 
     def forward(self, x: Tensor):
-        key = self._device.next_key() if autograd.training else None
+        # Key comes from the *input's* device each call (never cached:
+        # params may migrate after a host-side init forward).
+        key = (x.device.next_key()
+               if autograd.training and self.ratio > 0.0 else None)
         return autograd.Dropout(self.ratio, rng_key=key)(x)
 
 

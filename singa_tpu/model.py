@@ -25,6 +25,7 @@ import io
 import json
 import os
 import zipfile
+from contextlib import contextmanager
 from typing import Dict, List, Optional
 
 import jax
@@ -42,6 +43,7 @@ class Model(Layer):
         super().__init__(name)
         self._optimizer = None
         self._jit_step = None
+        self._jit_fwd = None
         self._use_graph = False
         self._mesh = self._rules = self._batch_specs = None
         self.training = True
@@ -74,13 +76,49 @@ class Model(Layer):
         dev = inputs[0].device if inputs else None
         if dev is not None:
             dev.EnableGraph(use_graph)
-        # One real forward initializes all lazy params.
-        self.forward(*inputs)
+        # One real forward initializes all lazy params. On an
+        # accelerator device this would dispatch hundreds of one-op
+        # programs through PJRT (each separately compiled — minutes on
+        # a remote TPU); run it on the host XLA CPU backend instead and
+        # migrate the created params over. Threefry RNG is
+        # backend-deterministic, so init values are identical.
+        if (dev is not None and dev.lang != "cpp" and inputs
+                and not self.param_tensors()):
+            self._host_init_forward(inputs, dev)
+        else:
+            # Params already exist (a forward ran before compile) or
+            # inputs are host-side: run the tracing forward in place.
+            self.forward(*inputs)
         self._use_graph = use_graph or mesh is not None
         self._mesh, self._rules, self._batch_specs = mesh, rules, batch_specs
         self._jit_step = None  # (re)built lazily on first train_one_batch
+        self._jit_fwd = None
         if dev is not None:
             dev.EnableGraph(False)
+
+    def _host_init_forward(self, inputs, dev):
+        """Run the param-init forward on host CPU, borrowing `dev`'s RNG
+        stream so `dev.SetRandSeed(...)` still governs init values, then
+        move every created param/state onto `dev`."""
+        from .device import get_default_device
+
+        cpu = get_default_device()
+        saved_cpu_key = cpu._rng_key
+        cpu._rng_key = jax.device_put(dev._rng_key, cpu.jax_device)
+        try:
+            host_inputs = []
+            for t in inputs:
+                h = t.clone()
+                h.data = jax.device_put(np.asarray(t.to_numpy()),
+                                        cpu.jax_device)
+                h.device = cpu
+                host_inputs.append(h)
+            self.forward(*host_inputs)
+        finally:
+            dev._rng_key = jax.device_put(cpu._rng_key, dev.jax_device)
+            cpu._rng_key = saved_cpu_key
+        for t in self.param_tensors() + self.state_tensors():
+            t.to_device(dev)
 
     def train(self, mode: bool = True):
         self.training = mode
@@ -118,6 +156,8 @@ class Model(Layer):
         `forward` in eval mode."""
         if self.training and (self._optimizer is not None or len(args) > 1):
             return self.train_one_batch_dispatch(*args, **kwargs)
+        if self._use_graph and not kwargs:
+            return self.forward_graph(*args)
         return self.forward(*args, **kwargs)
 
     # -- graph (jit) execution --------------------------------------------
@@ -143,6 +183,14 @@ class Model(Layer):
         if self._use_graph:
             return self.train_one_batch_graph(*batch)
         return self.train_one_batch(*batch)
+
+    def forward_graph(self, *xs: Tensor):
+        """Run `forward` as one compiled XLA program (the eval-path
+        analogue of `train_one_batch_graph`; reference eval replays the
+        same buffered Graph)."""
+        if self._jit_fwd is None:
+            self._jit_fwd = _JitForward(self)
+        return self._jit_fwd(*xs)
 
     # -- checkpoint --------------------------------------------------------
     def save_states(self, fpath: str, aux_states: Optional[Dict] = None):
@@ -198,6 +246,7 @@ class Model(Layer):
                 if t is not None:
                     self._optimizer.states.setdefault(id(t), {})[slot] = jnp.asarray(arr)
         self._jit_step = None  # state changed: force retrace
+        self._jit_fwd = None
         return meta.get("aux", {})
 
 
@@ -209,6 +258,159 @@ def _jsonable(d):
         else:
             out[k] = float(v) if np.isscalar(v) else np.asarray(v).tolist()
     return out
+
+
+@contextmanager
+def _bound_model(params, states, dev, pvals, svals, key):
+    """Bind tracer/program values onto the live param/state tensors and
+    the device RNG key for the duration of a traced call, restoring the
+    concrete arrays afterwards. The shared functionalization core of
+    `_JitStep` and `_JitForward`."""
+    saved_p = [p.data for p in params]
+    saved_s = [s.data for s in states]
+    saved_key = dev._rng_key
+    try:
+        for p, v in zip(params, pvals):
+            p.data = v
+        for s, v in zip(states, svals):
+            s.data = v
+        dev._rng_key = key
+        yield
+    finally:
+        for p, v in zip(params, saved_p):
+            p.data = v
+        for s, v in zip(states, saved_s):
+            s.data = v
+        dev._rng_key = saved_key
+
+
+def _unwrap_out(out):
+    return jax.tree_util.tree_map(
+        lambda t: t.data if isinstance(t, Tensor) else t,
+        out,
+        is_leaf=lambda t: isinstance(t, Tensor),
+    )
+
+
+class _JitForward:
+    """Compiles `model.forward` into one XLA program (inference path).
+
+    Same functionalization trick as `_JitStep` (via `_bound_model`),
+    minus optimizer state and buffer donation (params are read-only
+    here). The device RNG key is threaded through so eval-time
+    stochastic ops stay reproducible. Layer-state updates made during a
+    training-mode forward (BN running stats) are captured as program
+    outputs and written back.
+
+    Compiled executables are cached per (training-flag, non-Tensor
+    args): the train/eval flag changes the traced program (dropout on /
+    off), and plain-Python positional args are baked in as statics, not
+    traced.
+
+    Mesh mode: when the model was compiled over a mesh, inputs are laid
+    out to match — params by the model's `ShardingRules`, states/key
+    replicated, batch dims sharded — so the sharded train path and this
+    eval path never mix incompatible device commitments.
+    """
+
+    def __init__(self, model: "Model"):
+        self.model = model
+        self.params: List[Tensor] = model.param_tensors()
+        self.states: List[Tensor] = model.state_tensors()
+        self._compiled: Dict = {}
+
+    def _device(self):
+        if self.params:
+            return self.params[0].device
+        from .device import get_default_device
+
+        return get_default_device()
+
+    def _build(self, tensor_pos, statics, nargs):
+        model, params, states = self.model, self.params, self.states
+
+        def fwd_fn(pvals, svals, key, batch):
+            dev = self._device()
+            with _bound_model(params, states, dev, pvals, svals, key):
+                args = [None] * nargs
+                for i, b in zip(tensor_pos, batch):
+                    args[i] = tensor_mod.from_raw(b, dev)
+                it = iter(statics)
+                for i in range(nargs):
+                    if args[i] is None:
+                        args[i] = next(it)
+                out_arrays = _unwrap_out(model.forward(*args))
+                new_s = [s.data for s in states]
+                return out_arrays, new_s, dev._rng_key
+
+        return jax.jit(fwd_fn)
+
+    def _place_inputs(self, pvals, svals, key, batch_arrays):
+        """Mesh-mode placement (single-device: identity)."""
+        mesh = getattr(self.model, "_mesh", None)
+        if mesh is None:
+            return pvals, svals, key, batch_arrays
+        from jax.sharding import NamedSharding
+
+        from .parallel.sharding import (
+            ShardingRules,
+            batch_sharding,
+            replicated,
+        )
+
+        rules = getattr(self.model, "_rules", None) or ShardingRules()
+        name_of = {id(t): n for n, t in self.model.get_params().items()}
+        pvals = [
+            jax.device_put(
+                v, rules.sharding_for(mesh, name_of.get(id(p), ""),
+                                      p.data.shape))
+            for p, v in zip(self.params, pvals)
+        ]
+        rep = replicated(mesh)
+        svals = [jax.device_put(v, rep) for v in svals]
+        key = jax.device_put(key, rep)
+        specs = getattr(self.model, "_batch_specs", None)
+        if specs is not None:
+            shs = [NamedSharding(mesh, s) for s in specs]
+        else:
+            shs = [batch_sharding(mesh, getattr(b, "ndim", 0))
+                   for b in batch_arrays]
+        batch_arrays = tuple(
+            jax.device_put(b, s) for b, s in zip(batch_arrays, shs)
+        )
+        return pvals, svals, key, batch_arrays
+
+    def __call__(self, *xs):
+        tensor_pos = tuple(i for i, x in enumerate(xs)
+                           if isinstance(x, Tensor))
+        statics = tuple(x for x in xs if not isinstance(x, Tensor))
+        batch_arrays = tuple(xs[i].data for i in tensor_pos)
+        try:
+            cache_key = (self.model.training, tensor_pos, statics)
+            fn = self._compiled.get(cache_key)
+        except TypeError:  # unhashable static arg: compile fresh
+            cache_key, fn = None, None
+        if fn is None:
+            fn = self._build(tensor_pos, statics, len(xs))
+            if cache_key is not None:
+                self._compiled[cache_key] = fn
+        dev = self._device()
+        pvals, svals, key, batch_arrays = self._place_inputs(
+            [p.data for p in self.params],
+            [s.data for s in self.states],
+            dev._rng_key, batch_arrays,
+        )
+        out, new_s, new_key = fn(pvals, svals, key, batch_arrays)
+        if self.model.training:
+            for s, v in zip(self.states, new_s):
+                s.data = v
+        # Pin the advanced key back onto the device's own placement so
+        # later eager code stays single-device even when params are
+        # mesh-sharded (cf. _JitStep._restore_key).
+        dev._rng_key = jax.device_put(new_key, dev.jax_device)
+        return jax.tree_util.tree_map(
+            lambda a: tensor_mod.from_raw(a, dev), out
+        )
 
 
 class _JitStep:
@@ -251,42 +453,25 @@ class _JitStep:
         params, states = self.params, self.states
 
         def step_fn(pvals, svals, ovals, key, step_counter, batch):
-            saved_p = [p.data for p in params]
-            saved_s = [s.data for s in states]
             saved_o = self._opt_arrays()
             dev = self._device()
-            saved_key = dev._rng_key
             saved_step = None if opt is None else opt.step_counter
-            try:
-                for p, v in zip(params, pvals):
-                    p.data = v
-                for s, v in zip(states, svals):
-                    s.data = v
-                self._bind_opt_arrays(ovals)
-                dev._rng_key = key
-                if opt is not None:
-                    opt.step_counter = step_counter
-                batch_t = [tensor_mod.from_raw(b, self._device()) for b in batch]
-                out = model.train_one_batch(*batch_t)
-                out_arrays = jax.tree_util.tree_map(
-                    lambda t: t.data if isinstance(t, Tensor) else t,
-                    out,
-                    is_leaf=lambda t: isinstance(t, Tensor),
-                )
-                new_p = [p.data for p in params]
-                new_s = [s.data for s in states]
-                new_o = self._opt_arrays()
-                new_key = dev._rng_key
-                return out_arrays, new_p, new_s, new_o, new_key
-            finally:
-                for p, v in zip(params, saved_p):
-                    p.data = v
-                for s, v in zip(states, saved_s):
-                    s.data = v
-                self._bind_opt_arrays(saved_o)
-                dev._rng_key = saved_key
-                if opt is not None and saved_step is not None:
-                    opt.step_counter = saved_step
+            with _bound_model(params, states, dev, pvals, svals, key):
+                try:
+                    self._bind_opt_arrays(ovals)
+                    if opt is not None:
+                        opt.step_counter = step_counter
+                    batch_t = [tensor_mod.from_raw(b, dev) for b in batch]
+                    out_arrays = _unwrap_out(model.train_one_batch(*batch_t))
+                    new_p = [p.data for p in params]
+                    new_s = [s.data for s in states]
+                    new_o = self._opt_arrays()
+                    new_key = dev._rng_key
+                    return out_arrays, new_p, new_s, new_o, new_key
+                finally:
+                    self._bind_opt_arrays(saved_o)
+                    if opt is not None and saved_step is not None:
+                        opt.step_counter = saved_step
 
         # Pre-create optimizer slots so the jit signature (flattened
         # opt state) is stable from step one. step_counter is traced

@@ -47,7 +47,6 @@ class _RNNBase(Layer):
         w = Tensor((self.handle.weights_size,), device=x.device)
         w.data = self.handle.init_weights(x.device.next_key())
         self.register_param("W", w)
-        self._device = x.device
 
     def _zero_state(self, batch: int, like: Tensor) -> Tensor:
         t = Tensor(self.handle.state_shape(batch), device=like.device)
@@ -63,7 +62,7 @@ class _RNNBase(Layer):
             hx = self._zero_state(batch, x)
         if cx is None:
             cx = self._zero_state(batch, x)
-        key = (self._device.next_key()
+        key = (x.device.next_key()
                if autograd.training and self.handle.dropout > 0 else None)
         y, hy, cy = autograd.rnn_op(self.handle, x, hx, cx, self.W,
                                     rng_key=key)

@@ -1,0 +1,219 @@
+"""Aux subsystems: loss, metric, snapshot, data iterators, and the
+compiled eval-forward path (reference: test/python/{test_loss,
+test_metric,test_snapshot}.py-style coverage, SURVEY.md §4.2)."""
+import os
+
+import numpy as np
+import pytest
+
+from singa_tpu import (
+    autograd,
+    data,
+    layer,
+    loss,
+    metric,
+    model,
+    opt,
+    snapshot,
+    tensor,
+)
+
+
+class TestLoss:
+    def test_softmax_cross_entropy_matches_autograd(self):
+        np.random.seed(0)
+        x = tensor.from_numpy(np.random.randn(8, 5).astype(np.float32))
+        t = tensor.from_numpy(np.random.randint(0, 5, (8,)).astype(np.int32))
+        l = loss.SoftmaxCrossEntropy()
+        v = l.forward(x, t)
+        ref = autograd.softmax_cross_entropy(x, t)
+        np.testing.assert_allclose(v.to_numpy(), ref.to_numpy(), rtol=1e-6)
+
+    def test_backward_returns_input_grad(self):
+        np.random.seed(1)
+        x = tensor.from_numpy(np.random.randn(4, 3).astype(np.float32))
+        t = tensor.from_numpy(np.array([0, 1, 2, 0], np.int32))
+        l = loss.SoftmaxCrossEntropy()
+        l.forward(x, t)
+        g = l.backward()
+        assert g.shape == x.shape
+        # CE grad: (softmax - onehot)/batch
+        p = np.exp(x.to_numpy() - x.to_numpy().max(1, keepdims=True))
+        p /= p.sum(1, keepdims=True)
+        onehot = np.eye(3)[t.to_numpy()]
+        np.testing.assert_allclose(g.to_numpy(), (p - onehot) / 4,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_squared_error(self):
+        x = tensor.from_numpy(np.array([[1.0, 2.0]], np.float32))
+        t = tensor.from_numpy(np.array([[0.0, 0.0]], np.float32))
+        v = loss.SquaredError().forward(x, t)
+        np.testing.assert_allclose(v.to_numpy(), 0.5 * np.mean([1.0, 4.0]),
+                                   rtol=1e-6)
+
+
+class TestMetric:
+    def test_accuracy_top1(self):
+        logits = np.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]], np.float32)
+        labels = np.array([1, 0, 0], np.int32)
+        acc = metric.Accuracy()
+        assert acc.evaluate(logits, labels) == pytest.approx(2 / 3)
+
+    def test_accuracy_topk(self):
+        logits = np.array([[0.5, 0.3, 0.2], [0.1, 0.2, 0.7]], np.float32)
+        labels = np.array([1, 0], np.int32)
+        assert metric.Accuracy(top_k=2).evaluate(logits, labels) == \
+            pytest.approx(0.5)
+
+    def test_precision_recall(self):
+        pred = np.array([0.9, 0.8, 0.2, 0.1], np.float32)
+        true = np.array([1, 0, 1, 0], np.float32)
+        assert metric.Precision().evaluate(pred, true) == pytest.approx(0.5)
+        assert metric.Recall().evaluate(pred, true) == pytest.approx(0.5)
+
+
+class TestSnapshot:
+    def test_roundtrip(self, tmp_path):
+        f = str(tmp_path / "ckpt")
+        w = tensor.from_numpy(np.arange(6, dtype=np.float32).reshape(2, 3))
+        b = tensor.from_numpy(np.ones(3, np.float32))
+        snapshot.save(f, {"w": w, "b": b})
+        assert os.path.exists(f + ".model")
+        back = snapshot.load(f)
+        np.testing.assert_array_equal(back["w"].to_numpy(), w.to_numpy())
+        np.testing.assert_array_equal(back["b"].to_numpy(), b.to_numpy())
+
+    def test_mode_guards(self, tmp_path):
+        f = str(tmp_path / "x")
+        with snapshot.Snapshot(f, True) as s:
+            s.write("a", tensor.from_numpy(np.zeros(2, np.float32)))
+        r = snapshot.Snapshot(f, False)
+        with pytest.raises(RuntimeError):
+            r.write("b", tensor.from_numpy(np.zeros(2, np.float32)))
+
+
+class TestData:
+    def test_minibatches_cover_epoch(self):
+        x = np.arange(10)
+        y = np.arange(10) * 2
+        got = list(data.minibatches(x, y, 3, shuffle=False))
+        assert len(got) == 3
+        np.testing.assert_array_equal(got[0][0], [0, 1, 2])
+
+    def test_batchiter_prefetch(self):
+        def src():
+            for i in range(5):
+                yield i
+        assert list(data.BatchIter(src, prefetch=2)) == [0, 1, 2, 3, 4]
+
+    def test_shard_disjoint(self):
+        x = np.arange(8)
+        parts = [data.shard(x, r, 4) for r in range(4)]
+        assert sorted(np.concatenate(parts).tolist()) == list(range(8))
+
+
+class _BNModel(model.Model):
+    def __init__(self):
+        super().__init__()
+        self.conv = layer.Conv2d(4, 3, padding=1)
+        self.bn = layer.BatchNorm2d()
+        self.flat = layer.Flatten()
+        self.fc = layer.Linear(3)
+
+    def forward(self, x):
+        return self.fc(self.flat(self.bn(self.conv(x))))
+
+
+class TestJitForward:
+    """The compiled eval path (`Model.forward_graph`)."""
+
+    def _make(self):
+        np.random.seed(0)
+        x = tensor.from_numpy(np.random.randn(4, 2, 8, 8).astype(np.float32))
+        y = tensor.from_numpy(np.random.randint(0, 3, (4,)).astype(np.int32))
+        m = _BNModel()
+        m.set_optimizer(opt.SGD(lr=0.05))
+        m.compile([x], is_train=True, use_graph=True)
+        return m, x, y
+
+    def test_eval_matches_eager(self):
+        m, x, y = self._make()
+        m(x, y)  # one train step so BN stats move off init
+        m.eval()
+        got = m(x)  # routed through forward_graph
+        ref = m.forward(x)  # eager
+        np.testing.assert_allclose(got.to_numpy(), ref.to_numpy(),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_train_flag_not_baked_in(self):
+        """Dropout must differ between train and eval replays."""
+        np.random.seed(0)
+        x = tensor.from_numpy(np.random.randn(16, 32).astype(np.float32))
+
+        class _Drop(model.Model):
+            def __init__(self):
+                super().__init__()
+                self.fc = layer.Linear(32)
+                self.drop = layer.Dropout(0.5)
+
+            def forward(self, xx):
+                return self.drop(self.fc(xx))
+
+        m = _Drop()
+        m.compile([x], is_train=True, use_graph=True)
+        train_out = m.forward_graph(x).to_numpy()
+        m.eval()
+        eval_out = m.forward_graph(x).to_numpy()
+        # Train output has zeroed entries; eval must not equal it.
+        assert (train_out == 0).sum() > 0
+        assert not np.allclose(train_out, eval_out)
+
+    def test_dropout_mask_varies_across_calls(self):
+        """The RNG key is threaded, not baked: two train-mode replays
+        draw different masks."""
+        np.random.seed(0)
+        x = tensor.from_numpy(np.random.randn(16, 32).astype(np.float32))
+
+        class _Drop(model.Model):
+            def __init__(self):
+                super().__init__()
+                self.fc = layer.Linear(32)
+                self.drop = layer.Dropout(0.5)
+
+            def forward(self, xx):
+                return self.drop(self.fc(xx))
+
+        m = _Drop()
+        m.compile([x], is_train=True, use_graph=True)
+        a = m.forward_graph(x).to_numpy()
+        b = m.forward_graph(x).to_numpy()
+        assert not np.allclose(a, b)
+
+    def test_bn_stats_updated_through_graph_forward(self):
+        m, x, _ = self._make()
+        before = m.bn.running_mean.to_numpy().copy()
+        m.forward_graph(x)  # training-mode graph forward
+        after = m.bn.running_mean.to_numpy()
+        assert not np.allclose(before, after)
+
+    def test_static_args_pass_through(self):
+        class _Flag(model.Model):
+            def __init__(self):
+                super().__init__()
+                self.fc = layer.Linear(4)
+
+            def forward(self, xx, scale=None):
+                out = self.fc(xx)
+                if scale is not None and scale != 1:
+                    out = autograd.mul(
+                        out, tensor.from_numpy(
+                            np.float32(scale)).to_device(xx.device))
+                return out
+
+        np.random.seed(0)
+        x = tensor.from_numpy(np.random.randn(2, 8).astype(np.float32))
+        m = _Flag()
+        m.compile([x], is_train=False, use_graph=True)
+        a = m.forward_graph(x, 1).to_numpy()
+        b = m.forward_graph(x, 2.0).to_numpy()
+        np.testing.assert_allclose(2 * a, b, rtol=1e-5)

@@ -1,0 +1,44 @@
+"""Char-RNN example smoke test (reference config: examples/rnn —
+char-level LSTM; BASELINE.md "configs"[3]). Tiny shapes, CPU mesh."""
+import importlib.util
+import os
+import sys
+
+import numpy as np
+
+
+def _load_example():
+    path = os.path.join(os.path.dirname(__file__), "..", "examples",
+                        "rnn", "train.py")
+    spec = importlib.util.spec_from_file_location("char_rnn_train", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_char_rnn_loss_decreases():
+    mod = _load_example()
+    first = mod.run(epochs=1, seq_len=16, batch_size=8, hidden=32,
+                    layers=1, lr=3e-3, do_sample=False, verbose=False)
+    final = mod.run(epochs=4, seq_len=16, batch_size=8, hidden=32,
+                    layers=1, lr=3e-3, do_sample=False, verbose=False)
+    assert final < first
+
+
+def test_char_rnn_sampling_runs():
+    mod = _load_example()
+    ids, chars, _ = mod.load_corpus(None)
+    from singa_tpu import device, opt, tensor
+
+    dev = device.create_tpu_device()
+    m = mod.CharRNN(len(chars), hidden_size=32)
+    m.set_optimizer(opt.Adam(lr=1e-3))
+    x0 = np.stack([ids[:16], ids[16:32]])
+    y0 = np.stack([ids[1:17], ids[17:33]])
+    tx = tensor.from_numpy(x0.astype(np.int32), device=dev)
+    ty = tensor.from_numpy(y0.astype(np.int32), device=dev)
+    m.compile([tx], is_train=True, use_graph=True)
+    m(tx, ty)
+    text = mod.sample(m, chars, dev, prime="th", length=20)
+    assert len(text) == 22
+    assert all(c in chars for c in text[2:])

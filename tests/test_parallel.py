@@ -184,6 +184,22 @@ class TestMeshModeTraining:
         shard, = {s.data.shape for s in w.addressable_shards}
         assert shard == (32, 32)
 
+    def test_eval_forward_after_mesh_training(self):
+        """Eval on a mesh-compiled model must run (and match eager
+        single-device math) despite mesh-sharded params."""
+        mesh = create_mesh({"data": 4, "model": 2})
+        m, _ = _train_mlp(mesh)
+        X = np.random.RandomState(3).randn(16, 32).astype(np.float32)
+        tx = tensor.from_numpy(X)
+        m.eval()
+        got = m(tx)  # routes through the compiled forward
+        host_params = {k: v.to_numpy() for k, v in m.get_params().items()}
+        ref = np.maximum(X @ host_params["_MLP.fc1.W"]
+                         + host_params["_MLP.fc1.b"], 0)
+        ref = ref @ host_params["_MLP.fc2.W"] + host_params["_MLP.fc2.b"]
+        np.testing.assert_allclose(got.to_numpy(), ref, rtol=1e-4,
+                                   atol=1e-5)
+
 
 # ---------------------------------------------------------------------------
 # transformer: DP + TP + SP in one step
