@@ -1,0 +1,119 @@
+"""ONNX import + fine-tune workflow.
+
+Reference parity: `examples/onnx/bert/bert.py` and friends — download
+an ONNX-zoo model, `sonnx.prepare` it, wrap in `SONNXModel`, fine-tune
+with the Model API (SURVEY.md §3.4). This environment has no network,
+so the script is self-contained: it builds a transformer-block
+classifier natively, EXPORTS it to .onnx, then re-imports through
+`SONNXModel` and fine-tunes — the same user workflow end to end. Point
+`--onnx` at any real .onnx file (e.g. BERT-base) to skip the export
+step and fine-tune that instead.
+
+Run: python finetune.py [--onnx model.onnx] [--epochs N]
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.abspath(os.path.join(_HERE, "..", "..")))
+
+from singa_tpu import (  # noqa: E402
+    autograd,
+    device,
+    layer,
+    model,
+    opt,
+    sonnx,
+    tensor,
+)
+
+
+class TinyEncoderClassifier(model.Model):
+    """A BERT-shaped stand-in: embed → [LN → attention-free mixer →
+    GELU MLP] → mean-pool → classify. (The attention op exports once
+    ONNX Attention lands; the mixer keeps the exported graph in the
+    supported op set.)"""
+
+    def __init__(self, vocab=64, d=32, classes=4):
+        super().__init__()
+        self.embed = layer.Embedding(vocab, d)
+        self.ln1 = layer.LayerNorm()
+        self.mix = layer.Linear(d)
+        self.ln2 = layer.LayerNorm()
+        self.fc1 = layer.Linear(2 * d)
+        self.act = layer.Gelu()
+        self.fc2 = layer.Linear(d)
+        self.head = layer.Linear(classes)
+
+    def forward(self, x):
+        h = self.embed(x)
+        h = autograd.add(h, self.mix(self.ln1(h)))
+        h = autograd.add(h, self.fc2(self.act(self.fc1(self.ln2(h)))))
+        pooled = autograd.reduce_mean(h, axes=(1,))
+        return self.head(pooled)
+
+
+def make_data(n=64, seq=16, vocab=64, classes=4, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.randint(0, vocab, (n, seq)).astype(np.int32)
+    # Learnable rule: class = (count of token 0) % classes
+    y = ((x == 0).sum(axis=1) % classes).astype(np.int32)
+    return x, y
+
+
+def export_tiny(path, dev):
+    m = TinyEncoderClassifier()
+    x, _ = make_data(n=8)
+    tx = tensor.from_numpy(x, device=dev)
+    m.compile([tx], is_train=False, use_graph=False)
+    sonnx.save(sonnx.to_onnx(m, [tx]), path)
+    return path
+
+
+def run(onnx_path=None, epochs=10, batch=32, lr=1e-2, verbose=True):
+    dev = device.create_tpu_device()
+    dev.SetRandSeed(0)
+    if onnx_path is None:
+        onnx_path = os.path.join("/tmp", "tiny_encoder.onnx")
+        export_tiny(onnx_path, dev)
+        if verbose:
+            print(f"exported tiny encoder to {onnx_path}")
+
+    sm = sonnx.SONNXModel(onnx_path, device=dev)
+    sm.set_optimizer(opt.SGD(lr=lr, momentum=0.9))
+    x, y = make_data(n=256)
+    tx = tensor.from_numpy(x[:batch], device=dev)
+    ty = tensor.from_numpy(y[:batch], device=dev)
+    sm.compile([tx], is_train=True, use_graph=True)
+
+    last = None
+    for epoch in range(epochs):
+        total, nb, correct = 0.0, 0, 0
+        for i in range(0, len(x) - batch + 1, batch):
+            tx.copy_from_numpy(x[i:i + batch])
+            ty.copy_from_numpy(y[i:i + batch])
+            out, l = sm(tx, ty)
+            total += float(l.to_numpy())
+            nb += 1
+            o = out[0] if isinstance(out, tuple) else out
+            correct += (np.argmax(o.to_numpy(), -1)
+                        == y[i:i + batch]).sum()
+        last = total / nb
+        if verbose:
+            print(f"epoch {epoch}: loss {last:.4f} "
+                  f"acc {correct / (nb * batch):.3f}")
+    return last
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--onnx", default=None,
+                   help=".onnx file to fine-tune (default: self-export)")
+    p.add_argument("--epochs", type=int, default=10)
+    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--lr", type=float, default=1e-2)
+    a = p.parse_args()
+    run(a.onnx, a.epochs, a.batch, a.lr)

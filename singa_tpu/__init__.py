@@ -16,12 +16,17 @@ Layer map (mirrors SURVEY.md §1, re-designed TPU-first):
 __version__ = "0.1.0"
 
 from . import autograd  # noqa: F401
+from . import data  # noqa: F401
 from . import device  # noqa: F401
 from . import initializer  # noqa: F401
 from . import layer  # noqa: F401
+from . import loss  # noqa: F401
+from . import metric  # noqa: F401
 from . import model  # noqa: F401
 from . import opt  # noqa: F401
 from . import rnn  # noqa: F401
+from . import snapshot  # noqa: F401
+from . import sonnx  # noqa: F401
 from . import tensor  # noqa: F401
 from .model import Model  # noqa: F401
 from .device import (  # noqa: F401

@@ -444,6 +444,13 @@ class Gelu(Operator):
         return jax.nn.gelu(x, approximate=False)
 
 
+class Identity(Operator):
+    """Reference: ONNX Identity (used by sonnx import of Dropout)."""
+
+    def fn(self, x):
+        return x
+
+
 class Cast(Operator):
     def __init__(self, to):
         super().__init__()
@@ -634,7 +641,8 @@ class Gather(Operator):
     def __init__(self, axis: int, indices):
         super().__init__()
         self.axis = axis
-        self.indices = jnp.asarray(np.asarray(indices), dtype=jnp.int32)
+        idx = indices.data if isinstance(indices, Tensor) else indices
+        self.indices = jnp.asarray(idx).astype(jnp.int32)
 
     def fn(self, x):
         return jnp.take(x, self.indices, axis=self.axis)
@@ -780,8 +788,11 @@ class Embedding(Operator):
 
     def __init__(self, indices):
         super().__init__()
-        idx = indices.data if isinstance(indices, Tensor) else jnp.asarray(indices)
-        self.indices = idx.astype(jnp.int32)
+        # Keep the source tensor: sonnx export re-links the lookup to
+        # the graph input instead of baking the indices as a constant.
+        self._indices_src = indices if isinstance(indices, Tensor) else None
+        idx = indices.data if isinstance(indices, Tensor) else indices
+        self.indices = jnp.asarray(idx).astype(jnp.int32)
 
     def fn(self, w):
         return jnp.take(w, self.indices, axis=0)

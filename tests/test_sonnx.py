@@ -1,0 +1,292 @@
+"""SONNX tests (reference: test/python/test_onnx.py — export/import
+roundtrips asserting output parity; SURVEY.md §4.2).
+
+No `onnx` pip package exists in this environment, so wire-format
+compatibility is asserted structurally (serialize → parse → same
+graph) through `singa_tpu.proto.onnx_ir_pb2`.
+"""
+import numpy as np
+import pytest
+
+from singa_tpu import autograd, layer, model, opt, sonnx, tensor
+from singa_tpu.proto import onnx_ir_pb2 as P
+
+
+class _MLP(model.Model):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = layer.Linear(16)
+        self.relu = layer.ReLU()
+        self.fc2 = layer.Linear(4)
+
+    def forward(self, x):
+        return self.fc2(self.relu(self.fc1(x)))
+
+
+class _CNN(model.Model):
+    def __init__(self):
+        super().__init__()
+        self.conv = layer.Conv2d(4, 3, padding=1)
+        self.bn = layer.BatchNorm2d()
+        self.pool = layer.MaxPool2d(2, 2)
+        self.flat = layer.Flatten()
+        self.fc = layer.Linear(6)
+
+    def forward(self, x):
+        return self.fc(self.flat(self.pool(self.bn(self.conv(x)))))
+
+
+def _roundtrip(m, x, tmp_path=None):
+    m.eval()
+    ref = m.forward(x).to_numpy()
+    mp = sonnx.to_onnx(m, [x])
+    # serialize → parse (wire roundtrip)
+    blob = mp.SerializeToString()
+    mp2 = P.ModelProto()
+    mp2.ParseFromString(blob)
+    rep = sonnx.prepare(mp2)
+    out = rep.run([x])[0].to_numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+    return mp2
+
+
+class TestExportImport:
+    def test_mlp_roundtrip(self):
+        np.random.seed(0)
+        x = tensor.from_numpy(np.random.randn(3, 8).astype(np.float32))
+        m = _MLP()
+        m.compile([x], is_train=False, use_graph=False)
+        mp = _roundtrip(m, x)
+        ops = [n.op_type for n in mp.graph.node]
+        assert "MatMul" in ops and "Relu" in ops
+
+    def test_cnn_roundtrip(self):
+        np.random.seed(0)
+        x = tensor.from_numpy(
+            np.random.randn(2, 3, 8, 8).astype(np.float32))
+        m = _CNN()
+        m.compile([x], is_train=False, use_graph=False)
+        mp = _roundtrip(m, x)
+        ops = [n.op_type for n in mp.graph.node]
+        assert "Conv" in ops and "BatchNormalization" in ops \
+            and "MaxPool" in ops
+
+    def test_transformerish_ops_roundtrip(self):
+        """LayerNorm + Gelu + Softmax + Gemm — the BERT op family."""
+        np.random.seed(0)
+
+        class _Block(model.Model):
+            def __init__(self):
+                super().__init__()
+                self.ln = layer.LayerNorm()
+                self.fc = layer.Linear(8)
+                self.act = layer.Gelu()
+
+            def forward(self, x):
+                return autograd.softmax(self.act(self.fc(self.ln(x))),
+                                        axis=-1)
+
+        x = tensor.from_numpy(np.random.randn(4, 8).astype(np.float32))
+        m = _Block()
+        m.compile([x], is_train=False, use_graph=False)
+        mp = _roundtrip(m, x)
+        ops = [n.op_type for n in mp.graph.node]
+        assert "LayerNormalization" in ops and "Gelu" in ops
+
+    def test_file_roundtrip(self, tmp_path):
+        np.random.seed(0)
+        x = tensor.from_numpy(np.random.randn(3, 8).astype(np.float32))
+        m = _MLP()
+        m.compile([x], is_train=False, use_graph=False)
+        ref = m.forward(x).to_numpy()
+        path = str(tmp_path / "m.onnx")
+        sonnx.save(sonnx.to_onnx(m, [x]), path)
+        rep = sonnx.prepare(path)
+        np.testing.assert_allclose(rep.run([x])[0].to_numpy(), ref,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_unsupported_op_reported(self):
+        mp = P.ModelProto()
+        mp.graph.name = "g"
+        n = mp.graph.node.add()
+        n.op_type = "NonexistentOp999"
+        n.input.append("x")
+        n.output.append("y")
+        with pytest.raises(ValueError, match="NonexistentOp999"):
+            sonnx.prepare(mp)
+
+
+class TestSONNXModel:
+    def _exported_mlp(self):
+        np.random.seed(0)
+        x = tensor.from_numpy(np.random.randn(8, 8).astype(np.float32))
+        m = _MLP()
+        m.compile([x], is_train=False, use_graph=False)
+        return sonnx.to_onnx(m, [x]), x
+
+    def test_params_trainable(self):
+        mp, x = self._exported_mlp()
+        sm = sonnx.SONNXModel(mp)
+        params = sm.get_params()
+        assert len(params) == 4  # 2 layers x (W, b)
+
+    def test_finetune_loss_decreases(self):
+        mp, x = self._exported_mlp()
+        sm = sonnx.SONNXModel(mp)
+        sm.set_optimizer(opt.SGD(lr=0.1))
+        y = tensor.from_numpy(
+            np.random.RandomState(1).randint(0, 4, (8,)).astype(np.int32))
+        sm.compile([x], is_train=True, use_graph=False)
+        losses = [float(sm.train_one_batch(x, y)[1].to_numpy())
+                  for _ in range(8)]
+        assert losses[-1] < losses[0]
+
+    def test_finetune_graph_mode_matches_eager(self):
+        mp, x = self._exported_mlp()
+        y = tensor.from_numpy(
+            np.random.RandomState(1).randint(0, 4, (8,)).astype(np.int32))
+
+        def run(use_graph):
+            sm = sonnx.SONNXModel(mp)
+            sm.set_optimizer(opt.SGD(lr=0.1))
+            sm.compile([x], is_train=True, use_graph=use_graph)
+            return [float(sm(x, y)[1].to_numpy()) for _ in range(4)]
+
+        np.testing.assert_allclose(run(False), run(True), rtol=1e-5)
+
+
+class TestReviewRegressions:
+    def test_addbias_axis1_roundtrip(self):
+        class _RowBias(model.Model):
+            def __init__(self):
+                super().__init__()
+                self.b = tensor.from_numpy(
+                    np.arange(3, dtype=np.float32))
+                self.b.requires_grad = self.b.stores_grad = True
+
+            def forward(self, x):
+                return autograd.add_bias(x, self.b, axis=1)
+
+        x = tensor.from_numpy(
+            np.random.RandomState(0).randn(3, 5).astype(np.float32))
+        m = _RowBias()
+        _roundtrip(m, x)
+
+    def test_conv_empty_bias_name(self):
+        """ONNX marks an omitted optional input with an empty string."""
+        np.random.seed(0)
+        x = tensor.from_numpy(
+            np.random.randn(1, 2, 6, 6).astype(np.float32))
+        w_np = np.random.randn(3, 2, 3, 3).astype(np.float32)
+        mp = P.ModelProto()
+        mp.graph.name = "g"
+        mp.graph.initializer.append(sonnx.to_tensor_proto("W", w_np))
+        n = mp.graph.node.add()
+        n.op_type = "Conv"
+        n.input.extend(["x", "W", ""])
+        n.output.append("y")
+        n.attribute.append(sonnx._make_attr("kernel_shape", [3, 3]))
+        vi = mp.graph.input.add()
+        vi.name = "x"
+        vo = mp.graph.output.add()
+        vo.name = "y"
+        out = sonnx.prepare(mp).run([x])[0]
+        assert out.shape == (1, 3, 4, 4)
+
+    def test_asymmetric_pads_rejected(self):
+        mp = P.ModelProto()
+        mp.graph.name = "g"
+        n = mp.graph.node.add()
+        n.op_type = "MaxPool"
+        n.input.append("x")
+        n.output.append("y")
+        n.attribute.append(sonnx._make_attr("kernel_shape", [2, 2]))
+        n.attribute.append(sonnx._make_attr("pads", [0, 0, 1, 1]))
+        vi = mp.graph.input.add()
+        vi.name = "x"
+        vo = mp.graph.output.add()
+        vo.name = "y"
+        x = tensor.from_numpy(np.zeros((1, 1, 4, 4), np.float32))
+        with pytest.raises(ValueError, match="asymmetric"):
+            sonnx.prepare(mp).run([x])
+
+    def test_onehot_roundtrip(self):
+        class _OH(model.Model):
+            def forward(self, x):
+                return autograd.OneHot(5)(x)
+
+        x = tensor.from_numpy(np.array([0, 2, 4], np.int32))
+        m = _OH()
+        ref = m.forward(x).to_numpy()
+        rep = sonnx.prepare(sonnx.to_onnx(m, [x]))
+        np.testing.assert_array_equal(rep.run([x])[0].to_numpy(), ref)
+
+    def test_export_restores_requires_grad(self):
+        x = tensor.from_numpy(
+            np.random.RandomState(0).randn(3, 8).astype(np.float32))
+        assert not x.requires_grad
+        m = _MLP()
+        m.compile([x], is_train=False, use_graph=False)
+        sonnx.to_onnx(m, [x])
+        assert not x.requires_grad
+
+    def test_bn_stats_are_state_not_params(self):
+        np.random.seed(0)
+        x = tensor.from_numpy(
+            np.random.randn(2, 3, 8, 8).astype(np.float32))
+        m = _CNN()
+        m.compile([x], is_train=True, use_graph=False)
+        y = tensor.from_numpy(np.zeros(2, np.int32))
+        m.set_optimizer(opt.SGD(lr=0.01))
+        m.train_one_batch(x, y)  # move BN stats off init
+        sm = sonnx.SONNXModel(sonnx.to_onnx(m, [x]))
+        # 3 trainable pairs (conv W/b, bn scale/bias, fc W/b)
+        assert len(sm.get_params()) == 6
+        assert len(sm.state_tensors()) == 2  # bn mean/var
+
+    def test_bn_stats_move_when_finetuning(self):
+        np.random.seed(0)
+        x = tensor.from_numpy(
+            np.random.randn(2, 3, 8, 8).astype(np.float32))
+        m = _CNN()
+        m.compile([x], is_train=False, use_graph=False)
+        sm = sonnx.SONNXModel(sonnx.to_onnx(m, [x]))
+        sm.set_optimizer(opt.SGD(lr=0.01))
+        y = tensor.from_numpy(np.zeros(2, np.int32))
+        sm.compile([x], is_train=True, use_graph=False)
+        before = [s.to_numpy().copy() for s in sm.state_tensors()]
+        sm.train_one_batch(x, y)
+        after = [s.to_numpy() for s in sm.state_tensors()]
+        assert any(not np.allclose(b, a) for b, a in zip(before, after))
+
+
+class TestFinetuneExample:
+    def test_example_learns(self):
+        import importlib.util
+        import os as _os
+
+        path = _os.path.join(_os.path.dirname(__file__), "..", "examples",
+                             "onnx", "finetune.py")
+        spec = importlib.util.spec_from_file_location("onnx_finetune", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        last = mod.run(epochs=4, verbose=False)
+        assert last < 1.0
+
+
+class TestGradThroughImport:
+    def test_imported_graph_differentiable(self):
+        np.random.seed(0)
+        x = tensor.from_numpy(np.random.randn(4, 8).astype(np.float32))
+        m = _MLP()
+        m.compile([x], is_train=False, use_graph=False)
+        rep = sonnx.prepare(sonnx.to_onnx(m, [x]))
+        for t in rep.params.values():
+            t.requires_grad = True
+            t.stores_grad = True
+        out = rep.run([x])[0]
+        loss = autograd.reduce_sum(autograd.mul(out, out))
+        grads = autograd.gradients(loss)
+        assert len(grads) == 4
+        for g in grads.values():
+            assert np.isfinite(g.to_numpy()).all()
