@@ -1,0 +1,45 @@
+// Framed key/value record files (datasets, snapshots).
+// Reference parity: include/singa/io/{reader,writer}.h,
+// src/io/binfile_{reader,writer}.cc. Redesigned frame: per-record
+// magic + CRC32 so truncated/corrupt files fail loudly instead of
+// feeding garbage.
+//
+// Layout: file header "STBF" u32(version)
+//         record: u32 magic 0x5354524b ("STRK") | u32 klen | u64 vlen
+//                 | key bytes | value bytes | u32 crc32(value)
+#pragma once
+
+#include <cstdint>
+#include <cstdio>
+#include <string>
+
+namespace singa_tpu {
+
+uint32_t Crc32(const void* data, size_t n);
+
+class BinFileWriter {
+ public:
+  // mode "w" truncates, "a" appends.
+  bool Open(const std::string& path, const char* mode = "w");
+  bool Write(const std::string& key, const void* value, uint64_t vlen);
+  void Flush();
+  void Close();
+  ~BinFileWriter() { Close(); }
+
+ private:
+  FILE* f_ = nullptr;
+};
+
+class BinFileReader {
+ public:
+  bool Open(const std::string& path);
+  // Returns false at EOF; aborts (ST_CHECK) on corruption.
+  bool Read(std::string* key, std::string* value);
+  void Close();
+  ~BinFileReader() { Close(); }
+
+ private:
+  FILE* f_ = nullptr;
+};
+
+}  // namespace singa_tpu

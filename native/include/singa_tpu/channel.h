@@ -1,0 +1,29 @@
+// Named output channels for training metrics.
+// Reference parity: include/singa/utils/channel.h, src/utils/channel.cc
+// (Channel::Send, GetChannel, per-channel file/stderr sinks).
+#pragma once
+
+#include <string>
+
+namespace singa_tpu {
+
+class Channel {
+ public:
+  explicit Channel(const std::string& name);
+  ~Channel();
+  const std::string& name() const { return name_; }
+  void EnableDestStderr(bool flag) { to_stderr_ = flag; }
+  void EnableDestFile(const std::string& path);
+  void DisableDestFile();
+  void Send(const std::string& message);
+
+ private:
+  std::string name_;
+  bool to_stderr_ = false;
+  void* file_ = nullptr;  // FILE*
+};
+
+// Process-wide registry; creates on first use. Thread-safe.
+Channel* GetChannel(const std::string& name);
+
+}  // namespace singa_tpu

@@ -1,0 +1,63 @@
+// Logging / CHECK substrate for the native runtime.
+// Reference parity: include/singa/utils/logging.h, src/utils/logging.cc
+// (glog-compatible LOG(severity) + CHECK macros). Re-designed: no glog
+// dependency, severity filter + optional file sink, thread-safe.
+#pragma once
+
+#include <cstdint>
+#include <sstream>
+#include <string>
+
+namespace singa_tpu {
+
+enum class Severity : int { kDebug = 0, kInfo = 1, kWarning = 2,
+                            kError = 3, kFatal = 4 };
+
+// Write one record to the active sinks (stderr and/or file).
+// Fatal aborts after logging.
+void LogMessage(Severity s, const char* file, int line,
+                const std::string& msg);
+void SetLogLevel(int min_severity);
+int GetLogLevel();
+// Empty path restores stderr-only logging.
+void SetLogFile(const std::string& path);
+
+namespace detail {
+class LogStream {
+ public:
+  LogStream(Severity s, const char* file, int line)
+      : s_(s), file_(file), line_(line) {}
+  ~LogStream() { LogMessage(s_, file_, line_, ss_.str()); }
+  template <typename T>
+  LogStream& operator<<(const T& v) {
+    ss_ << v;
+    return *this;
+  }
+
+ private:
+  Severity s_;
+  const char* file_;
+  int line_;
+  std::ostringstream ss_;
+};
+}  // namespace detail
+
+}  // namespace singa_tpu
+
+#define ST_LOG(severity)                                                  \
+  ::singa_tpu::detail::LogStream(::singa_tpu::Severity::k##severity,      \
+                                 __FILE__, __LINE__)
+
+#define ST_CHECK(cond)                                                    \
+  if (!(cond))                                                            \
+  ::singa_tpu::detail::LogStream(::singa_tpu::Severity::kFatal, __FILE__, \
+                                 __LINE__)                                \
+      << "Check failed: " #cond " "
+
+#define ST_CHECK_OP(a, b, op) ST_CHECK((a)op(b)) << "(" << (a) << " vs " << (b) << ") "
+#define ST_CHECK_EQ(a, b) ST_CHECK_OP(a, b, ==)
+#define ST_CHECK_NE(a, b) ST_CHECK_OP(a, b, !=)
+#define ST_CHECK_LT(a, b) ST_CHECK_OP(a, b, <)
+#define ST_CHECK_LE(a, b) ST_CHECK_OP(a, b, <=)
+#define ST_CHECK_GT(a, b) ST_CHECK_OP(a, b, >)
+#define ST_CHECK_GE(a, b) ST_CHECK_OP(a, b, >=)

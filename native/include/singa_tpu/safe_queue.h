@@ -1,0 +1,61 @@
+// Bounded thread-safe queue.
+// Reference parity: include/singa/utils/safe_queue.h. Redesigned as a
+// single bounded MPMC queue with close() semantics (the reference
+// ships separate SafeQueue/PriorityQueue without shutdown signaling,
+// which every consumer then hand-rolls).
+#pragma once
+
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+#include <optional>
+
+namespace singa_tpu {
+
+template <typename T>
+class SafeQueue {
+ public:
+  explicit SafeQueue(size_t capacity = 0) : cap_(capacity) {}
+
+  // Returns false if the queue is closed.
+  bool Push(T v) {
+    std::unique_lock<std::mutex> lk(mu_);
+    not_full_.wait(lk, [&] { return closed_ || cap_ == 0 || q_.size() < cap_; });
+    if (closed_) return false;
+    q_.push_back(std::move(v));
+    not_empty_.notify_one();
+    return true;
+  }
+
+  // Blocks until an item or close; empty optional on closed+drained.
+  std::optional<T> Pop() {
+    std::unique_lock<std::mutex> lk(mu_);
+    not_empty_.wait(lk, [&] { return closed_ || !q_.empty(); });
+    if (q_.empty()) return std::nullopt;
+    T v = std::move(q_.front());
+    q_.pop_front();
+    not_full_.notify_one();
+    return v;
+  }
+
+  void Close() {
+    std::lock_guard<std::mutex> lk(mu_);
+    closed_ = true;
+    not_empty_.notify_all();
+    not_full_.notify_all();
+  }
+
+  size_t Size() const {
+    std::lock_guard<std::mutex> lk(mu_);
+    return q_.size();
+  }
+
+ private:
+  mutable std::mutex mu_;
+  std::condition_variable not_empty_, not_full_;
+  std::deque<T> q_;
+  size_t cap_;
+  bool closed_ = false;
+};
+
+}  // namespace singa_tpu

@@ -1,0 +1,26 @@
+// Monotonic timer. Reference parity: include/singa/utils/timer.h.
+#pragma once
+
+#include <chrono>
+#include <cstdint>
+
+namespace singa_tpu {
+
+inline uint64_t NowNs() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+class Timer {
+ public:
+  Timer() : start_(NowNs()) {}
+  void Reset() { start_ = NowNs(); }
+  uint64_t ElapsedNs() const { return NowNs() - start_; }
+  double ElapsedMs() const { return ElapsedNs() / 1e6; }
+
+ private:
+  uint64_t start_;
+};
+
+}  // namespace singa_tpu

@@ -1,0 +1,120 @@
+#include "singa_tpu/binfile.h"
+
+#include <cstring>
+#include <vector>
+
+#include "singa_tpu/logging.h"
+
+namespace singa_tpu {
+
+namespace {
+constexpr uint32_t kFileMagic = 0x46425453;    // "STBF" little-endian
+constexpr uint32_t kRecordMagic = 0x4b525453;  // "STRK"
+constexpr uint32_t kVersion = 1;
+
+uint32_t g_crc_table[256];
+bool g_crc_init = false;
+
+void InitCrc() {
+  if (g_crc_init) return;
+  for (uint32_t i = 0; i < 256; ++i) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; ++k) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+    g_crc_table[i] = c;
+  }
+  g_crc_init = true;
+}
+}  // namespace
+
+uint32_t Crc32(const void* data, size_t n) {
+  InitCrc();
+  const uint8_t* p = static_cast<const uint8_t*>(data);
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; ++i)
+    c = g_crc_table[(c ^ p[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+bool BinFileWriter::Open(const std::string& path, const char* mode) {
+  Close();
+  bool fresh = true;
+  if (mode[0] == 'a') {
+    if (FILE* probe = fopen(path.c_str(), "rb")) {
+      fseek(probe, 0, SEEK_END);
+      fresh = ftell(probe) == 0;
+      fclose(probe);
+    }
+  }
+  f_ = fopen(path.c_str(), mode[0] == 'a' ? "ab" : "wb");
+  if (!f_) return false;
+  if (fresh) {
+    fwrite(&kFileMagic, 4, 1, f_);
+    fwrite(&kVersion, 4, 1, f_);
+  }
+  return true;
+}
+
+bool BinFileWriter::Write(const std::string& key, const void* value,
+                          uint64_t vlen) {
+  ST_CHECK(f_ != nullptr) << "writer not open";
+  uint32_t klen = static_cast<uint32_t>(key.size());
+  uint32_t crc = Crc32(value, vlen);
+  return fwrite(&kRecordMagic, 4, 1, f_) == 1 &&
+         fwrite(&klen, 4, 1, f_) == 1 && fwrite(&vlen, 8, 1, f_) == 1 &&
+         (klen == 0 || fwrite(key.data(), 1, klen, f_) == klen) &&
+         (vlen == 0 || fwrite(value, 1, vlen, f_) == vlen) &&
+         fwrite(&crc, 4, 1, f_) == 1;
+}
+
+void BinFileWriter::Flush() {
+  if (f_) fflush(f_);
+}
+
+void BinFileWriter::Close() {
+  if (f_) {
+    fclose(f_);
+    f_ = nullptr;
+  }
+}
+
+bool BinFileReader::Open(const std::string& path) {
+  Close();
+  f_ = fopen(path.c_str(), "rb");
+  if (!f_) return false;
+  uint32_t magic = 0, version = 0;
+  if (fread(&magic, 4, 1, f_) != 1 || fread(&version, 4, 1, f_) != 1 ||
+      magic != kFileMagic) {
+    Close();
+    return false;
+  }
+  ST_CHECK_EQ(version, kVersion) << "binfile version mismatch";
+  return true;
+}
+
+bool BinFileReader::Read(std::string* key, std::string* value) {
+  ST_CHECK(f_ != nullptr) << "reader not open";
+  uint32_t magic = 0;
+  if (fread(&magic, 4, 1, f_) != 1) return false;  // clean EOF
+  ST_CHECK_EQ(magic, kRecordMagic) << "corrupt record frame";
+  uint32_t klen = 0;
+  uint64_t vlen = 0;
+  ST_CHECK_EQ(fread(&klen, 4, 1, f_), 1u) << "truncated record";
+  ST_CHECK_EQ(fread(&vlen, 8, 1, f_), 1u) << "truncated record";
+  key->resize(klen);
+  value->resize(vlen);
+  if (klen) ST_CHECK_EQ(fread(&(*key)[0], 1, klen, f_), klen);
+  if (vlen) ST_CHECK_EQ(fread(&(*value)[0], 1, vlen, f_), vlen);
+  uint32_t crc = 0;
+  ST_CHECK_EQ(fread(&crc, 4, 1, f_), 1u) << "truncated record";
+  ST_CHECK_EQ(crc, Crc32(value->data(), vlen)) << "crc mismatch: " << *key;
+  return true;
+}
+
+void BinFileReader::Close() {
+  if (f_) {
+    fclose(f_);
+    f_ = nullptr;
+  }
+}
+
+}  // namespace singa_tpu

@@ -1,0 +1,51 @@
+#include "singa_tpu/channel.h"
+
+#include <cstdio>
+#include <map>
+#include <memory>
+#include <mutex>
+
+#include "singa_tpu/logging.h"
+
+namespace singa_tpu {
+
+Channel::Channel(const std::string& name) : name_(name) {}
+
+Channel::~Channel() { DisableDestFile(); }
+
+void Channel::EnableDestFile(const std::string& path) {
+  DisableDestFile();
+  file_ = fopen(path.c_str(), "a");
+  if (!file_) ST_LOG(Error) << "channel " << name_ << ": cannot open " << path;
+}
+
+void Channel::DisableDestFile() {
+  if (file_) {
+    fclose(static_cast<FILE*>(file_));
+    file_ = nullptr;
+  }
+}
+
+void Channel::Send(const std::string& message) {
+  if (to_stderr_) fprintf(stderr, "[%s] %s\n", name_.c_str(), message.c_str());
+  if (file_) {
+    fprintf(static_cast<FILE*>(file_), "%s\n", message.c_str());
+    fflush(static_cast<FILE*>(file_));
+  }
+}
+
+namespace {
+std::mutex g_mu;
+std::map<std::string, std::unique_ptr<Channel>>* g_channels = nullptr;
+}  // namespace
+
+Channel* GetChannel(const std::string& name) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (!g_channels)
+    g_channels = new std::map<std::string, std::unique_ptr<Channel>>();
+  auto& slot = (*g_channels)[name];
+  if (!slot) slot = std::make_unique<Channel>(name);
+  return slot.get();
+}
+
+}  // namespace singa_tpu

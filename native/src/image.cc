@@ -1,0 +1,46 @@
+// Host-side image transforms (crop / flip / normalize) on raw float
+// CHW arrays. Reference parity: src/io/image_transformer.cc (crop,
+// flip, resize via OpenCV). OpenCV-free: these are the pure-array
+// transforms the CNN data pipelines need; JPEG decode stays in Python
+// (PIL) as the reference's examples mostly do anyway.
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// in: (c, h, w) float32; out: (c, oh, ow); top-left corner (y0, x0).
+int st_image_crop(const float* in, int c, int h, int w, int y0, int x0,
+                  int oh, int ow, float* out) {
+  if (y0 < 0 || x0 < 0 || y0 + oh > h || x0 + ow > w) return 0;
+  for (int ch = 0; ch < c; ++ch)
+    for (int y = 0; y < oh; ++y)
+      std::memcpy(out + (static_cast<size_t>(ch) * oh + y) * ow,
+                  in + (static_cast<size_t>(ch) * h + y0 + y) * w + x0,
+                  sizeof(float) * ow);
+  return 1;
+}
+
+// Horizontal flip, (c, h, w) float32.
+int st_image_hflip(const float* in, int c, int h, int w, float* out) {
+  for (int ch = 0; ch < c; ++ch)
+    for (int y = 0; y < h; ++y) {
+      const float* row = in + (static_cast<size_t>(ch) * h + y) * w;
+      float* orow = out + (static_cast<size_t>(ch) * h + y) * w;
+      for (int x = 0; x < w; ++x) orow[x] = row[w - 1 - x];
+    }
+  return 1;
+}
+
+// Per-channel (x - mean[c]) / std[c], in place allowed (in == out).
+int st_image_normalize(const float* in, int c, int h, int w,
+                       const float* mean, const float* stddev, float* out) {
+  size_t plane = static_cast<size_t>(h) * w;
+  for (int ch = 0; ch < c; ++ch) {
+    float m = mean[ch], s = stddev[ch];
+    const float* src = in + ch * plane;
+    float* dst = out + ch * plane;
+    for (size_t i = 0; i < plane; ++i) dst[i] = (src[i] - m) / s;
+  }
+  return 1;
+}
+}

@@ -1,0 +1,248 @@
+// Threaded prefetching dataset loader over BinFile records.
+// Reference parity: the reader side of src/io/binfile_reader.cc plus
+// the worker-thread prefetch of python/singa/data.py's ImageBatchIter,
+// moved into native code: records are indexed once, then worker
+// threads pread() them by offset (random order per epoch, optional
+// rank/world sharding) into a bounded SafeQueue.
+#include <fcntl.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cstring>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "singa_tpu/binfile.h"
+#include "singa_tpu/channel.h"
+#include "singa_tpu/logging.h"
+#include "singa_tpu/safe_queue.h"
+#include "singa_tpu/timer.h"
+
+namespace singa_tpu {
+
+struct Record {
+  std::string key;
+  std::string value;
+};
+
+class Loader {
+ public:
+  Loader(const std::string& path, int prefetch, bool shuffle, uint64_t seed,
+         int rank, int world, int epochs)
+      : path_(path), shuffle_(shuffle), seed_(seed), rank_(rank),
+        world_(world), epochs_(epochs), queue_(std::max(prefetch, 1)) {}
+
+  bool Init() {
+    if (rank_ < 0 || rank_ >= world_) return false;
+    // Index pass: (offset, klen, vlen) per record.
+    FILE* f = fopen(path_.c_str(), "rb");
+    if (!f) return false;
+    uint32_t magic = 0, version = 0;
+    if (fread(&magic, 4, 1, f) != 1 || fread(&version, 4, 1, f) != 1 ||
+        magic != 0x46425453u) {
+      fclose(f);
+      return false;
+    }
+    while (true) {
+      long at = ftell(f);
+      uint32_t rmagic = 0, klen = 0;
+      uint64_t vlen = 0;
+      if (fread(&rmagic, 4, 1, f) != 1) break;
+      ST_CHECK_EQ(rmagic, 0x4b525453u) << "corrupt record at " << at;
+      ST_CHECK_EQ(fread(&klen, 4, 1, f), 1u);
+      ST_CHECK_EQ(fread(&vlen, 8, 1, f), 1u);
+      index_.push_back({static_cast<uint64_t>(at), klen, vlen});
+      fseek(f, static_cast<long>(klen + vlen + 4), SEEK_CUR);
+    }
+    fclose(f);
+    fd_ = open(path_.c_str(), O_RDONLY);
+    if (fd_ < 0) return false;
+    worker_ = std::thread([this] { Run(); });
+    return true;
+  }
+
+  // False once all epochs are drained.
+  bool Next(Record* out) {
+    auto v = queue_.Pop();
+    if (!v) return false;
+    *out = std::move(*v);
+    return true;
+  }
+
+  size_t NumRecords() const {
+    size_t n = index_.size() / world_;
+    return n + (static_cast<size_t>(rank_) < index_.size() % world_ ? 1 : 0);
+  }
+
+  ~Loader() {
+    stop_ = true;
+    queue_.Close();
+    if (worker_.joinable()) worker_.join();
+    if (fd_ >= 0) close(fd_);
+  }
+
+ private:
+  struct Entry {
+    uint64_t offset;
+    uint32_t klen;
+    uint64_t vlen;
+  };
+
+  void Run() {
+    for (int epoch = 0; epochs_ < 0 || epoch < epochs_; ++epoch) {
+      std::vector<size_t> order;
+      for (size_t i = rank_; i < index_.size(); i += world_)
+        order.push_back(i);
+      if (shuffle_) {
+        std::mt19937_64 rng(seed_ + epoch);
+        std::shuffle(order.begin(), order.end(), rng);
+      }
+      for (size_t i : order) {
+        if (stop_) return;
+        const Entry& e = index_[i];
+        Record r;
+        r.key.resize(e.klen);
+        r.value.resize(e.vlen);
+        uint64_t base = e.offset + 16;  // magic + klen + vlen
+        if (e.klen)
+          ST_CHECK_EQ(pread(fd_, &r.key[0], e.klen, base),
+                      static_cast<ssize_t>(e.klen));
+        if (e.vlen)
+          ST_CHECK_EQ(pread(fd_, &r.value[0], e.vlen, base + e.klen),
+                      static_cast<ssize_t>(e.vlen));
+        if (!queue_.Push(std::move(r))) return;
+      }
+    }
+    queue_.Close();
+  }
+
+  std::string path_;
+  bool shuffle_;
+  uint64_t seed_;
+  int rank_, world_, epochs_;
+  int fd_ = -1;
+  std::vector<Entry> index_;
+  SafeQueue<Record> queue_;
+  std::thread worker_;
+  std::atomic<bool> stop_{false};
+};
+
+}  // namespace singa_tpu
+
+// ---------------------------------------------------------------------------
+// C API for the Python ctypes binding (singa_tpu/io.py). SWIG-free by
+// design (reference used SWIG, src/api/*.i).
+// ---------------------------------------------------------------------------
+extern "C" {
+
+using singa_tpu::BinFileReader;
+using singa_tpu::BinFileWriter;
+using singa_tpu::Loader;
+using singa_tpu::Record;
+
+void* st_writer_open(const char* path, const char* mode) {
+  auto* w = new BinFileWriter();
+  if (!w->Open(path, mode)) {
+    delete w;
+    return nullptr;
+  }
+  return w;
+}
+
+int st_writer_write(void* w, const char* key, const void* val,
+                    uint64_t vlen) {
+  return static_cast<BinFileWriter*>(w)->Write(key, val, vlen) ? 1 : 0;
+}
+
+void st_writer_close(void* w) { delete static_cast<BinFileWriter*>(w); }
+
+void* st_reader_open(const char* path) {
+  auto* r = new BinFileReader();
+  if (!r->Open(path)) {
+    delete r;
+    return nullptr;
+  }
+  return r;
+}
+
+// Returns 1 and fills out-params, 0 at EOF. Buffers owned by the
+// reader until the next call (copied out by the binding).
+int st_reader_next(void* rp, const char** key, uint32_t* klen,
+                   const char** val, uint64_t* vlen) {
+  auto* r = static_cast<BinFileReader*>(rp);
+  thread_local std::string k, v;
+  if (!r->Read(&k, &v)) return 0;
+  *key = k.data();
+  *klen = static_cast<uint32_t>(k.size());
+  *val = v.data();
+  *vlen = v.size();
+  return 1;
+}
+
+void st_reader_close(void* r) { delete static_cast<BinFileReader*>(r); }
+
+void* st_loader_open(const char* path, int prefetch, int shuffle,
+                     uint64_t seed, int rank, int world, int epochs) {
+  auto* l = new Loader(path, prefetch, shuffle != 0, seed, rank,
+                       world < 1 ? 1 : world, epochs);
+  if (!l->Init()) {
+    delete l;
+    return nullptr;
+  }
+  return l;
+}
+
+uint64_t st_loader_size(void* lp) {
+  return static_cast<Loader*>(lp)->NumRecords();
+}
+
+int st_loader_next(void* lp, const char** key, uint32_t* klen,
+                   const char** val, uint64_t* vlen) {
+  thread_local Record r;
+  if (!static_cast<Loader*>(lp)->Next(&r)) return 0;
+  *key = r.key.data();
+  *klen = static_cast<uint32_t>(r.key.size());
+  *val = r.value.data();
+  *vlen = r.value.size();
+  return 1;
+}
+
+void st_loader_close(void* l) { delete static_cast<Loader*>(l); }
+
+uint32_t st_crc32(const void* data, uint64_t n) {
+  return singa_tpu::Crc32(data, n);
+}
+
+void st_log(int severity, const char* file, int line, const char* msg) {
+  singa_tpu::LogMessage(static_cast<singa_tpu::Severity>(severity), file,
+                        line, msg);
+}
+
+void st_set_log_level(int level) { singa_tpu::SetLogLevel(level); }
+void st_set_log_file(const char* path) { singa_tpu::SetLogFile(path); }
+
+uint64_t st_now_ns() { return singa_tpu::NowNs(); }
+
+void* st_channel_get(const char* name) {
+  return singa_tpu::GetChannel(name);
+}
+
+void st_channel_send(void* ch, const char* msg) {
+  static_cast<singa_tpu::Channel*>(ch)->Send(msg);
+}
+
+void st_channel_stderr(void* ch, int flag) {
+  static_cast<singa_tpu::Channel*>(ch)->EnableDestStderr(flag != 0);
+}
+
+void st_channel_file(void* ch, const char* path) {
+  auto* c = static_cast<singa_tpu::Channel*>(ch);
+  if (path && path[0])
+    c->EnableDestFile(path);
+  else
+    c->DisableDestFile();
+}
+}

@@ -1,0 +1,57 @@
+#include "singa_tpu/logging.h"
+
+#include <atomic>
+#include <cstdio>
+#include <cstdlib>
+#include <ctime>
+#include <mutex>
+
+namespace singa_tpu {
+
+namespace {
+std::atomic<int> g_min_severity{1};  // Info
+std::mutex g_mu;
+FILE* g_file = nullptr;
+const char kLetters[] = "DIWEF";
+}  // namespace
+
+void SetLogLevel(int min_severity) { g_min_severity = min_severity; }
+int GetLogLevel() { return g_min_severity; }
+
+void SetLogFile(const std::string& path) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (g_file) {
+    fclose(g_file);
+    g_file = nullptr;
+  }
+  if (!path.empty()) g_file = fopen(path.c_str(), "a");
+}
+
+void LogMessage(Severity s, const char* file, int line,
+                const std::string& msg) {
+  int sev = static_cast<int>(s);
+  if (sev < 0) sev = 0;
+  if (sev > 4) sev = 4;
+  if (sev < g_min_severity && s != Severity::kFatal) return;
+  char head[96];
+  std::time_t t = std::time(nullptr);
+  std::tm tm;
+  localtime_r(&t, &tm);
+  const char* base = file;
+  for (const char* p = file; *p; ++p)
+    if (*p == '/') base = p + 1;
+  snprintf(head, sizeof(head), "%c%02d%02d %02d:%02d:%02d %s:%d] ",
+           kLetters[sev], tm.tm_mon + 1, tm.tm_mday, tm.tm_hour, tm.tm_min,
+           tm.tm_sec, base, line);
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    fprintf(stderr, "%s%s\n", head, msg.c_str());
+    if (g_file) {
+      fprintf(g_file, "%s%s\n", head, msg.c_str());
+      fflush(g_file);
+    }
+  }
+  if (s == Severity::kFatal) std::abort();
+}
+
+}  // namespace singa_tpu

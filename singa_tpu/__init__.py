@@ -19,6 +19,7 @@ from . import autograd  # noqa: F401
 from . import data  # noqa: F401
 from . import device  # noqa: F401
 from . import initializer  # noqa: F401
+from . import io  # noqa: F401
 from . import layer  # noqa: F401
 from . import loss  # noqa: F401
 from . import metric  # noqa: F401

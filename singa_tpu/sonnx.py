@@ -68,6 +68,11 @@ def to_numpy(tp: P.TensorProto) -> np.ndarray:
     shape = tuple(tp.dims)
     if tp.raw_data:
         return np.frombuffer(tp.raw_data, dtype=dtype).reshape(shape).copy()
+    if tp.int32_data and tp.data_type == P.TensorProto.FLOAT16:
+        # The ONNX spec stores fp16 as raw bit patterns in int32_data;
+        # reinterpret, don't numerically cast.
+        return (np.asarray(tp.int32_data, np.int32).astype(np.uint16)
+                .view(np.float16).reshape(shape))
     if tp.float_data:
         return np.asarray(tp.float_data, np.float32).astype(dtype).reshape(shape)
     if tp.int64_data:
@@ -77,6 +82,14 @@ def to_numpy(tp: P.TensorProto) -> np.ndarray:
     if tp.double_data:
         return np.asarray(tp.double_data, np.float64).astype(dtype).reshape(shape)
     return np.zeros(shape, dtype)
+
+
+def _elem_type(dtype) -> int:
+    """ONNX elem_type for a value-info dtype; bf16 maps to BFLOAT16=16
+    (it is not in _NP2ONNX since numpy has no native bfloat16)."""
+    if str(dtype) == "bfloat16":
+        return P.TensorProto.BFLOAT16
+    return _NP2ONNX[np.dtype(dtype)]
 
 
 def _attr(node: P.NodeProto, name: str, default=None):
@@ -397,7 +410,7 @@ def to_onnx(model, inputs: Sequence[Tensor],
         names[id(t)] = f"input_{i}"
         vi = g.input.add()
         vi.name = f"input_{i}"
-        vi.type.tensor_type.elem_type = _NP2ONNX[np.dtype(t.dtype)]
+        vi.type.tensor_type.elem_type = _elem_type(t.dtype)
         for d in t.shape:
             vi.type.tensor_type.shape.dim.add().dim_value = d
 
@@ -433,7 +446,7 @@ def to_onnx(model, inputs: Sequence[Tensor],
               if t.creator is not None else _in_name(t))
         vo = g.output.add()
         vo.name = nm
-        vo.type.tensor_type.elem_type = _NP2ONNX[np.dtype(t.dtype)]
+        vo.type.tensor_type.elem_type = _elem_type(t.dtype)
         for d in t.shape:
             vo.type.tensor_type.shape.dim.add().dim_value = d
     return mp
@@ -557,14 +570,23 @@ def _import_reshape(ctx, node):
     return autograd.reshape(x, shape)
 
 
+def _req_const(ctx, node, idx, what) -> np.ndarray:
+    c = ctx.const(node.input[idx])
+    if c is None:
+        raise ValueError(
+            f"sonnx: {node.op_type} with a runtime-computed {what} is "
+            "unsupported (must be a constant/initializer)")
+    return c
+
+
 def _import_slice(ctx, node):
     x = ctx.tensor(node.input[0])
     if len(node.input) > 1:
-        starts = ctx.const(node.input[1]).tolist()
-        ends = ctx.const(node.input[2]).tolist()
-        axes = (ctx.const(node.input[3]).tolist()
+        starts = _req_const(ctx, node, 1, "starts").tolist()
+        ends = _req_const(ctx, node, 2, "ends").tolist()
+        axes = (_req_const(ctx, node, 3, "axes").tolist()
                 if len(node.input) > 3 and node.input[3] else None)
-        steps = (ctx.const(node.input[4]).tolist()
+        steps = (_req_const(ctx, node, 4, "steps").tolist()
                  if len(node.input) > 4 and node.input[4] else None)
     else:
         starts = _attr(node, "starts")
@@ -719,19 +741,19 @@ _IMPORTERS = {
     "Slice": _import_slice,
     "Split": lambda ctx, n: autograd.SplitOp(
         _attr(n, "axis", 0),
-        (ctx.const(n.input[1]).tolist() if len(n.input) > 1
+        (_req_const(ctx, n, 1, "split sizes").tolist() if len(n.input) > 1
          else _attr(n, "split")))(ctx.tensor(n.input[0])),
     "Gather": lambda ctx, n: autograd.Gather(
         _attr(n, "axis", 0), ctx.tensor(n.input[1]))(ctx.tensor(n.input[0])),
     "Tile": lambda ctx, n: autograd.Tile(
-        ctx.const(n.input[1]).tolist())(ctx.tensor(n.input[0])),
+        _req_const(ctx, n, 1, "repeats").tolist())(ctx.tensor(n.input[0])),
     "Squeeze": lambda ctx, n: autograd.Squeeze(
         _axes_arg(ctx, n))(ctx.tensor(n.input[0])),
     "Unsqueeze": lambda ctx, n: autograd.Unsqueeze(
         _axes_arg(ctx, n))(ctx.tensor(n.input[0])),
     "Pad": _import_pad,
     "Expand": lambda ctx, n: autograd.Expand(
-        ctx.const(n.input[1]).tolist())(ctx.tensor(n.input[0])),
+        _req_const(ctx, n, 1, "shape").tolist())(ctx.tensor(n.input[0])),
     "DepthToSpace": lambda ctx, n: autograd.DepthToSpace(
         _attr(n, "blocksize"), _attr(n, "mode", "DCR"))(
         ctx.tensor(n.input[0])),

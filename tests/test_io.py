@@ -1,0 +1,135 @@
+"""Native runtime tests (reference: test/gtest/test_binfile_rw.cc,
+test_snapshot.cc, test_channel.cc, test_logging.cc — SURVEY.md §4.1 —
+driven through the ctypes binding)."""
+import numpy as np
+import pytest
+
+from singa_tpu import io
+
+
+class TestBinFile:
+    def test_roundtrip(self, tmp_path):
+        p = str(tmp_path / "data.bin")
+        with io.BinFileWriter(p) as w:
+            w.write("a", b"hello")
+            w.write("b", np.arange(4, dtype=np.float32).tobytes())
+            w.write("empty", b"")
+        got = dict(io.BinFileReader(p))
+        assert got["a"] == b"hello"
+        np.testing.assert_array_equal(
+            np.frombuffer(got["b"], np.float32), [0, 1, 2, 3])
+        assert got["empty"] == b""
+
+    def test_append_mode(self, tmp_path):
+        p = str(tmp_path / "data.bin")
+        with io.BinFileWriter(p) as w:
+            w.write("x", b"1")
+        with io.BinFileWriter(p, mode="a") as w:
+            w.write("y", b"2")
+        assert [k for k, _ in io.BinFileReader(p)] == ["x", "y"]
+
+    def test_bad_magic_rejected(self, tmp_path):
+        p = str(tmp_path / "junk.bin")
+        with open(p, "wb") as f:
+            f.write(b"not a binfile at all")
+        with pytest.raises(IOError):
+            io.BinFileReader(p)
+
+    def test_crc32_known_value(self):
+        # CRC-32 (IEEE 802.3) of "123456789" is 0xCBF43926.
+        assert io.crc32(b"123456789") == 0xCBF43926
+
+
+class TestLoader:
+    def _make(self, tmp_path, n=20):
+        p = str(tmp_path / "ds.bin")
+        with io.BinFileWriter(p) as w:
+            for i in range(n):
+                w.write(f"k{i:03d}", bytes([i]))
+        return p
+
+    def test_full_epoch(self, tmp_path):
+        p = self._make(tmp_path)
+        with io.Loader(p, shuffle=False) as ld:
+            assert len(ld) == 20
+            items = list(ld)
+        assert [k for k, _ in items] == [f"k{i:03d}" for i in range(20)]
+
+    def test_shuffle_is_seeded_permutation(self, tmp_path):
+        p = self._make(tmp_path)
+        with io.Loader(p, shuffle=True, seed=7) as ld:
+            a = [k for k, _ in ld]
+        with io.Loader(p, shuffle=True, seed=7) as ld:
+            b = [k for k, _ in ld]
+        assert a == b
+        assert sorted(a) == [f"k{i:03d}" for i in range(20)]
+        assert a != sorted(a)  # actually shuffled
+
+    def test_sharding_disjoint_and_complete(self, tmp_path):
+        p = self._make(tmp_path)
+        seen = []
+        for rank in range(4):
+            with io.Loader(p, shuffle=False, rank=rank, world=4) as ld:
+                seen.extend(k for k, _ in ld)
+        assert sorted(seen) == [f"k{i:03d}" for i in range(20)]
+
+    def test_multiple_epochs(self, tmp_path):
+        p = self._make(tmp_path, n=5)
+        with io.Loader(p, shuffle=False, epochs=3) as ld:
+            assert len(list(ld)) == 15
+
+
+class TestChannel:
+    def test_file_sink(self, tmp_path):
+        f = str(tmp_path / "train.log")
+        ch = io.get_channel("train")
+        ch.enable_dest_file(f)
+        ch.send("epoch 0 loss 1.0")
+        ch.send("epoch 1 loss 0.5")
+        ch.disable_dest_file()
+        with open(f) as fh:
+            lines = fh.read().strip().splitlines()
+        assert lines == ["epoch 0 loss 1.0", "epoch 1 loss 0.5"]
+
+    def test_registry_returns_same_channel(self):
+        assert io.get_channel("x")._h == io.get_channel("x")._h
+
+
+class TestLogging:
+    def test_log_file(self, tmp_path):
+        f = str(tmp_path / "log.txt")
+        io.set_log_file(f)
+        io.log(2, "something happened")
+        io.set_log_file("")
+        with open(f) as fh:
+            content = fh.read()
+        assert "something happened" in content
+        assert content.startswith("W")  # severity letter
+
+    def test_now_ns_monotonic(self):
+        a = io.now_ns()
+        b = io.now_ns()
+        assert b >= a > 0
+
+
+class TestImageTransforms:
+    def test_crop(self):
+        img = np.arange(2 * 4 * 4, dtype=np.float32).reshape(2, 4, 4)
+        out = io.image_crop(img, 1, 1, 2, 2)
+        np.testing.assert_array_equal(out, img[:, 1:3, 1:3])
+
+    def test_crop_out_of_bounds(self):
+        img = np.zeros((1, 4, 4), np.float32)
+        with pytest.raises(ValueError):
+            io.image_crop(img, 3, 3, 2, 2)
+
+    def test_hflip(self):
+        img = np.arange(1 * 2 * 3, dtype=np.float32).reshape(1, 2, 3)
+        np.testing.assert_array_equal(io.image_hflip(img), img[:, :, ::-1])
+
+    def test_normalize(self):
+        img = np.ones((3, 2, 2), np.float32)
+        out = io.image_normalize(img, [1.0, 0.0, 0.5], [1.0, 2.0, 0.5])
+        np.testing.assert_allclose(out[0], 0.0)
+        np.testing.assert_allclose(out[1], 0.5)
+        np.testing.assert_allclose(out[2], 1.0)
