@@ -1,0 +1,51 @@
+"""MPI-launched data-parallel training (launch topology #2).
+
+Reference parity: `examples/cnn/train_mpi.py` — `mpiexec -n N python
+train_mpi.py`; the Communicator's MPI ctor derives rank/size from
+MPI_Comm_rank and broadcasts the ncclUniqueId.
+
+TPU-native redesign: rank/size come from the launcher's environment
+(OMPI_COMM_WORLD_RANK/SIZE under mpiexec, or SLURM_PROCID/NTASKS under
+srun — the standard TPU-pod pattern where each host runs one
+controller), then it is the same multi-controller mesh training as
+train_multiprocess.py. `jax.distributed.initialize()` with no
+arguments auto-detects these launchers where supported; explicit env
+wiring below keeps it deterministic.
+
+Run: mpiexec -n 2 python train_mpi.py --steps 20
+     (or: SINGA_TPU_PROC_ID=r SINGA_TPU_NUM_PROCS=n python train_mpi.py)
+"""
+import argparse
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def detect_rank_world():
+    for rk, wk in (("OMPI_COMM_WORLD_RANK", "OMPI_COMM_WORLD_SIZE"),
+                   ("PMI_RANK", "PMI_SIZE"),
+                   ("SLURM_PROCID", "SLURM_NTASKS"),
+                   ("SINGA_TPU_PROC_ID", "SINGA_TPU_NUM_PROCS")):
+        if rk in os.environ:
+            return int(os.environ[rk]), int(os.environ[wk])
+    return 0, 1
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--coordinator", default="127.0.0.1:9931")
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--batch-per-rank", type=int, default=16)
+    ap.add_argument("--lr", type=float, default=0.05)
+    a = ap.parse_args()
+
+    rank, world = detect_rank_world()
+    sys.path.insert(0, _HERE)
+    from train_multiprocess import worker
+
+    worker(rank, world, a.coordinator, a.steps, a.batch_per_rank, a.lr)
+
+
+if __name__ == "__main__":
+    main()

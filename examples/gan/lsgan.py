@@ -1,0 +1,50 @@
+"""Least-squares GAN (LSGAN) on the eight-gaussians ring.
+
+Reference parity: `examples/gan/lsgan.py` — same trainer as vanilla
+with the BCE losses replaced by least-squares objectives
+(D: (D(x)-1)^2 + D(G(z))^2, G: (D(G(z))-1)^2), which avoids vanishing
+gradients from a saturated discriminator.
+
+Run: python lsgan.py [--iters N]
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _HERE)
+sys.path.insert(0, os.path.abspath(os.path.join(_HERE, "..", "..")))
+
+from singa_tpu import autograd, tensor  # noqa: E402
+import vanilla  # noqa: E402
+
+
+def d_loss_ls(d_real, d_fake):
+    ones = tensor.from_numpy(np.ones(d_real.shape, np.float32))
+    return autograd.add(
+        autograd.mse_loss(d_real, ones),
+        autograd.mse_loss(d_fake,
+                          tensor.from_numpy(
+                              np.zeros(d_fake.shape, np.float32))))
+
+
+def g_loss_ls(d_fake):
+    ones = tensor.from_numpy(np.ones(d_fake.shape, np.float32))
+    return autograd.mse_loss(d_fake, ones)
+
+
+def run(iters=600, batch=128, lr=5e-3, verbose=True):
+    return vanilla.run(iters=iters, batch=batch, lr=lr,
+                       d_loss=d_loss_ls, g_loss=g_loss_ls,
+                       verbose=verbose)
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--iters", type=int, default=600)
+    p.add_argument("--batch", type=int, default=128)
+    p.add_argument("--lr", type=float, default=5e-3)
+    a = p.parse_args()
+    run(a.iters, a.batch, a.lr)

@@ -190,7 +190,11 @@ class CppCPU(Device):
 
     def __init__(self, jax_device=None):
         if jax_device is None:
-            jax_device = _backend_devices("cpu")[0]
+            # Local, not global: under multi-controller launch
+            # (train_multiprocess/train_mpi), jax.devices() lists other
+            # processes' devices too, and the host device must be one
+            # this process can address.
+            jax_device = jax.local_devices(backend="cpu")[0]
         super().__init__(jax_device, lang="cpp")
 
 

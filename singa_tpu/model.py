@@ -82,8 +82,13 @@ class Model(Layer):
         # a remote TPU); run it on the host XLA CPU backend instead and
         # migrate the created params over. Threefry RNG is
         # backend-deterministic, so init values are identical.
-        if (dev is not None and dev.lang != "cpp" and inputs
-                and not self.param_tensors()):
+        needs_host_init = (
+            inputs and not self.param_tensors()
+            and ((dev is not None and dev.lang != "cpp")
+                 or mesh is not None
+                 or any(not getattr(t.data, "is_fully_addressable", True)
+                        for t in inputs)))
+        if needs_host_init:
             self._host_init_forward(inputs, dev)
         else:
             # Params already exist (a forward ran before compile) or
@@ -99,26 +104,37 @@ class Model(Layer):
     def _host_init_forward(self, inputs, dev):
         """Run the param-init forward on host CPU, borrowing `dev`'s RNG
         stream so `dev.SetRandSeed(...)` still governs init values, then
-        move every created param/state onto `dev`."""
+        move every created param/state onto `dev`.
+
+        Multi-controller inputs (global arrays spanning processes) are
+        replaced by their local shard for this pass — lazy init only
+        reads feature dims, which batch shardings leave whole.
+        """
         from .device import get_default_device
 
         cpu = get_default_device()
-        saved_cpu_key = cpu._rng_key
-        cpu._rng_key = jax.device_put(dev._rng_key, cpu.jax_device)
+        borrow = dev is not None and dev is not cpu
+        if borrow:
+            saved_cpu_key = cpu._rng_key
+            cpu._rng_key = jax.device_put(dev._rng_key, cpu.jax_device)
         try:
             host_inputs = []
             for t in inputs:
+                arr = t.data
+                if not getattr(arr, "is_fully_addressable", True):
+                    arr = arr.addressable_shards[0].data
                 h = t.clone()
-                h.data = jax.device_put(np.asarray(t.to_numpy()),
-                                        cpu.jax_device)
+                h.data = jax.device_put(np.asarray(arr), cpu.jax_device)
                 h.device = cpu
                 host_inputs.append(h)
             self.forward(*host_inputs)
         finally:
-            dev._rng_key = jax.device_put(cpu._rng_key, dev.jax_device)
-            cpu._rng_key = saved_cpu_key
-        for t in self.param_tensors() + self.state_tensors():
-            t.to_device(dev)
+            if borrow:
+                dev._rng_key = jax.device_put(cpu._rng_key, dev.jax_device)
+                cpu._rng_key = saved_cpu_key
+        if dev is not None and dev is not cpu:
+            for t in self.param_tensors() + self.state_tensors():
+                t.to_device(dev)
 
     def train(self, mode: bool = True):
         self.training = mode

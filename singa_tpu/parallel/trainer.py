@@ -17,6 +17,7 @@ from __future__ import annotations
 from typing import List, Optional, Sequence
 
 import jax
+import numpy as np
 
 from ..model import _JitStep
 from .sharding import ShardingRules, batch_sharding, replicated
@@ -46,8 +47,24 @@ class ShardedJitStep(_JitStep):
         self._param_names = {
             id(t): n for n, t in model.get_params().items()
         }
+        # Multi-controller: the mesh spans devices of other processes
+        # (launch topologies train_multiprocess.py / train_mpi.py).
+        self._multiproc = any(
+            d.process_index != jax.process_index()
+            for d in np.asarray(mesh.devices).flat)
         self._ensure_opt_slots()
         self._place()
+
+    def _gput(self, v, sh):
+        """device_put that works across controllers: a single-device
+        committed array cannot be copied onto non-addressable devices,
+        so bridge through the host value (every controller holds the
+        same value by construction — same seed, same updates)."""
+        if getattr(v, "sharding", None) == sh:
+            return v
+        if self._multiproc and getattr(v, "is_fully_addressable", True):
+            v = np.asarray(v)
+        return jax.device_put(v, sh)
 
     # -- sharding tables ---------------------------------------------------
     def _param_shardings(self) -> List:
@@ -94,34 +111,38 @@ class ShardedJitStep(_JitStep):
         """Lay existing (single-device) param/state/opt arrays out on
         the mesh so the first compiled step starts sharded."""
         for p, sh in zip(self.params, self._param_shardings()):
-            p.data = jax.device_put(p.data, sh)
+            p.data = self._gput(p.data, sh)
         rep = replicated(self.mesh)
         for s in self.states:
-            s.data = jax.device_put(s.data, rep)
+            s.data = self._gput(s.data, rep)
         if self.opt is not None:
             arrays = self._opt_arrays()
             shs = self._opt_shardings()
             self._bind_opt_arrays(
-                [jax.device_put(a, sh) for a, sh in zip(arrays, shs)]
+                [self._gput(a, sh) for a, sh in zip(arrays, shs)]
             )
 
     def _prepare_inputs(self, pvals, svals, ovals, key, batch_arrays):
         """device_put everything to its mesh layout (no-op for arrays
         already placed — users may rebind p.data to host arrays)."""
         rep = replicated(self.mesh)
-        pvals = [jax.device_put(v, s)
+        pvals = [self._gput(v, s)
                  for v, s in zip(pvals, self._param_shardings())]
-        svals = [jax.device_put(v, rep) for v in svals]
-        ovals = [jax.device_put(v, s)
+        svals = [self._gput(v, rep) for v in svals]
+        ovals = [self._gput(v, s)
                  for v, s in zip(ovals, self._opt_shardings())]
-        key = jax.device_put(key, rep)
+        key = self._gput(key, rep)
         batch_arrays = tuple(
-            jax.device_put(b, s)
+            self._gput(b, s)
             for b, s in zip(batch_arrays, self._batch_shardings(batch_arrays))
         )
         return pvals, svals, ovals, key, batch_arrays
 
     def _restore_key(self, new_key, dev):
+        if not getattr(new_key, "is_fully_addressable", True):
+            # Replicated over a multi-controller mesh: every process
+            # holds the full value in its local shard; pull that.
+            new_key = new_key.addressable_shards[0].data
         return jax.device_put(new_key, dev.jax_device)
 
     # -- jit wiring --------------------------------------------------------

@@ -1,0 +1,31 @@
+"""GAN example smoke tests (reference: examples/gan/{vanilla,lsgan}.py
+— SURVEY.md §2.3). Few iterations; asserts the generator's samples
+move from the origin toward the data ring (radius 1)."""
+import importlib.util
+import os
+import sys
+
+
+def _load(name):
+    d = os.path.join(os.path.dirname(__file__), "..", "examples", "gan")
+    if d not in sys.path:
+        sys.path.insert(0, d)
+    path = os.path.join(d, name + ".py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_vanilla_gan_moves_toward_ring():
+    mod = _load("vanilla")
+    r = mod.run(iters=150, batch=64, verbose=False)
+    assert 0.3 < r < 2.5
+
+
+def test_lsgan_moves_toward_ring():
+    _load("vanilla")
+    mod = _load("lsgan")
+    r = mod.run(iters=150, batch=64, verbose=False)
+    assert 0.3 < r < 2.5

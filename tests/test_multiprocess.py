@@ -1,0 +1,43 @@
+"""Multi-controller launch topologies (reference: the two distributed
+launch modes, examples/cnn/{train_multiprocess,train_mpi}.py —
+SURVEY.md §2.3 "Distributed CNN"). Spawns real worker processes that
+bootstrap jax.distributed over a coordinator, form a global 2-device
+mesh, and train with XLA-inserted gradient reductions."""
+import os
+import socket
+import subprocess
+import sys
+
+
+_EX = os.path.join(os.path.dirname(__file__), "..", "examples", "cnn")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_dp_training():
+    out = subprocess.run(
+        [sys.executable, os.path.join(_EX, "train_multiprocess.py"),
+         "--world", "2", "--steps", "8", "--coordinator",
+         f"127.0.0.1:{_free_port()}"],
+        capture_output=True, text=True, timeout=220,
+        env={**os.environ, "JAX_PLATFORMS": "", "XLA_FLAGS": ""},
+    )
+    assert "DONE" in out.stdout, out.stdout + out.stderr
+    losses = [float(line.split()[-1]) for line in out.stdout.splitlines()
+              if line.startswith("step")]
+    assert len(losses) >= 2 and losses[-1] < losses[0]
+
+
+def test_mpi_style_env_detection_single_rank():
+    out = subprocess.run(
+        [sys.executable, os.path.join(_EX, "train_mpi.py"),
+         "--steps", "4", "--coordinator", f"127.0.0.1:{_free_port()}"],
+        capture_output=True, text=True, timeout=220,
+        env={**os.environ, "JAX_PLATFORMS": "", "XLA_FLAGS": "",
+             "SINGA_TPU_PROC_ID": "0", "SINGA_TPU_NUM_PROCS": "1"},
+    )
+    assert "DONE" in out.stdout, out.stdout + out.stderr
