@@ -1,40 +1,363 @@
 """Headline benchmark: ResNet-50 synthetic-ImageNet throughput, one chip.
 
-Driver contract: print ONE JSON line
+Driver contract: print ONE JSON line on stdout
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
-The reference (mlinking/singa) publishes no in-tree numbers
-(BASELINE.md); its measurement tool is `examples/cnn/benchmark.py`
-(synthetic-data ResNet-50 images/sec). `vs_baseline` is therefore
-computed against an estimated V100 figure for SINGA-class frameworks
-(ResNet-50 fp32/amp, bs32, ~360 img/s) — the best available stand-in
-until a measured reference number exists.
+Reference: `examples/cnn/benchmark.py` is the tool that DEFINES the
+reference's headline metric (synthetic-data ResNet-50 images/sec/chip;
+SURVEY.md §6). The reference publishes no in-tree numbers (BASELINE.md),
+so `vs_baseline` is computed against an estimated V100 figure for
+SINGA-class frameworks (ResNet-50, bs32, ~360 img/s).
+
+Round-2 redesign (VERDICT.md Weak #1): round 1 produced NO number —
+a 25-minute silent hang (the TPU tunnel dial blocks inside PJRT client
+init, where Python signal handlers never run). Therefore:
+
+  * every stage runs in a SUBPROCESS with a hard deadline enforced by
+    the parent (kill on expiry) — a hung tunnel costs one stage,
+    not the whole bench;
+  * per-step timings stream to stderr immediately (the driver captures
+    the tail, so even a timeout leaves a diagnosis trail);
+  * stages ramp up: devices probe -> ResNet-50 bs16 -> bs64 -> bs128,
+    each flushing its result; the final JSON reports the best measured
+    throughput no matter which stage died;
+  * compile time and steady-state step time are reported separately;
+  * MFU is computed from an analytic ResNet-50 flop model vs the
+    chip's peak (v5e: 197 TFLOP/s bf16) — the honest single-chip
+    utilization metric given no published reference number;
+  * a persistent XLA compilation cache (.jax_cache/) makes repeat runs
+    skip the remote compile entirely.
+
+Usage:
+  python bench.py            # full staged bench (global deadline)
+  python bench.py --smoke    # <=2 min TPU smoke test (VERDICT next #2)
+  python bench.py --stage X  # internal: run one stage in-process
 """
+import argparse
 import json
 import os
+import signal
+import subprocess
 import sys
+import time
 
-sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                "examples", "cnn"))
+HERE = os.path.dirname(os.path.abspath(__file__))
 
-# Estimated reference throughput (see module docstring / BASELINE.md).
-REF_V100_IPS = 360.0
+REF_V100_IPS = 360.0          # estimated SINGA-class V100 img/s (BASELINE.md)
+PEAK_FLOPS = {                # per-chip peak dense bf16 FLOP/s
+    "v5e": 197e12, "v5litepod": 197e12,
+    "v5p": 459e12, "v4": 275e12, "v6e": 918e12,
+}
+# ResNet-50 @224: ~4.09e9 fwd FLOPs/image (MACs x2); training step
+# (fwd + bwd) ~= 3x fwd.
+RESNET50_TRAIN_FLOPS_PER_IMG = 3 * 4.09e9
+
+
+def log(msg):
+    print(f"[bench {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
+          flush=True)
+
+
+def _chip_peak():
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "")
+    acc = os.environ.get("TPU_ACCELERATOR_TYPE", "")
+    for key, peak in PEAK_FLOPS.items():
+        if key in gen or key in acc:
+            return peak, (gen or acc or "unknown")
+    return PEAK_FLOPS["v5e"], (gen or acc or "assumed-v5e")
+
+
+# ===========================================================================
+# Stages (run in a child process; parent enforces the deadline)
+# ===========================================================================
+def _setup_jax():
+    import jax
+
+    # BENCH_PLATFORM=cpu lets the staged bench run on the XLA CPU
+    # backend (mechanics validation / CI). Must go through jax.config +
+    # clear_backends: this image's sitecustomize force-registers the
+    # "axon" TPU plugin and overrides JAX_PLATFORMS env (see
+    # tests/conftest.py).
+    plat = os.environ.get("BENCH_PLATFORM")
+    if plat:
+        from jax.extend.backend import clear_backends
+
+        jax.config.update("jax_platforms", plat)
+        clear_backends()
+
+    cache = os.path.join(HERE, ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception as e:  # older jax spellings; cache is best-effort
+        log(f"compile cache unavailable: {e!r}")
+    return jax
+
+
+def stage_probe():
+    """Connect to the chip and run one tiny matmul. Proves the tunnel."""
+    jax = _setup_jax()
+    t0 = time.time()
+    devs = jax.devices()
+    log(f"devices ({time.time() - t0:.1f}s): {devs}")
+    import jax.numpy as jnp
+
+    t0 = time.time()
+    x = jnp.ones((1024, 1024), jnp.bfloat16)
+    y = (x @ x).block_until_ready()
+    log(f"1k matmul compile+run: {time.time() - t0:.1f}s")
+    t0 = time.time()
+    for _ in range(8):
+        y = y @ x
+    y.block_until_ready()
+    log(f"8 cached matmuls: {time.time() - t0:.3f}s")
+    print(json.dumps({"ok": True, "platform": devs[0].platform}), flush=True)
+
+
+def stage_smoke():
+    """MLP + small CNN train steps on the chip, per-phase timing.
+    The <=2-minute TPU breakage detector (VERDICT next-round #2)."""
+    import numpy as np
+
+    _setup_jax()
+    sys.path.insert(0, os.path.join(HERE, "examples", "cnn"))
+    sys.path.insert(0, os.path.join(HERE, "examples", "cnn", "model"))
+    from singa_tpu import device, layer, model, opt, tensor
+
+    dev = device.create_tpu_device()
+    dev.SetRandSeed(0)
+    log(f"device up: {dev}")
+
+    class _MLP(model.Model):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = layer.Linear(256)
+            self.relu = layer.ReLU()
+            self.fc2 = layer.Linear(10)
+
+        def forward(self, x):
+            return self.fc2(self.relu(self.fc1(x)))
+
+    rs = np.random.RandomState(0)
+    phases = {}
+    m = _MLP()
+    m.set_optimizer(opt.SGD(lr=0.05, momentum=0.9))
+    tx = tensor.from_numpy(rs.randn(64, 784).astype(np.float32), device=dev)
+    ty = tensor.from_numpy(rs.randint(0, 10, 64).astype(np.int32),
+                           device=dev)
+    t0 = time.time()
+    m.compile([tx], is_train=True, use_graph=True)
+    phases["mlp_compile_host_s"] = round(time.time() - t0, 2)
+    t0 = time.time()
+    out, loss = m(tx, ty)
+    loss.data.block_until_ready()
+    phases["mlp_first_step_s"] = round(time.time() - t0, 2)
+    t0 = time.time()
+    for _ in range(10):
+        out, loss = m(tx, ty)
+    loss.data.block_until_ready()
+    phases["mlp_10_steps_s"] = round(time.time() - t0, 3)
+    log(f"mlp: {phases}  loss={float(loss.to_numpy()):.3f}")
+
+    # small conv net, CIFAR shapes
+    import cnn as cnn_mod
+
+    m = cnn_mod.create_model(num_classes=10)
+    m.set_optimizer(opt.SGD(lr=0.05, momentum=0.9))
+    tx = tensor.from_numpy(rs.randn(32, 3, 32, 32).astype(np.float32),
+                           device=dev)
+    ty = tensor.from_numpy(rs.randint(0, 10, 32).astype(np.int32),
+                           device=dev)
+    t0 = time.time()
+    m.compile([tx], is_train=True, use_graph=True)
+    phases["cnn_compile_host_s"] = round(time.time() - t0, 2)
+    t0 = time.time()
+    out, loss = m(tx, ty)
+    loss.data.block_until_ready()
+    phases["cnn_first_step_s"] = round(time.time() - t0, 2)
+    t0 = time.time()
+    for _ in range(10):
+        out, loss = m(tx, ty)
+    loss.data.block_until_ready()
+    phases["cnn_10_steps_s"] = round(time.time() - t0, 3)
+    log(f"cnn: {phases}  loss={float(loss.to_numpy()):.3f}")
+    print(json.dumps({"ok": True, "phases": phases}), flush=True)
+
+
+def stage_resnet(batch, steps, deadline_s):
+    """ResNet-50 synthetic throughput at one batch size.
+
+    Streams one line per step; respects an internal soft deadline so a
+    slow chip still yields a partial measurement.
+    """
+    import numpy as np
+
+    _setup_jax()
+    sys.path.insert(0, os.path.join(HERE, "examples", "cnn"))
+    sys.path.insert(0, os.path.join(HERE, "examples", "cnn", "model"))
+    import resnet
+
+    from singa_tpu import device, opt, tensor
+
+    hard_stop = time.time() + deadline_s
+    dev = device.create_tpu_device()
+    dev.SetRandSeed(0)
+    log(f"device up: {dev}")
+    tensor.set_matmul_precision("default")
+
+    m = resnet.create_model(depth=50)
+    m.set_optimizer(opt.SGD(lr=0.1, momentum=0.9))
+    rs = np.random.RandomState(0)
+    x_np = rs.randn(batch, 3, 224, 224).astype(np.float32)
+    y_np = rs.randint(0, 1000, batch).astype(np.int32)
+    tx = tensor.from_numpy(x_np, device=dev)
+    ty = tensor.from_numpy(y_np, device=dev)
+    log(f"inputs on device (bs={batch})")
+
+    t0 = time.time()
+    m.compile([tx], is_train=True, use_graph=True)
+    host_compile = time.time() - t0
+    log(f"host trace/compile setup: {host_compile:.1f}s")
+
+    t0 = time.time()
+    out, loss = m(tx, ty)
+    loss.data.block_until_ready()
+    first_step = time.time() - t0
+    log(f"first step (XLA compile + run): {first_step:.1f}s")
+
+    times = []
+    for step in range(steps):
+        if time.time() > hard_stop and len(times) >= 3:
+            log(f"soft deadline hit after {len(times)} steps")
+            break
+        t0 = time.time()
+        out, loss = m(tx, ty)
+        loss.data.block_until_ready()
+        dt = time.time() - t0
+        times.append(dt)
+        log(f"bs{batch} step {step}: {dt * 1e3:.1f} ms "
+            f"({batch / dt:.1f} img/s)")
+    if not times:
+        print(json.dumps({"ok": False, "error": "no steps completed"}),
+              flush=True)
+        return
+    # Median step time: robust to one-off stragglers without inflating
+    # the published number the way a best-quartile mean would.
+    med = sorted(times)[len(times) // 2]
+    ips = batch / med
+    out = {"ok": True, "batch": batch, "ips": round(ips, 2),
+           "step_ms": round(1e3 * med, 2),
+           "compile_s": round(host_compile + first_step, 1),
+           "loss": round(float(loss.to_numpy()), 3)}
+    log(f"RESULT {out}")
+    print(json.dumps(out), flush=True)
+
+
+# ===========================================================================
+# Parent orchestration
+# ===========================================================================
+def run_stage(name, args, deadline):
+    """Run one stage in a child process; returns parsed JSON or None."""
+    cmd = [sys.executable, "-u", os.path.abspath(__file__),
+           "--stage", name] + args
+    log(f"stage {name} (deadline {deadline:.0f}s)")
+    t0 = time.time()
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, stderr=None,
+                            start_new_session=True, text=True)
+    try:
+        out, _ = proc.communicate(timeout=deadline)
+    except subprocess.TimeoutExpired:
+        log(f"stage {name} DEADLINE EXPIRED after {time.time() - t0:.0f}s "
+            "-> killing")
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        proc.wait()
+        return None
+    log(f"stage {name} rc={proc.returncode} in {time.time() - t0:.0f}s")
+    for line in reversed((out or "").strip().splitlines()):
+        try:
+            return json.loads(line)
+        except ValueError:
+            continue
+    return None
 
 
 def main():
-    from benchmark import run
+    p = argparse.ArgumentParser()
+    p.add_argument("--stage", help="internal: run one stage in-process")
+    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--deadline", type=float, default=420.0)
+    p.add_argument("--smoke", action="store_true",
+                   help="<=2min chip smoke test only")
+    a = p.parse_args()
 
-    batch = int(os.environ.get("BENCH_BATCH", "64"))
-    steps = int(os.environ.get("BENCH_STEPS", "16"))
-    ips = run(depth=50, batch_size=batch, steps=steps, warmup=4,
-              image_size=224, use_graph=True, precision="bf16",
-              verbose=False)
-    print(json.dumps({
-        "metric": "resnet50_images_per_sec_chip",
-        "value": round(ips, 2),
-        "unit": "img/s",
-        "vs_baseline": round(ips / REF_V100_IPS, 3),
-    }))
+    if a.stage == "probe":
+        return stage_probe()
+    if a.stage == "smoke":
+        return stage_smoke()
+    if a.stage == "resnet":
+        return stage_resnet(a.batch, a.steps, a.deadline)
+
+    global_deadline = time.time() + float(
+        os.environ.get("BENCH_DEADLINE", "1380"))  # default 23 min
+    peak, chip = _chip_peak()
+
+    def remaining():
+        return global_deadline - time.time()
+
+    if a.smoke:
+        probe = run_stage("probe", [], min(240, max(30, remaining())))
+        smoke = run_stage("smoke", [], min(420, max(30, remaining())))
+        ok = bool(probe and probe.get("ok") and smoke and smoke.get("ok"))
+        print(json.dumps({"metric": "tpu_smoke", "ok": ok,
+                          "probe": probe, "smoke": smoke}))
+        sys.exit(0 if ok else 1)
+
+    best = None
+    result_extra = {}
+    probe = run_stage("probe", [], min(270, max(30, remaining())))
+    if not (probe and probe.get("ok")):
+        # One retry: the first dial sometimes needs a cold tunnel warm-up.
+        log("probe failed; retrying once")
+        probe = run_stage("probe", [], min(270, max(30, remaining())))
+    if probe and probe.get("ok"):
+        plan = [(16, 12, 420), (64, 12, 420), (128, 12, 300)]
+        for batch, steps, dl in plan:
+            if remaining() < 90:
+                log("global deadline near; stopping ramp")
+                break
+            r = run_stage("resnet",
+                          ["--batch", str(batch), "--steps", str(steps),
+                           "--deadline", str(min(dl, remaining() - 30))],
+                          min(dl + 60, max(45, remaining() - 15)))
+            if r and r.get("ok"):
+                if best is None or r["ips"] > best["ips"]:
+                    best = r
+            else:
+                log(f"bs{batch} stage failed; stopping ramp")
+                break
+    else:
+        result_extra["error"] = "tpu_unreachable"
+
+    if best:
+        mfu = best["ips"] * RESNET50_TRAIN_FLOPS_PER_IMG / peak
+        out = {"metric": "resnet50_images_per_sec_chip",
+               "value": best["ips"], "unit": "img/s",
+               "vs_baseline": round(best["ips"] / REF_V100_IPS, 3),
+               "batch": best["batch"], "step_ms": best["step_ms"],
+               "compile_s": best["compile_s"],
+               "mfu": round(mfu, 4), "chip": chip}
+    else:
+        out = {"metric": "resnet50_images_per_sec_chip", "value": 0.0,
+               "unit": "img/s", "vs_baseline": 0.0, "chip": chip,
+               **result_extra}
+    with open(os.path.join(HERE, "BENCH_partial.json"), "w") as f:
+        json.dump(out, f)
+    print(json.dumps(out), flush=True)
 
 
 if __name__ == "__main__":

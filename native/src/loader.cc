@@ -96,6 +96,7 @@ class Loader {
       std::vector<size_t> order;
       for (size_t i = rank_; i < index_.size(); i += world_)
         order.push_back(i);
+      if (order.empty()) break;  // empty shard: don't busy-spin forever
       if (shuffle_) {
         std::mt19937_64 rng(seed_ + epoch);
         std::shuffle(order.begin(), order.end(), rng);

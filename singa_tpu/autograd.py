@@ -79,8 +79,21 @@ class Operator:
         self.requires_grad = any(t.requires_grad for t in xs)
         dev = xs[0].device if xs else None
         self.device = dev
+        # Under tracing, named_scope stamps the op's class name into
+        # XLA metadata (op_name) — how the graph-mode profiler maps
+        # fused HLO regions back to framework ops (hlo_profile.py).
+        # Eager dispatch (no tracers) skips it: the metadata is only
+        # consumed when traced into a program.
+        traced = any(isinstance(t.data, jax.core.Tracer) for t in xs)
         if dev is not None and dev._verbosity > 0:
             with dev.TimeOp(type(self).__name__):
+                if traced:
+                    with jax.named_scope(type(self).__name__):
+                        ys = self.forward(*[t.data for t in xs])
+                else:
+                    ys = self.forward(*[t.data for t in xs])
+        elif traced:
+            with jax.named_scope(type(self).__name__):
                 ys = self.forward(*[t.data for t in xs])
         else:
             ys = self.forward(*[t.data for t in xs])

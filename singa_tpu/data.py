@@ -32,21 +32,43 @@ class BatchIter:
     def __iter__(self) -> Iterator:
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
         _END = object()
+        closed = threading.Event()
 
         def worker():
+            # Propagate pipeline failures to the consumer instead of
+            # silently truncating the epoch; `closed` + put timeouts let
+            # the worker exit when the consumer abandons the iterator
+            # (a bounded q.put would otherwise block forever).
+            def put(item):
+                while not closed.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        return True
+                    except queue.Full:
+                        continue
+                return False
+
             try:
                 for item in self.source():
-                    q.put(item)
-            finally:
-                q.put(_END)
+                    if not put(item):
+                        return
+                put(_END)
+            except BaseException as e:  # noqa: BLE001 — re-raised in consumer
+                put((_END, e))
 
         t = threading.Thread(target=worker, daemon=True)
         t.start()
-        while True:
-            item = q.get()
-            if item is _END:
-                break
-            yield item
+        try:
+            while True:
+                item = q.get()
+                if item is _END:
+                    break
+                if isinstance(item, tuple) and len(item) == 2 \
+                        and item[0] is _END:
+                    raise item[1]
+                yield item
+        finally:
+            closed.set()
 
 
 def minibatches(x: np.ndarray, y: np.ndarray, batch_size: int,

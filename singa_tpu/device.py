@@ -22,7 +22,10 @@ What *is* kept from the reference Device API:
   - `SetVerbosity`/`PrintTimeProfiling`/`SetSkipIteration` — the per-op
     profiling table (reference: cudaEvent timing inside `Graph::Run`,
     `src/core/scheduler/scheduler.cc`); here backed by op-level wall
-    timing in eager mode and `jax.profiler` hooks in graph mode.
+    timing in eager mode, and in graph (jit) mode by measured step
+    times plus a per-HLO-instruction cost breakdown of the compiled
+    program (`hlo_profile.py`) — fused regions are attributed back to
+    framework ops via `jax.named_scope` metadata.
 """
 from __future__ import annotations
 
@@ -81,6 +84,10 @@ class Device:
         self._skip_iteration = 5
         self._op_times = collections.defaultdict(lambda: [0.0, 0])
         self._iteration = 0
+        # Graph-mode profiles: label -> {"rows": [...], "step_s": float}
+        # (filled by model._JitStep when verbosity > 0; see
+        # hlo_profile.py for the cost model).
+        self._graph_profiles = {}
 
     # ---- RNG ------------------------------------------------------------
     def SetRandSeed(self, seed: int) -> None:
@@ -143,7 +150,11 @@ class Device:
         return _OpTimer(self, name)
 
     def PrintTimeProfiling(self) -> str:
-        """Reference: `Device::PrintTimeProfiling` — per-op time table."""
+        """Reference: `Device::PrintTimeProfiling` — per-op time table.
+
+        Eager ops report measured wall times; graph (jit) runs report
+        the measured step time plus the compiled program's per-op XLA
+        cost breakdown (hlo_profile.py)."""
         lines = ["Time Profiling:"]
         total = sum(t for t, _ in self._op_times.values())
         for name, (t, n) in sorted(
@@ -155,11 +166,17 @@ class Device:
                 f"  OP = {name:<28} Time = {avg_us:10.3f} us x {n:<6d} ({pct:5.1f}%)"
             )
         out = "\n".join(lines)
+        for label, prof in self._graph_profiles.items():
+            from . import hlo_profile
+
+            out += f"\n[{label}]\n" + hlo_profile.format_table(
+                prof["rows"], prof.get("step_s"))
         print(out)
         return out
 
     def ResetTimeProfiling(self) -> None:
         self._op_times.clear()
+        self._graph_profiles.clear()
         self._iteration = 0
 
     # ---- Misc ------------------------------------------------------------

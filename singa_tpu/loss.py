@@ -52,14 +52,15 @@ class SoftmaxCrossEntropy(Loss):
 
 
 class SquaredError(Loss):
-    """Reference: `loss.SquaredError` — 0.5 * (x - t)^2, batch mean."""
+    """Reference: `loss.SquaredError` — batch mean of 0.5*||x - t||^2.
+
+    `autograd.mse_loss` already computes sum((x-t)^2)/(2*batch)
+    (autograd.py MeanSquareError), i.e. the 0.5 factor is built in, so
+    it is returned as-is."""
 
     def forward(self, x: Tensor, t: Tensor) -> Tensor:
         x.requires_grad = True
-        l = autograd.mul(
-            autograd.mse_loss(x, t),
-            0.5,
-        )
+        l = autograd.mse_loss(x, t)
         self._last = (x, l)
         return l
 

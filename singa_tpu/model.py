@@ -24,6 +24,7 @@ from __future__ import annotations
 import io
 import json
 import os
+import time
 import zipfile
 from contextlib import contextmanager
 from typing import Dict, List, Optional
@@ -448,6 +449,7 @@ class _JitStep:
         self.states: List[Tensor] = model.state_tensors()
         self.opt = model._optimizer
         self._compiled = None
+        self._hlo_rows = None  # graph-profile cache (hlo_profile.py)
 
     # ---- optimizer state flattening -------------------------------------
     def _opt_arrays(self):
@@ -554,9 +556,37 @@ class _JitStep:
         pvals, svals, ovals, key, batch_arrays = self._prepare_inputs(
             pvals, svals, ovals, dev._rng_key, batch_arrays
         )
+        profiling = dev._verbosity > 0
+        if profiling and getattr(self, "_hlo_rows", None) is None:
+            # One extra lower+compile (shapes only — safe before the
+            # donating call below) yields the optimized HLO for the
+            # per-op cost table (hlo_profile.py).
+            try:
+                from . import hlo_profile
+
+                text = self._compiled.lower(
+                    pvals, svals, ovals, key, step, batch_arrays
+                ).compile().as_text()
+                self._hlo_rows = hlo_profile.profile_hlo(text)
+            except Exception:
+                self._hlo_rows = []
+        t0 = time.perf_counter() if profiling else 0.0
         out, new_p, new_s, new_o, new_key = self._compiled(
             pvals, svals, ovals, key, step, batch_arrays
         )
+        if profiling:
+            jax.block_until_ready(new_key)
+            dt = time.perf_counter() - t0
+            dev.StepIteration()  # graph replay == one iteration (ref)
+            dev.RecordOpTime("train_one_batch[graph]", dt)
+            # Keyed per model so two compiled models on one device
+            # (e.g. a GAN's G and D) keep separate tables.
+            label = f"train_one_batch:{self.model.name or 'model'}" \
+                    f"@{id(self.model) & 0xffff:04x}"
+            prof = dev._graph_profiles.setdefault(
+                label, {"rows": self._hlo_rows or [], "step_s": dt})
+            prof["step_s"] = min(prof["step_s"], dt)
+            prof["rows"] = self._hlo_rows or []
         for p, v in zip(self.params, new_p):
             p.data = v
         for s, v in zip(self.states, new_s):

@@ -620,8 +620,13 @@ def _import_layernorm(ctx, node):
     b = (ctx.tensor(node.input[2]) if len(node.input) > 2 and node.input[2]
          else tensor_mod.from_numpy(
              np.zeros(g.shape, np.float32), device=ctx.device))
-    if _attr(node, "axis", -1) not in (-1, None):
-        raise ValueError("sonnx: LayerNormalization only supports axis=-1")
+    axis = _attr(node, "axis", -1)
+    # Positive last-axis spellings (e.g. axis=2 on rank-3) are the same
+    # computation; only genuinely non-last-axis normalization is refused.
+    if axis is not None and axis % len(x.shape) != len(x.shape) - 1:
+        raise ValueError(
+            "sonnx: LayerNormalization only supports last-axis "
+            f"normalization (got axis={axis} for rank {len(x.shape)})")
     return autograd.layer_norm(x, g, b, eps=_attr(node, "epsilon", 1e-5))
 
 
@@ -635,8 +640,8 @@ def _import_pad(ctx, node):
     x = ctx.tensor(node.input[0])
     mode = _attr(node, "mode", "constant")
     if len(node.input) > 1:
-        pads = ctx.const(node.input[1]).tolist()
-        cval = (float(ctx.const(node.input[2]))
+        pads = _req_const(ctx, node, 1, "pads").tolist()
+        cval = (float(_req_const(ctx, node, 2, "value"))
                 if len(node.input) > 2 and node.input[2] else 0.0)
     else:
         pads = _attr(node, "pads")
@@ -719,10 +724,10 @@ _IMPORTERS = {
         _attr(n, "alpha", 0.2), _attr(n, "beta", 0.5))(
         ctx.tensor(n.input[0])),
     "Clip": lambda ctx, n: autograd.Clip(
-        float(ctx.const(n.input[1])) if len(n.input) > 1 and n.input[1]
-        else _attr(n, "min"),
-        float(ctx.const(n.input[2])) if len(n.input) > 2 and n.input[2]
-        else _attr(n, "max"))(ctx.tensor(n.input[0])),
+        float(_req_const(ctx, n, 1, "min")) if len(n.input) > 1
+        and n.input[1] else _attr(n, "min"),
+        float(_req_const(ctx, n, 2, "max")) if len(n.input) > 2
+        and n.input[2] else _attr(n, "max"))(ctx.tensor(n.input[0])),
     "Cast": _import_cast,
     "Gemm": _import_gemm,
     "Conv": _import_conv,

@@ -1,0 +1,67 @@
+"""Worker for test_dist_eager: eager (driver-regime) DistOpt training
+under a 2-controller launch, NO mesh compile — exercises the
+cross-process `Communicator._driver_reduce` path (reference contract:
+per-grad ncclAllReduce driven from Python; src/io/communicator.cc
+`synch`)."""
+import json
+import os
+import sys
+
+
+def main():
+    rank = int(sys.argv[1])
+    world = int(sys.argv[2])
+    coordinator = sys.argv[3]
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from jax.extend.backend import clear_backends
+
+    clear_backends()
+
+    sys.path.insert(0, os.path.abspath(
+        os.path.join(os.path.dirname(__file__), "..")))
+    from singa_tpu import autograd, layer, model, opt, tensor
+    from singa_tpu.dist.communicator import init_distributed
+
+    init_distributed(coordinator, num_processes=world, process_id=rank)
+    assert jax.process_count() == world
+
+    import numpy as np
+
+    class _M(model.Model):
+        def __init__(self):
+            super().__init__()
+            self.fc = layer.Linear(4)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    m = _M()
+    sgd = opt.DistOpt(opt.SGD(lr=0.1, momentum=0.9))
+    assert sgd.communicator.world_size == world
+    m.set_optimizer(sgd)
+
+    # Identical init on every controller (same seed), DIFFERENT data
+    # per rank — parameter equality after steps proves the reduction.
+    rs_init = np.random.RandomState(0)
+    x0 = tensor.from_numpy(rs_init.randn(8, 6).astype(np.float32))
+    m.compile([x0], is_train=True, use_graph=False)  # eager!
+
+    rs = np.random.RandomState(100 + rank)
+    for step in range(4):
+        x = tensor.from_numpy(rs.randn(8, 6).astype(np.float32))
+        y = tensor.from_numpy(rs.randint(0, 4, 8).astype(np.int32))
+        out = m.forward(x)
+        loss = autograd.softmax_cross_entropy(out, y)
+        sgd.backward_and_update(loss)
+
+    params = {k: np.asarray(v.to_numpy()).tolist()
+              for k, v in m.get_params().items()}
+    print("PARAMS " + json.dumps(params), flush=True)
+    print("DONE", flush=True)
+
+
+if __name__ == "__main__":
+    main()

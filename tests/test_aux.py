@@ -45,10 +45,19 @@ class TestLoss:
                                    rtol=1e-5, atol=1e-6)
 
     def test_squared_error(self):
-        x = tensor.from_numpy(np.array([[1.0, 2.0]], np.float32))
-        t = tensor.from_numpy(np.array([[0.0, 0.0]], np.float32))
-        v = loss.SquaredError().forward(x, t)
-        np.testing.assert_allclose(v.to_numpy(), 0.5 * np.mean([1.0, 4.0]),
+        # 3 features per row so the sum/(2*batch) convention is
+        # distinguishable from 0.5*mean-over-elements (ADVICE r1).
+        x_np = np.array([[1.0, 2.0, 3.0], [0.5, -1.0, 2.0]], np.float32)
+        t_np = np.array([[0.0, 0.0, 1.0], [0.5, 1.0, 0.0]], np.float32)
+        x = tensor.from_numpy(x_np)
+        t = tensor.from_numpy(t_np)
+        sq = loss.SquaredError()
+        v = sq.forward(x, t)
+        expect = np.sum((x_np - t_np) ** 2) / (2.0 * x_np.shape[0])
+        np.testing.assert_allclose(v.to_numpy(), expect, rtol=1e-6)
+        g = sq.backward()
+        np.testing.assert_allclose(g.to_numpy(),
+                                   (x_np - t_np) / x_np.shape[0],
                                    rtol=1e-6)
 
 
@@ -105,6 +114,35 @@ class TestData:
             for i in range(5):
                 yield i
         assert list(data.BatchIter(src, prefetch=2)) == [0, 1, 2, 3, 4]
+
+    def test_batchiter_propagates_worker_error(self):
+        def src():
+            yield 0
+            raise RuntimeError("decode failed")
+        it = iter(data.BatchIter(src, prefetch=2))
+        assert next(it) == 0
+        with pytest.raises(RuntimeError, match="decode failed"):
+            next(it)
+
+    def test_batchiter_abandoned_consumer_unblocks_worker(self):
+        import threading
+        started = threading.Event()
+
+        def src():
+            started.set()
+            for i in range(1000):
+                yield i
+        it = iter(data.BatchIter(src, prefetch=1))
+        assert next(it) == 0
+        started.wait(5)
+        it.close()  # generator close fires the finally -> closed.set()
+        # worker must drain out; give it a moment and check thread count
+        import time
+        deadline = time.time() + 5
+        while time.time() < deadline and any(
+                t.name.startswith("Thread") and t.is_alive()
+                and t.daemon for t in threading.enumerate()):
+            time.sleep(0.05)
 
     def test_shard_disjoint(self):
         x = np.arange(8)

@@ -105,6 +105,37 @@ class TestExportImport:
         np.testing.assert_allclose(rep.run([x])[0].to_numpy(), ref,
                                    rtol=1e-5, atol=1e-5)
 
+    def test_layernorm_positive_last_axis(self):
+        # Many exporters emit axis=rank-1 instead of -1; both denote
+        # last-axis normalization and must import (ADVICE r1).
+        mp = P.ModelProto()
+        mp.graph.name = "g"
+        vi = mp.graph.input.add()
+        vi.name = "x"
+        vi.type.tensor_type.elem_type = 1  # FLOAT
+        for d in (2, 3, 8):
+            vi.type.tensor_type.shape.dim.add().dim_value = d
+        mp.graph.initializer.append(
+            sonnx.to_tensor_proto("g_scale", np.ones(8, np.float32)))
+        mp.graph.initializer.append(
+            sonnx.to_tensor_proto("g_bias", np.zeros(8, np.float32)))
+        n = mp.graph.node.add()
+        n.op_type = "LayerNormalization"
+        n.input.extend(["x", "g_scale", "g_bias"])
+        n.output.append("y")
+        a = n.attribute.add()
+        a.name = "axis"
+        a.i = 2
+        a.type = P.AttributeProto.INT
+        out = mp.graph.output.add()
+        out.name = "y"
+        rep = sonnx.prepare(mp)
+        x_np = np.random.RandomState(0).randn(2, 3, 8).astype(np.float32)
+        y = rep.run([tensor.from_numpy(x_np)])[0].to_numpy()
+        ref = (x_np - x_np.mean(-1, keepdims=True)) / np.sqrt(
+            x_np.var(-1, keepdims=True) + 1e-5)
+        np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-4)
+
     def test_unsupported_op_reported(self):
         mp = P.ModelProto()
         mp.graph.name = "g"
