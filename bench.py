@@ -337,27 +337,35 @@ def main():
             if r and r.get("ok"):
                 if best is None or r["ips"] > best["ips"]:
                     best = r
+                # Flush the best-so-far immediately: if the outer
+                # driver kills this parent mid-ramp, the measured
+                # result survives on disk.
+                with open(os.path.join(HERE, "BENCH_partial.json"),
+                          "w") as f:
+                    json.dump(_final_json(best, peak, chip, {}), f)
             else:
                 log(f"bs{batch} stage failed; stopping ramp")
                 break
     else:
         result_extra["error"] = "tpu_unreachable"
 
-    if best:
-        mfu = best["ips"] * RESNET50_TRAIN_FLOPS_PER_IMG / peak
-        out = {"metric": "resnet50_images_per_sec_chip",
-               "value": best["ips"], "unit": "img/s",
-               "vs_baseline": round(best["ips"] / REF_V100_IPS, 3),
-               "batch": best["batch"], "step_ms": best["step_ms"],
-               "compile_s": best["compile_s"],
-               "mfu": round(mfu, 4), "chip": chip}
-    else:
-        out = {"metric": "resnet50_images_per_sec_chip", "value": 0.0,
-               "unit": "img/s", "vs_baseline": 0.0, "chip": chip,
-               **result_extra}
+    out = _final_json(best, peak, chip, result_extra)
     with open(os.path.join(HERE, "BENCH_partial.json"), "w") as f:
         json.dump(out, f)
     print(json.dumps(out), flush=True)
+
+
+def _final_json(best, peak, chip, extra):
+    if best:
+        mfu = best["ips"] * RESNET50_TRAIN_FLOPS_PER_IMG / peak
+        return {"metric": "resnet50_images_per_sec_chip",
+                "value": best["ips"], "unit": "img/s",
+                "vs_baseline": round(best["ips"] / REF_V100_IPS, 3),
+                "batch": best["batch"], "step_ms": best["step_ms"],
+                "compile_s": best["compile_s"],
+                "mfu": round(mfu, 4), "chip": chip}
+    return {"metric": "resnet50_images_per_sec_chip", "value": 0.0,
+            "unit": "img/s", "vs_baseline": 0.0, "chip": chip, **extra}
 
 
 if __name__ == "__main__":

@@ -1,0 +1,240 @@
+"""BERT via SONNX + data-parallel fine-tune (north-star config #5).
+
+Reference parity: `examples/onnx/bert/bert.py` — download BERT from
+the ONNX model zoo, import with `sonnx.prepare`, fine-tune through
+`SONNXModel` under `DistOpt` (SURVEY.md §2.3 / §3.4). This
+environment has no network, so `build_bert_onnx` constructs a
+BERT-shaped transformer-encoder ONNX model locally through the in-repo
+wire-compatible proto writer — the exact op family a zoo BERT uses
+(Gather embeddings, MatMul/Add, Reshape/Transpose multi-head split,
+Softmax attention, LayerNormalization, Gelu FFN) — then the import +
+fine-tune workflow is identical to pointing `--onnx` at a real file.
+
+TPU-native distribution: instead of the reference's per-grad NCCL
+allreduce, `Model.compile(mesh=...)` turns the whole fine-tune step
+into one SPMD program with the batch sharded over the mesh's "data"
+axis (XLA inserts the gradient reductions over ICI).
+
+Run:  python bert.py [--base] [--steps N] [--onnx FILE]
+      --base builds the full BERT-base config (12 layers, d=768,
+      H=12); the default is a small config for quick runs.
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.abspath(os.path.join(_HERE, "..", "..")))
+
+from singa_tpu import device, opt, sonnx, tensor  # noqa: E402
+from singa_tpu.proto import onnx_ir_pb2 as P  # noqa: E402
+
+
+def _node(g, op, ins, outs, **attrs):
+    n = g.node.add()
+    n.op_type = op
+    n.name = f"{op}_{len(g.node)}"
+    n.input.extend(ins)
+    n.output.extend(outs)
+    for k, v in attrs.items():
+        a = n.attribute.add()
+        a.name = k
+        if isinstance(v, int):
+            a.i = v
+            a.type = P.AttributeProto.INT
+        elif isinstance(v, float):
+            a.f = v
+            a.type = P.AttributeProto.FLOAT
+        elif isinstance(v, (list, tuple)):
+            a.ints.extend(int(x) for x in v)
+            a.type = P.AttributeProto.INTS
+        else:
+            raise TypeError(f"attr {k}: {type(v)}")
+    return n
+
+
+def build_bert_onnx(vocab=1000, seq=64, d=128, heads=4, layers=2,
+                    classes=4, seed=0):
+    """BERT-shaped encoder classifier as an ONNX ModelProto.
+
+    input_ids[int32, B x S] -> Gather word emb + position emb -> LN ->
+    L x (MHSA + residual + LN, GELU-FFN + residual + LN) ->
+    mean-pool -> Linear -> logits[B x classes].
+    """
+    assert d % heads == 0
+    dh = d // heads
+    rs = np.random.RandomState(seed)
+    mp = P.ModelProto()
+    mp.ir_version = 8
+    op = mp.opset_import.add()
+    op.domain = ""
+    op.version = 17
+    g = mp.graph
+    g.name = f"bert_l{layers}_d{d}_h{heads}"
+
+    def init(name, arr):
+        g.initializer.append(sonnx.to_tensor_proto(name, arr))
+        return name
+
+    def w(name, *shape, scale=None):
+        scale = scale or (1.0 / np.sqrt(shape[0]))
+        return init(name, (rs.randn(*shape) * scale).astype(np.float32))
+
+    def zeros(name, *shape):
+        return init(name, np.zeros(shape, np.float32))
+
+    def ones(name, *shape):
+        return init(name, np.ones(shape, np.float32))
+
+    vi = g.input.add()
+    vi.name = "input_ids"
+    vi.type.tensor_type.elem_type = 6  # INT32
+    for dim in ("B", None):
+        dd = vi.type.tensor_type.shape.dim.add()
+        if dim == "B":
+            dd.dim_param = "B"
+        else:
+            dd.dim_value = seq
+
+    # --- embeddings -------------------------------------------------------
+    w("word_emb", vocab, d, scale=0.02)
+    init("pos_emb", (rs.randn(seq, d) * 0.02).astype(np.float32))
+    _node(g, "Gather", ["word_emb", "input_ids"], ["tok_emb"], axis=0)
+    _node(g, "Add", ["tok_emb", "pos_emb"], ["emb_sum"])
+    ones("emb_ln_g", d)
+    zeros("emb_ln_b", d)
+    _node(g, "LayerNormalization", ["emb_sum", "emb_ln_g", "emb_ln_b"],
+          ["h0"], axis=-1, epsilon=1e-5)
+
+    init("attn_scale", np.asarray(1.0 / np.sqrt(dh), np.float32))
+    init("head_split", np.asarray([0, 0, heads, dh], np.int64))
+    init("head_merge", np.asarray([0, 0, d], np.int64))
+
+    h = "h0"
+    for li in range(layers):
+        p = f"l{li}_"
+        # -- multi-head self-attention ------------------------------------
+        for proj in ("q", "k", "v"):
+            w(p + f"W{proj}", d, d)
+            zeros(p + f"b{proj}", d)
+            _node(g, "MatMul", [h, p + f"W{proj}"], [p + proj + "_mm"])
+            _node(g, "Add", [p + proj + "_mm", p + f"b{proj}"],
+                  [p + proj])
+            _node(g, "Reshape", [p + proj, "head_split"],
+                  [p + proj + "_4d"])
+        _node(g, "Transpose", [p + "q_4d"], [p + "qh"], perm=[0, 2, 1, 3])
+        _node(g, "Transpose", [p + "k_4d"], [p + "kT"], perm=[0, 2, 3, 1])
+        _node(g, "Transpose", [p + "v_4d"], [p + "vh"], perm=[0, 2, 1, 3])
+        _node(g, "MatMul", [p + "qh", p + "kT"], [p + "scores_raw"])
+        _node(g, "Mul", [p + "scores_raw", "attn_scale"], [p + "scores"])
+        _node(g, "Softmax", [p + "scores"], [p + "probs"], axis=-1)
+        _node(g, "MatMul", [p + "probs", p + "vh"], [p + "ctx_h"])
+        _node(g, "Transpose", [p + "ctx_h"], [p + "ctx_t"],
+              perm=[0, 2, 1, 3])
+        _node(g, "Reshape", [p + "ctx_t", "head_merge"], [p + "ctx"])
+        w(p + "Wo", d, d)
+        zeros(p + "bo", d)
+        _node(g, "MatMul", [p + "ctx", p + "Wo"], [p + "attn_mm"])
+        _node(g, "Add", [p + "attn_mm", p + "bo"], [p + "attn_out"])
+        _node(g, "Add", [h, p + "attn_out"], [p + "res1"])
+        ones(p + "ln1_g", d)
+        zeros(p + "ln1_b", d)
+        _node(g, "LayerNormalization",
+              [p + "res1", p + "ln1_g", p + "ln1_b"], [p + "h1"],
+              axis=-1, epsilon=1e-5)
+        # -- GELU FFN ------------------------------------------------------
+        w(p + "W1", d, 4 * d)
+        zeros(p + "b1", 4 * d)
+        w(p + "W2", 4 * d, d)
+        zeros(p + "b2", d)
+        _node(g, "MatMul", [p + "h1", p + "W1"], [p + "ffn_mm1"])
+        _node(g, "Add", [p + "ffn_mm1", p + "b1"], [p + "ffn_pre"])
+        _node(g, "Gelu", [p + "ffn_pre"], [p + "ffn_act"])
+        _node(g, "MatMul", [p + "ffn_act", p + "W2"], [p + "ffn_mm2"])
+        _node(g, "Add", [p + "ffn_mm2", p + "b2"], [p + "ffn_out"])
+        _node(g, "Add", [p + "h1", p + "ffn_out"], [p + "res2"])
+        ones(p + "ln2_g", d)
+        zeros(p + "ln2_b", d)
+        _node(g, "LayerNormalization",
+              [p + "res2", p + "ln2_g", p + "ln2_b"], [p + "h2"],
+              axis=-1, epsilon=1e-5)
+        h = p + "h2"
+
+    # --- pool + classify --------------------------------------------------
+    _node(g, "ReduceMean", [h], ["pooled"], axes=[1], keepdims=0)
+    w("Wc", d, classes)
+    zeros("bc", classes)
+    _node(g, "MatMul", ["pooled", "Wc"], ["logits_mm"])
+    _node(g, "Add", ["logits_mm", "bc"], ["logits"])
+    out = g.output.add()
+    out.name = "logits"
+    return mp
+
+
+def run(onnx_path=None, base=False, steps=20, batch=8, seq=None, lr=1e-3,
+        use_mesh=True, verbose=True):
+    import jax
+
+    if onnx_path:
+        mp = sonnx.load(onnx_path)
+        vocab, seq, classes = 30522, seq or 128, 2
+    elif base:
+        vocab, seq, d, heads, layers, classes = 30522, 128, 768, 12, 12, 2
+        mp = build_bert_onnx(vocab, seq, d, heads, layers, classes)
+    else:
+        vocab, seq, d, heads, layers, classes = 1000, 64, 128, 4, 2, 4
+        mp = build_bert_onnx(vocab, seq, d, heads, layers, classes)
+
+    dev = device.create_tpu_device()
+    dev.SetRandSeed(0)
+    m = sonnx.SONNXModel(mp, device=dev)
+    m.set_optimizer(opt.SGD(lr=lr, momentum=0.9))
+
+    mesh = None
+    batch_specs = None
+    n_dev = len(jax.local_devices())
+    if use_mesh and n_dev > 1:
+        from jax.sharding import PartitionSpec as PS
+
+        from singa_tpu.parallel import create_mesh
+
+        mesh = create_mesh({"data": n_dev})
+        batch_specs = [PS("data"), PS("data")]
+        batch = max(batch, n_dev) // n_dev * n_dev
+
+    rs = np.random.RandomState(1)
+    x_np = rs.randint(0, vocab, (batch, seq)).astype(np.int32)
+    # learnable synthetic task: label = first token bucket
+    y_np = (x_np[:, 0] % classes).astype(np.int32)
+    tx = tensor.from_numpy(x_np, device=dev)
+    ty = tensor.from_numpy(y_np, device=dev)
+
+    m.compile([tx], is_train=True, use_graph=True, mesh=mesh,
+              batch_specs=batch_specs)
+    losses = []
+    for step in range(steps):
+        out, loss = m(tx, ty)
+        losses.append(float(loss.to_numpy()))
+        if verbose:
+            print(f"step {step}: loss {losses[-1]:.4f}", flush=True)
+    if verbose:
+        print(f"DONE first={losses[0]:.4f} last={losses[-1]:.4f}")
+    return losses
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--onnx", help="fine-tune a real .onnx file instead")
+    ap.add_argument("--base", action="store_true",
+                    help="full BERT-base config")
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--no-mesh", dest="mesh", action="store_false",
+                    default=True)
+    a = ap.parse_args()
+    losses = run(a.onnx, a.base, a.steps, a.batch, lr=a.lr,
+                 use_mesh=a.mesh)
+    assert losses[-1] < losses[0], "fine-tune loss did not decrease"

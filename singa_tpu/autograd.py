@@ -28,6 +28,7 @@ keeps `jax.vjp` over float leaves only.
 """
 from __future__ import annotations
 
+import contextlib
 import math
 from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
@@ -85,17 +86,11 @@ class Operator:
         # Eager dispatch (no tracers) skips it: the metadata is only
         # consumed when traced into a program.
         traced = any(isinstance(t.data, jax.core.Tracer) for t in xs)
-        if dev is not None and dev._verbosity > 0:
-            with dev.TimeOp(type(self).__name__):
-                if traced:
-                    with jax.named_scope(type(self).__name__):
-                        ys = self.forward(*[t.data for t in xs])
-                else:
-                    ys = self.forward(*[t.data for t in xs])
-        elif traced:
-            with jax.named_scope(type(self).__name__):
-                ys = self.forward(*[t.data for t in xs])
-        else:
+        timing = dev is not None and dev._verbosity > 0
+        with (dev.TimeOp(type(self).__name__) if timing
+              else contextlib.nullcontext()), \
+             (jax.named_scope(type(self).__name__) if traced
+              else contextlib.nullcontext()):
             ys = self.forward(*[t.data for t in xs])
         multiple = isinstance(ys, tuple)
         ys = ys if multiple else (ys,)

@@ -132,17 +132,21 @@ class TestData:
             started.set()
             for i in range(1000):
                 yield i
+        import time
+        before = set(threading.enumerate())
         it = iter(data.BatchIter(src, prefetch=1))
         assert next(it) == 0
         started.wait(5)
+        worker_threads = [t for t in threading.enumerate()
+                          if t not in before]
+        assert worker_threads, "prefetch worker thread not found"
         it.close()  # generator close fires the finally -> closed.set()
-        # worker must drain out; give it a moment and check thread count
-        import time
         deadline = time.time() + 5
-        while time.time() < deadline and any(
-                t.name.startswith("Thread") and t.is_alive()
-                and t.daemon for t in threading.enumerate()):
+        while time.time() < deadline and any(t.is_alive()
+                                             for t in worker_threads):
             time.sleep(0.05)
+        assert not any(t.is_alive() for t in worker_threads), \
+            "abandoned consumer left the prefetch worker blocked"
 
     def test_shard_disjoint(self):
         x = np.arange(8)

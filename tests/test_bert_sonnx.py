@@ -1,0 +1,136 @@
+"""BERT via SONNX (north-star config #5; VERDICT r1 missing #2).
+
+Reference: `examples/onnx/bert/bert.py` imports zoo BERT with
+`sonnx.prepare` and fine-tunes under DistOpt (SURVEY.md §3.4). Here a
+BERT-shaped encoder is constructed locally through the in-repo proto
+writer (examples/onnx/bert.py::build_bert_onnx) and the import is
+validated at encoder scale: numpy forward parity, gradient flow to
+every parameter, and a mesh-DP fine-tune with decreasing loss.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                "examples", "onnx"))
+
+from singa_tpu import opt, sonnx, tensor  # noqa: E402
+
+from bert import build_bert_onnx  # noqa: E402
+
+
+VOCAB, SEQ, D, HEADS, LAYERS, CLASSES = 97, 12, 32, 4, 2, 4
+
+
+@pytest.fixture(scope="module")
+def bert_proto():
+    return build_bert_onnx(VOCAB, SEQ, D, HEADS, LAYERS, CLASSES, seed=3)
+
+
+def _np_forward(mp, ids):
+    """Numpy reference of the BERT-shaped graph built by
+    build_bert_onnx (embeddings -> L x (MHSA + FFN) -> pool -> head)."""
+    init = {tp.name: sonnx.to_numpy(tp) for tp in mp.graph.initializer}
+
+    def ln(x, g, b, eps=1e-5):
+        mu = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        return (x - mu) / np.sqrt(var + eps) * g + b
+
+    def gelu_exact(x):
+        import math
+
+        erf = np.vectorize(math.erf)
+        return 0.5 * x * (1.0 + erf(x / np.sqrt(2.0)))
+
+    def softmax(x):
+        e = np.exp(x - x.max(-1, keepdims=True))
+        return e / e.sum(-1, keepdims=True)
+
+    B, S = ids.shape
+    h = init["word_emb"][ids] + init["pos_emb"]
+    h = ln(h, init["emb_ln_g"], init["emb_ln_b"])
+    dh = D // HEADS
+    for li in range(LAYERS):
+        p = f"l{li}_"
+        def proj(name):
+            y = h @ init[p + "W" + name] + init[p + "b" + name]
+            return y.reshape(B, S, HEADS, dh)
+        q = proj("q").transpose(0, 2, 1, 3)
+        k = proj("k").transpose(0, 2, 3, 1)
+        v = proj("v").transpose(0, 2, 1, 3)
+        scores = (q @ k) * (1.0 / np.sqrt(dh))
+        ctx = softmax(scores) @ v
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, D)
+        attn = ctx @ init[p + "Wo"] + init[p + "bo"]
+        h1 = ln(h + attn, init[p + "ln1_g"], init[p + "ln1_b"])
+        ffn = gelu_exact(h1 @ init[p + "W1"] + init[p + "b1"])
+        ffn = ffn @ init[p + "W2"] + init[p + "b2"]
+        h = ln(h1 + ffn, init[p + "ln2_g"], init[p + "ln2_b"])
+    pooled = h.mean(1)
+    return pooled @ init["Wc"] + init["bc"]
+
+
+class TestBertImport:
+    def test_op_family_present(self, bert_proto):
+        ops = {n.op_type for n in bert_proto.graph.node}
+        assert {"Gather", "MatMul", "Softmax", "LayerNormalization",
+                "Gelu", "Transpose", "Reshape", "Add"} <= ops
+
+    def test_forward_matches_numpy_at_encoder_scale(self, bert_proto):
+        rs = np.random.RandomState(0)
+        ids = rs.randint(0, VOCAB, (3, SEQ)).astype(np.int32)
+        rep = sonnx.prepare(bert_proto)
+        got = rep.run([tensor.from_numpy(ids)])[0].to_numpy()
+        want = _np_forward(bert_proto, ids)
+        assert got.shape == (3, CLASSES)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_gradients_reach_every_param(self, bert_proto):
+        m = sonnx.SONNXModel(bert_proto)
+        m.set_optimizer(opt.SGD(lr=0.5))
+        rs = np.random.RandomState(1)
+        x = tensor.from_numpy(rs.randint(0, VOCAB, (4, SEQ))
+                              .astype(np.int32))
+        y = tensor.from_numpy(rs.randint(0, CLASSES, 4).astype(np.int32))
+        before = {k: v.to_numpy().copy() for k, v in m.get_params().items()}
+        m.compile([x], is_train=True, use_graph=False)
+        m.train_one_batch(x, y)
+        after = {k: v.to_numpy() for k, v in m.get_params().items()}
+        for k in before:
+            if "word_emb" in k:
+                # only the gathered rows receive gradient
+                assert not np.allclose(before[k], after[k]), k
+            elif "pos_emb" in k or not k.startswith("p_"):
+                continue
+            else:
+                assert not np.allclose(before[k], after[k]), \
+                    f"param {k} received no gradient"
+
+    def test_finetune_mesh_dp_loss_decreases(self, bert_proto):
+        """The north-star workflow: imported graph + Model.compile over
+        a data-parallel mesh, one SPMD program per step."""
+        import jax
+        from jax.sharding import PartitionSpec as PS
+
+        from singa_tpu.parallel import create_mesh
+
+        n = len(jax.devices())
+        assert n == 8  # conftest virtual mesh
+        mesh = create_mesh({"data": n})
+        m = sonnx.SONNXModel(bert_proto)
+        m.set_optimizer(opt.SGD(lr=2e-3, momentum=0.9))
+        rs = np.random.RandomState(2)
+        x_np = rs.randint(0, VOCAB, (16, SEQ)).astype(np.int32)
+        y_np = (x_np[:, 0] % CLASSES).astype(np.int32)
+        x = tensor.from_numpy(x_np)
+        y = tensor.from_numpy(y_np)
+        m.compile([x], is_train=True, use_graph=True, mesh=mesh,
+                  batch_specs=[PS("data"), PS("data")])
+        losses = []
+        for _ in range(6):
+            out, loss = m(x, y)
+            losses.append(float(loss.to_numpy()))
+        assert losses[-1] < losses[0], losses
