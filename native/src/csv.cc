@@ -1,0 +1,83 @@
+// CSV record encode/decode.
+//
+// Reference parity: singa::io::CSVDecoder / CSVEncoder
+// (src/io/csv_decoder.cc, csv_encoder.cc — SURVEY.md N19): a record
+// is "label,f0,f1,..." (label optional), decoded into a float vector
+// (+ int label). C ABI for the ctypes binding.
+#include <cctype>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+extern "C" {
+
+// Parse a CSV line of floats. If has_label, the first field is the
+// int label. Returns the number of floats written to out (up to
+// max_n), or -1 on a malformed line: empty/blank fields, a label that
+// is not a whole integer (e.g. "1.5"), or any field with trailing
+// junk. Fields are anchored at commas — nothing is silently skipped.
+// *label receives the label (0 if has_label == 0).
+int64_t st_csv_decode(const char* line, float* out, int64_t max_n,
+                      int has_label, int* label) {
+  if (label) *label = 0;
+  if (!line) return -1;
+  // Empty line: no fields at all -> malformed when a label is
+  // required, else zero features.
+  const char* scan = line;
+  while (*scan && isspace(static_cast<unsigned char>(*scan))) ++scan;
+  if (!*scan) return has_label ? -1 : 0;
+
+  const char* p = line;
+  int64_t n = 0;
+  bool first = true;
+  for (;;) {
+    const char* field_end = strchr(p, ',');
+    const char* fend = field_end ? field_end : p + strlen(p);
+    // trim the field
+    const char* b = p;
+    while (b < fend && isspace(static_cast<unsigned char>(*b))) ++b;
+    const char* e = fend;
+    while (e > b && isspace(static_cast<unsigned char>(*(e - 1)))) --e;
+    if (b == e) return -1;  // empty field
+    char* end = nullptr;
+    if (first && has_label) {
+      long v = strtol(b, &end, 10);
+      if (end != e) return -1;  // label not a whole integer
+      if (label) *label = static_cast<int>(v);
+    } else {
+      float v = strtof(b, &end);
+      if (end != e) return -1;  // trailing junk in a float field
+      if (n < max_n) out[n] = v;
+      ++n;
+    }
+    first = false;
+    if (!field_end) break;
+    p = field_end + 1;
+  }
+  return n;
+}
+
+// Encode floats (optionally prefixed by an int label) into buf.
+// Returns the string length, or -1 if buf_len is too small.
+int64_t st_csv_encode(const float* vals, int64_t n, int label,
+                      int has_label, char* buf, int64_t buf_len) {
+  int64_t off = 0;
+  if (has_label) {
+    int w = snprintf(buf + off, buf_len - off, "%d", label);
+    if (w < 0 || off + w >= buf_len) return -1;
+    off += w;
+  }
+  for (int64_t i = 0; i < n; ++i) {
+    int w = snprintf(buf + off, buf_len - off, "%s%.9g",
+                     (off > 0 || (!has_label && i > 0)) ? "," : "",
+                     static_cast<double>(vals[i]));
+    // NB: when nothing written yet and no label, first value has no
+    // comma; the condition above handles i==0 for both layouts.
+    if (w < 0 || off + w >= buf_len) return -1;
+    off += w;
+  }
+  return off;
+}
+
+}  // extern "C"

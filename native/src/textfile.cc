@@ -1,0 +1,97 @@
+// Line-oriented text record IO.
+//
+// Reference parity: singa::io::TextFileReader / TextFileWriter
+// (src/io/textfile_reader.cc, textfile_writer.cc — SURVEY.md N18):
+// value = one line (newline stripped), key = line number. Same
+// contract here, C ABI for the ctypes binding (singa_tpu/io.py).
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+namespace {
+
+struct TextWriter {
+  FILE* f = nullptr;
+};
+
+struct TextReader {
+  FILE* f = nullptr;
+  std::string line;      // last line (stable storage for the caller)
+  uint64_t lineno = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* st_text_writer_open(const char* path, const char* mode) {
+  // mode: "w" truncate, "a" append (reference kCreate / kAppend)
+  const char* m = (mode && mode[0] == 'a') ? "a" : "w";
+  FILE* f = fopen(path, m);
+  if (!f) return nullptr;
+  auto* w = new TextWriter();
+  w->f = f;
+  return w;
+}
+
+int st_text_writer_write(void* h, const char* line) {
+  auto* w = static_cast<TextWriter*>(h);
+  if (!w || !w->f) return 0;
+  size_t n = strlen(line);
+  if (n && fwrite(line, 1, n, w->f) != n) return 0;
+  if (fputc('\n', w->f) == EOF) return 0;
+  return 1;
+}
+
+int st_text_writer_flush(void* h) {
+  auto* w = static_cast<TextWriter*>(h);
+  if (!w || !w->f) return 0;
+  return fflush(w->f) == 0;
+}
+
+void st_text_writer_close(void* h) {
+  auto* w = static_cast<TextWriter*>(h);
+  if (!w) return;
+  if (w->f) fclose(w->f);
+  delete w;
+}
+
+void* st_text_reader_open(const char* path) {
+  FILE* f = fopen(path, "r");
+  if (!f) return nullptr;
+  auto* r = new TextReader();
+  r->f = f;
+  return r;
+}
+
+// Returns 1 and sets (*key = line number, *val/<*vlen> = line without
+// trailing newline) or 0 at EOF.
+int st_text_reader_next(void* h, uint64_t* key, const char** val,
+                        uint64_t* vlen) {
+  auto* r = static_cast<TextReader*>(h);
+  if (!r || !r->f) return 0;
+  r->line.clear();
+  int c;
+  bool any = false;
+  while ((c = fgetc(r->f)) != EOF) {
+    any = true;
+    if (c == '\n') break;
+    r->line.push_back(static_cast<char>(c));
+  }
+  if (!any) return 0;
+  if (!r->line.empty() && r->line.back() == '\r') r->line.pop_back();
+  *key = r->lineno++;
+  *val = r->line.c_str();
+  *vlen = r->line.size();
+  return 1;
+}
+
+void st_text_reader_close(void* h) {
+  auto* r = static_cast<TextReader*>(h);
+  if (!r) return;
+  if (r->f) fclose(r->f);
+  delete r;
+}
+
+}  // extern "C"

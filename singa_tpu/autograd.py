@@ -869,7 +869,21 @@ class SoftMaxCrossEntropy(Operator):
 
     def forward(self, x):
         t = self.t
-        if t.ndim == x.ndim - 1 or (t.ndim == x.ndim and t.shape[-1] == 1):
+        int_labels = t.ndim == x.ndim - 1 or (
+            t.ndim == x.ndim and t.shape[-1] == 1)
+        n = x.shape[0] if x.ndim > 1 else 1
+        self._n = n
+        # Pallas tier (SURVEY N10): fused kernel for the canonical
+        # 2-D-logits + int-labels case when enabled.
+        from .ops import pallas_kernels as _pk
+
+        if (_pk.enabled() and x.ndim == 2 and int_labels
+                and jnp.issubdtype(jnp.asarray(t).dtype, jnp.integer)):
+            lab = jnp.reshape(t, (x.shape[0],)).astype(jnp.int32)
+            self._pallas_res = (x, lab)
+            return jnp.sum(_pk.softmax_xent(x, lab)) / n
+        self._pallas_res = None
+        if int_labels:
             t = jax.nn.one_hot(
                 t.reshape(t.shape[: x.ndim - 1]).astype(jnp.int32),
                 x.shape[-1],
@@ -878,11 +892,16 @@ class SoftMaxCrossEntropy(Operator):
         self._onehot = t
         logp = jax.nn.log_softmax(x, axis=-1)
         self._p = jnp.exp(logp)
-        n = x.shape[0] if x.ndim > 1 else 1
-        self._n = n
         return -jnp.sum(t * logp) / n
 
     def backward(self, dy):
+        if getattr(self, "_pallas_res", None) is not None:
+            from .ops import pallas_kernels as _pk
+
+            x, lab = self._pallas_res
+            g = jnp.full((x.shape[0],), dy / self._n, jnp.float32)
+            dx, _ = _pk._softmax_xent_bwd((x, lab), g)
+            return dx
         return dy * (self._p - self._onehot) / self._n
 
 
@@ -994,6 +1013,14 @@ class Dropout(Operator):
             from .device import get_default_device
 
             key = get_default_device().next_key()
+        from .ops import pallas_kernels as _pk
+
+        if _pk.enabled() and not _pk._interpret():
+            # Pallas tier: on-core PRNG + mask + scale in one kernel
+            # (TPU only — the interpreter can't emulate the core PRNG).
+            seed = jax.random.randint(key, (), 0, 2 ** 31 - 1, jnp.int32)
+            y, self._mask = _pk.dropout(x, self.ratio, seed)
+            return y
         keep = 1.0 - self.ratio
         self._mask = jax.random.bernoulli(key, keep, x.shape).astype(x.dtype) / keep
         return x * self._mask

@@ -229,11 +229,20 @@ class Communicator:
         the reduction (the reference encodes index/value pairs; dense
         masking is the XLA-friendly equivalent — same math, and the
         mask multiply fuses into the reduce program)."""
+        from ..ops import pallas_kernels as _pk
+
         flat = jnp.ravel(x)
         if topK:
-            k = max(1, int(flat.size * spars))
-            thresh = jax.lax.top_k(jnp.abs(flat), k)[0][-1]
-            masked = jnp.where(jnp.abs(flat) >= thresh, flat, 0.0)
+            if _pk.enabled():
+                # Pallas tier: histogram-threshold kernel (keeps >= K;
+                # see pallas_kernels.topk_sparsify).
+                masked = _pk.topk_sparsify(flat, spars)
+            else:
+                k = max(1, int(flat.size * spars))
+                thresh = jax.lax.top_k(jnp.abs(flat), k)[0][-1]
+                masked = jnp.where(jnp.abs(flat) >= thresh, flat, 0.0)
+        elif _pk.enabled():
+            masked = _pk.threshold_mask(flat, spars)
         else:
             masked = jnp.where(jnp.abs(flat) >= spars, flat, 0.0)
         return jnp.reshape(self.synch(masked), x.shape)

@@ -290,3 +290,132 @@ def image_normalize(img: np.ndarray, mean, std) -> np.ndarray:
         std.ctypes.data_as(ctypes.c_void_p),
         out.ctypes.data_as(ctypes.c_void_p))
     return out
+
+
+# ---------------------------------------------------------------------------
+# Text-file record IO (reference: src/io/textfile_{reader,writer}.cc,
+# SURVEY.md N18 — value = one line, key = line number).
+# ---------------------------------------------------------------------------
+def _load_text_syms(lib):
+    if getattr(lib, "_text_ready", False):
+        return lib
+    lib.st_text_writer_open.restype = ctypes.c_void_p
+    lib.st_text_writer_open.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    lib.st_text_writer_write.restype = ctypes.c_int
+    lib.st_text_writer_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.st_text_writer_flush.restype = ctypes.c_int
+    lib.st_text_writer_flush.argtypes = [ctypes.c_void_p]
+    lib.st_text_writer_close.argtypes = [ctypes.c_void_p]
+    lib.st_text_reader_open.restype = ctypes.c_void_p
+    lib.st_text_reader_open.argtypes = [ctypes.c_char_p]
+    lib.st_text_reader_next.restype = ctypes.c_int
+    lib.st_text_reader_next.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_uint64)]
+    lib.st_text_reader_close.argtypes = [ctypes.c_void_p]
+    lib.st_csv_decode.restype = ctypes.c_int64
+    lib.st_csv_decode.argtypes = [
+        ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int)]
+    lib.st_csv_encode.restype = ctypes.c_int64
+    lib.st_csv_encode.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int, ctypes.c_int,
+        ctypes.c_char_p, ctypes.c_int64]
+    lib._text_ready = True
+    return lib
+
+
+class TextFileWriter(_Handle):
+    """Reference: `singa::io::TextFileWriter` — one record per line."""
+
+    _close_fn = "st_text_writer_close"
+
+    def __init__(self, path: str, mode: str = "w"):
+        self._lib = _load_text_syms(_load())
+        self._h = self._lib.st_text_writer_open(path.encode(),
+                                                mode.encode())
+        if not self._h:
+            raise IOError(f"cannot open {path} for writing")
+
+    def write(self, line: str) -> None:
+        if "\n" in line or "\0" in line:
+            # an embedded newline would split one record into two
+            # (shifting every later line-number key); NUL would be
+            # truncated by the C string boundary
+            raise ValueError(
+                "TextFileWriter records must not contain '\\n' or NUL")
+        if not self._lib.st_text_writer_write(self._check(),
+                                              line.encode()):
+            raise IOError("text write failed")
+
+    def flush(self) -> None:
+        self._lib.st_text_writer_flush(self._check())
+
+
+class TextFileReader(_Handle):
+    """Reference: `singa::io::TextFileReader` — yields
+    (line_number, line) with newline stripped."""
+
+    _close_fn = "st_text_reader_close"
+
+    def __init__(self, path: str):
+        self._lib = _load_text_syms(_load())
+        self._h = self._lib.st_text_reader_open(path.encode())
+        if not self._h:
+            raise IOError(f"cannot open {path}")
+
+    def read(self) -> Optional[Tuple[int, str]]:
+        key = ctypes.c_uint64()
+        val = ctypes.c_char_p()
+        vlen = ctypes.c_uint64()
+        if not self._lib.st_text_reader_next(
+                self._check(), ctypes.byref(key), ctypes.byref(val),
+                ctypes.byref(vlen)):
+            return None
+        return key.value, ctypes.string_at(val, vlen.value).decode()
+
+    def __iter__(self) -> Iterator[Tuple[int, str]]:
+        while True:
+            pair = self.read()
+            if pair is None:
+                return
+            yield pair
+
+
+# ---------------------------------------------------------------------------
+# CSV record codec (reference: src/io/csv_{encoder,decoder}.cc, N19 —
+# "label,f0,f1,..." <-> (label, float vector)).
+# ---------------------------------------------------------------------------
+def csv_decode(line: str, has_label: bool = True,
+               max_features: int = 1 << 16):
+    """Parse a CSV line into (label, np.float32 vector); label is None
+    when has_label is False."""
+    lib = _load_text_syms(_load())
+    out = np.empty(max_features, np.float32)
+    label = ctypes.c_int()
+    n = lib.st_csv_decode(line.encode(),
+                          out.ctypes.data_as(ctypes.c_void_p),
+                          max_features, int(has_label),
+                          ctypes.byref(label))
+    if n < 0:
+        raise ValueError(f"malformed CSV line: {line!r}")
+    if n > max_features:
+        raise ValueError(f"CSV line has {n} features "
+                         f"(> max_features={max_features})")
+    return (label.value if has_label else None), out[:n].copy()
+
+
+def csv_encode(values, label: Optional[int] = None) -> str:
+    """Encode a float vector (optionally label-prefixed) as one CSV
+    line."""
+    lib = _load_text_syms(_load())
+    vals = np.ascontiguousarray(values, np.float32).ravel()
+    buf_len = 32 * (len(vals) + 2)
+    buf = ctypes.create_string_buffer(buf_len)
+    n = lib.st_csv_encode(vals.ctypes.data_as(ctypes.c_void_p),
+                          len(vals),
+                          0 if label is None else int(label),
+                          int(label is not None), buf, buf_len)
+    if n < 0:
+        raise ValueError("csv_encode buffer overflow")
+    return buf.raw[:n].decode()

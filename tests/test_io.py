@@ -133,3 +133,120 @@ class TestImageTransforms:
         np.testing.assert_allclose(out[0], 0.0)
         np.testing.assert_allclose(out[1], 0.5)
         np.testing.assert_allclose(out[2], 1.0)
+
+
+class TestTextFile:
+    """Reference: src/io/textfile_{reader,writer}.cc (SURVEY N18)."""
+
+    def test_roundtrip_with_line_numbers(self, tmp_path):
+        p = str(tmp_path / "t.txt")
+        with io.TextFileWriter(p) as w:
+            for s in ("alpha", "beta,1,2", ""):
+                w.write(s)
+        with io.TextFileReader(p) as r:
+            rows = list(r)
+        assert rows == [(0, "alpha"), (1, "beta,1,2"), (2, "")]
+
+    def test_append_mode(self, tmp_path):
+        p = str(tmp_path / "t.txt")
+        with io.TextFileWriter(p) as w:
+            w.write("one")
+        with io.TextFileWriter(p, mode="a") as w:
+            w.write("two")
+        with io.TextFileReader(p) as r:
+            assert [v for _, v in r] == ["one", "two"]
+
+    def test_crlf_stripped(self, tmp_path):
+        p = str(tmp_path / "t.txt")
+        with open(p, "wb") as f:
+            f.write(b"win\r\nline2")  # no trailing newline
+        with io.TextFileReader(p) as r:
+            assert [v for _, v in r] == ["win", "line2"]
+
+    def test_missing_file_raises(self, tmp_path):
+        import pytest
+
+        with pytest.raises(IOError):
+            io.TextFileReader(str(tmp_path / "nope.txt"))
+
+
+class TestCSV:
+    """Reference: src/io/csv_{encoder,decoder}.cc (SURVEY N19)."""
+
+    def test_decode_with_label(self):
+        lab, v = io.csv_decode("5,1.5,-2.0,0.25")
+        assert lab == 5
+        np.testing.assert_allclose(v, [1.5, -2.0, 0.25])
+
+    def test_decode_without_label(self):
+        lab, v = io.csv_decode("1.5,2.5", has_label=False)
+        assert lab is None
+        np.testing.assert_allclose(v, [1.5, 2.5])
+
+    def test_roundtrip(self):
+        vals = np.asarray([0.1, -3.75, 1e-4], np.float32)
+        line = io.csv_encode(vals, label=9)
+        lab, back = io.csv_decode(line)
+        assert lab == 9
+        np.testing.assert_allclose(back, vals, rtol=1e-6)
+
+    def test_roundtrip_no_label(self):
+        line = io.csv_encode([2.0, 4.0])
+        assert line == "2,4"
+        lab, back = io.csv_decode(line, has_label=False)
+        np.testing.assert_allclose(back, [2.0, 4.0])
+
+    def test_malformed_raises(self):
+        import pytest
+
+        with pytest.raises(ValueError):
+            io.csv_decode("1,abc,3")
+
+
+class TestImageTool:
+    """Reference: python/singa/image_tool.py + JPG codec (N19)."""
+
+    def _img(self, h=32, w=48):
+        rs = np.random.RandomState(0)
+        return rs.randint(0, 255, (h, w, 3)).astype(np.uint8)
+
+    def test_jpeg_roundtrip(self):
+        from singa_tpu import image_tool as it
+
+        arr = self._img()
+        data = it.JPGEncoder(quality=95).encode(arr)
+        assert data[:2] == b"\xff\xd8"  # JPEG SOI
+        back = it.JPGDecoder().decode(data)
+        assert back.shape == arr.shape
+        # lossy codec: close in mean, not exact
+        assert abs(back.astype(float).mean() - arr.astype(float).mean()) < 5
+
+    def test_resize_crop_flip_chain(self):
+        from singa_tpu import image_tool as it
+
+        tool = it.ImageTool(seed=3)
+        out = (tool.set(self._img(64, 80)).resize_by_range(40, 48)
+               .random_crop(32).flip(prob=1.0).get_one())
+        assert out.shape == (32, 32, 3)
+
+    def test_crop5_fanout(self):
+        from singa_tpu import image_tool as it
+
+        outs = it.ImageTool().set(self._img(40, 40)).crop5(24).get()
+        assert len(outs) == 5
+        assert all(o.shape == (24, 24, 3) for o in outs)
+
+    def test_chw_conversion(self):
+        from singa_tpu import image_tool as it
+
+        arr = self._img()
+        chw = it.to_chw_float(arr)
+        assert chw.shape == (3, 32, 48) and chw.dtype == np.float32
+        np.testing.assert_array_equal(it.from_chw_float(chw), arr)
+
+    def test_color_and_enhance_bounds(self):
+        from singa_tpu import image_tool as it
+
+        out = (it.ImageTool(seed=0).set(self._img())
+               .color_cast(30).enhance(0.3).get_one())
+        assert out.dtype == np.uint8 and out.shape == (32, 48, 3)

@@ -1,0 +1,160 @@
+"""Pallas kernel tier (reference: src/core/tensor/math_kernel.cu,
+SURVEY.md N10/§7 — the hand-written kernels for fused/odd ops).
+
+Kernels run in Pallas interpret mode on the CPU backend, so this suite
+covers the kernel code paths without hardware; on a TPU the same calls
+compile to Mosaic. Parity tolerance vs the stock-jnp paths: <= 1e-5
+(VERDICT r1 next-round #4)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from singa_tpu import autograd, tensor
+from singa_tpu.ops import pallas_kernels as pk
+
+
+@pytest.fixture(autouse=True)
+def _enable_pallas():
+    pk.enable(True)
+    yield
+    pk.enable(False)
+
+
+class TestSoftmaxXent:
+    def test_forward_parity(self):
+        rs = np.random.RandomState(0)
+        x = jnp.asarray(rs.randn(33, 17).astype(np.float32))
+        lab = jnp.asarray(rs.randint(0, 17, 33).astype(np.int32))
+        got = pk.softmax_xent(x, lab)
+        want = -jax.nn.log_softmax(x, -1)[jnp.arange(33), lab]
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_backward_parity(self):
+        rs = np.random.RandomState(1)
+        x = jnp.asarray(rs.randn(16, 10).astype(np.float32))
+        lab = jnp.asarray(rs.randint(0, 10, 16).astype(np.int32))
+
+        def f_pallas(x):
+            return jnp.mean(pk.softmax_xent(x, lab))
+
+        def f_ref(x):
+            return jnp.mean(
+                -jax.nn.log_softmax(x, -1)[jnp.arange(16), lab])
+
+        np.testing.assert_allclose(jax.grad(f_pallas)(x),
+                                   jax.grad(f_ref)(x),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_autograd_op_uses_kernel_and_matches(self):
+        """autograd.SoftMaxCrossEntropy with the flag on must agree
+        with the flag off (the jnp path) in loss AND input grad."""
+        rs = np.random.RandomState(2)
+        x_np = rs.randn(12, 5).astype(np.float32)
+        t_np = rs.randint(0, 5, 12).astype(np.int32)
+
+        def run():
+            x = tensor.from_numpy(x_np)
+            x.requires_grad = True
+            x.stores_grad = True
+            t = tensor.from_numpy(t_np)
+            loss = autograd.softmax_cross_entropy(x, t)
+            grads = autograd.gradients(loss)
+            return float(loss.to_numpy()), grads[x].to_numpy()
+
+        l_pallas, g_pallas = run()
+        pk.enable(False)
+        l_ref, g_ref = run()
+        assert abs(l_pallas - l_ref) <= 1e-5
+        np.testing.assert_allclose(g_pallas, g_ref, rtol=1e-5, atol=1e-6)
+
+    def test_jit_graph_mode(self):
+        """The kernel must trace into a jitted program (graph mode)."""
+        rs = np.random.RandomState(3)
+        x = jnp.asarray(rs.randn(8, 6).astype(np.float32))
+        lab = jnp.asarray(rs.randint(0, 6, 8).astype(np.int32))
+        f = jax.jit(lambda x: jnp.mean(pk.softmax_xent(x, lab)))
+        want = float(jnp.mean(
+            -jax.nn.log_softmax(x, -1)[jnp.arange(8), lab]))
+        assert abs(float(f(x)) - want) <= 1e-5
+
+    def test_large_row_tiling(self):
+        """Rows beyond one tile (padding + multi-block grid path)."""
+        rs = np.random.RandomState(4)
+        b, c = 300, 2048  # forces row tiling with the 2^19 budget
+        x = jnp.asarray(rs.randn(b, c).astype(np.float32))
+        lab = jnp.asarray(rs.randint(0, c, b).astype(np.int32))
+        got = pk.softmax_xent(x, lab)
+        want = -jax.nn.log_softmax(x, -1)[jnp.arange(b), lab]
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+class TestTopKSparsify:
+    def test_threshold_keeps_at_least_k(self):
+        rs = np.random.RandomState(5)
+        flat = jnp.asarray(rs.randn(4096).astype(np.float32))
+        for frac in (0.01, 0.05, 0.25):
+            k = int(4096 * frac)
+            y = pk.topk_sparsify(flat, frac)
+            kept = int(jnp.sum(y != 0))
+            assert kept >= k, (frac, kept, k)
+            # conservative, but not wildly so (one histogram bin slack)
+            assert kept <= k + 4096 // 128, (frac, kept, k)
+
+    def test_mask_parity_with_jnp_at_same_threshold(self):
+        rs = np.random.RandomState(6)
+        flat = jnp.asarray(rs.randn(1000).astype(np.float32))
+        thr = pk.topk_threshold(flat, 50)
+        got = pk.threshold_mask(flat, thr)
+        want = jnp.where(jnp.abs(flat) >= thr, flat, 0.0)
+        np.testing.assert_array_equal(got, want)
+
+    def test_kept_values_are_the_largest(self):
+        rs = np.random.RandomState(7)
+        flat = jnp.asarray(rs.randn(2048).astype(np.float32))
+        y = np.asarray(pk.topk_sparsify(flat, 0.1))
+        kept = np.abs(y[y != 0])
+        dropped = np.abs(np.asarray(flat))[y == 0]
+        assert kept.min() >= dropped.max() - 1e-6
+
+    def test_communicator_sparsification_uses_kernel(self):
+        from singa_tpu.dist.communicator import Communicator
+
+        comm = Communicator(world_size=1)
+        rs = np.random.RandomState(8)
+        g = jnp.asarray(rs.randn(32, 16).astype(np.float32))
+        y = comm.sparsification(g, spars=0.1, topK=True)
+        assert y.shape == g.shape
+        kept = int(jnp.sum(y != 0))
+        assert kept >= int(g.size * 0.1)
+
+
+@pytest.mark.skipif(jax.default_backend() not in ("tpu", "axon"),
+                    reason="fused dropout uses the TPU on-core PRNG "
+                           "(pltpu.prng_*): no interpreter emulation")
+class TestDropoutTPU:
+    def test_mask_ratio_and_scale(self):
+        x = jnp.ones((256, 256), jnp.float32)
+        y, m = pk.dropout(x, 0.3, 1234)
+        keep = float(jnp.mean(m > 0))
+        assert abs(keep - 0.7) < 0.05
+        nz = np.asarray(y)[np.asarray(y) != 0]
+        np.testing.assert_allclose(nz, 1.0 / 0.7, rtol=1e-5)
+
+
+class TestEdgeCases:
+    def test_padding_labels_match_jnp_path(self):
+        """label=-1 (ignore/padding) must contribute zero loss, like
+        jax.nn.one_hot's all-zero row in the stock path."""
+        rs = np.random.RandomState(9)
+        x = jnp.asarray(rs.randn(6, 4).astype(np.float32))
+        lab = jnp.asarray([0, -1, 2, 3, -1, 1], np.int32)
+        got = pk.softmax_xent(x, lab)
+        onehot = jax.nn.one_hot(lab, 4)
+        want = -jnp.sum(onehot * jax.nn.log_softmax(x, -1), -1)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+        # grads must agree too (invalid rows get softmax*g)
+        gp = jax.grad(lambda x: jnp.sum(pk.softmax_xent(x, lab)))(x)
+        gr = jax.grad(lambda x: jnp.sum(
+            -jnp.sum(onehot * jax.nn.log_softmax(x, -1), -1)))(x)
+        np.testing.assert_allclose(gp, gr, rtol=1e-5, atol=1e-6)

@@ -1,0 +1,47 @@
+"""CIFAR-10 loss-parity gate (BASELINE.md row 2; VERDICT r1 #7).
+
+Reference: graph-vs-eager loss equality is the reference's key model
+test invariant (test/python/test_model.py, SURVEY.md §4.2); the
+committed PARITY_cifar10.json extends it across backends (host CPU
+vs TPU chip)."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def test_cifar_cnn_eager_vs_graph_parity_small():
+    """Regenerates the core parity property at small scale in-process:
+    same CNN config, eager vs jit curves within tolerance."""
+    sys.path.insert(0, _ROOT)
+    from tools.parity_cifar10 import max_rel_diff, train_curve
+
+    eager = train_curve("cpu", False, steps=6)
+    graph = train_curve("cpu", True, steps=6)
+    assert len(eager) == len(graph) == 6
+    assert max_rel_diff(eager, graph) <= 2e-2, (eager, graph)
+    # and training actually trains
+    assert graph[-1] < graph[0]
+
+
+def test_committed_artifact_is_valid():
+    """The committed PARITY_cifar10.json must exist, carry the CPU
+    pair within its recorded tolerance, and keep the TPU slot
+    (curve or an explicit error record)."""
+    path = os.path.join(_ROOT, "PARITY_cifar10.json")
+    assert os.path.exists(path), "run tools/parity_cifar10.py"
+    with open(path) as f:
+        art = json.load(f)
+    tol = art["config"]["tolerance_rel"]
+    diffs = art["max_rel_diffs"]
+    assert "cpu_eager_vs_cpu_graph" in diffs
+    assert diffs["cpu_eager_vs_cpu_graph"] <= tol
+    assert all(v <= tol for v in diffs.values()), diffs
+    assert "tpu_graph" in art["curves"]
+    if art["curves"]["tpu_graph"] is None:
+        assert art["errors"].get("tpu_graph"), \
+            "missing TPU curve must be explained"

@@ -1,0 +1,161 @@
+"""CIFAR-10 loss-curve parity artifact (the north star's correctness
+gate; BASELINE.md row 2, VERDICT r1 next-round #7).
+
+Reference invariant: the same CNN config must produce the same loss
+trajectory on CppCPU and CudaGPU within tolerance
+(test/python/test_model.py's graph-vs-eager discipline, SURVEY.md
+§4.2). The TPU translation: train the CIFAR CNN config for N steps
+
+  * on the host XLA CPU backend, eager (per-op dispatch),
+  * on the host XLA CPU backend, graph mode (one jit program),
+  * on the TPU chip, graph mode (skipped if the chip is unreachable —
+    recorded as null),
+
+save all curves + pairwise max relative differences to
+PARITY_cifar10.json at the repo root, and fail if any available pair
+diverges beyond tolerance.
+
+Data: deterministic synthetic CIFAR-shaped batches (this environment
+has no dataset downloads); the parity property is about execution
+backends, not data provenance.
+
+Run: python tools/parity_cifar10.py [--steps N] [--skip-tpu]
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, _ROOT)
+sys.path.insert(0, os.path.join(_ROOT, "examples", "cnn", "model"))
+
+TOL_REL = 2e-2  # bf16-free fp32 runs track much tighter; headroom for TPU
+
+
+def train_curve(backend: str, use_graph: bool, steps: int,
+                batch: int = 32, lr: float = 0.05):
+    """One training run; returns the per-step loss list."""
+    import jax
+
+    if backend == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+        from jax.extend.backend import clear_backends
+
+        clear_backends()
+
+    import cnn as cnn_mod
+
+    from singa_tpu import device, opt, tensor
+
+    dev = (device.create_tpu_device() if backend == "tpu"
+           else device.get_default_device())
+    dev.SetRandSeed(7)
+    m = cnn_mod.create_model(num_classes=10)
+    m.set_optimizer(opt.SGD(lr=lr, momentum=0.9))
+
+    rs = np.random.RandomState(0)
+    x_np = rs.randn(steps, batch, 3, 32, 32).astype(np.float32)
+    y_np = rs.randint(0, 10, (steps, batch)).astype(np.int32)
+
+    tx = tensor.from_numpy(x_np[0], device=dev)
+    m.compile([tx], is_train=True, use_graph=use_graph)
+    losses = []
+    for s in range(steps):
+        tx = tensor.from_numpy(x_np[s], device=dev)
+        ty = tensor.from_numpy(y_np[s], device=dev)
+        out, loss = m(tx, ty)
+        losses.append(float(loss.to_numpy()))
+    return losses
+
+
+def _curve_in_subprocess(backend, use_graph, steps, timeout):
+    """Each curve runs in its own process: backend selection is global
+    jax state, and a hung TPU dial must not kill the whole artifact."""
+    code = (
+        "import sys; sys.path.insert(0, {root!r});"
+        "from tools.parity_cifar10 import train_curve;"
+        "import json;"
+        "print('CURVE ' + json.dumps(train_curve({backend!r}, {graph},"
+        " {steps})))"
+    ).format(root=_ROOT, backend=backend, graph=use_graph, steps=steps)
+    try:
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True,
+                             timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return None, "timeout"
+    for line in out.stdout.splitlines():
+        if line.startswith("CURVE "):
+            return json.loads(line[len("CURVE "):]), None
+    return None, (out.stderr or "no output")[-500:]
+
+
+def max_rel_diff(a, b):
+    a, b = np.asarray(a), np.asarray(b)
+    return float(np.max(np.abs(a - b) / np.maximum(np.abs(a), 1e-3)))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--skip-tpu", action="store_true")
+    ap.add_argument("--tpu-timeout", type=float, default=600.0)
+    a = ap.parse_args()
+
+    curves = {}
+    errors = {}
+    for name, backend, graph, to in [
+        ("cpu_eager", "cpu", False, 1200),
+        ("cpu_graph", "cpu", True, 1200),
+    ]:
+        print(f"running {name}...", file=sys.stderr, flush=True)
+        curves[name], err = _curve_in_subprocess(backend, graph,
+                                                 a.steps, to)
+        if err:
+            errors[name] = err
+    if not a.skip_tpu:
+        print("running tpu_graph...", file=sys.stderr, flush=True)
+        curves["tpu_graph"], err = _curve_in_subprocess(
+            "tpu", True, a.steps, a.tpu_timeout)
+        if err:
+            errors["tpu_graph"] = err
+    else:
+        curves["tpu_graph"] = None
+        errors["tpu_graph"] = "skipped"
+
+    diffs = {}
+    pairs = [("cpu_eager", "cpu_graph"), ("cpu_graph", "tpu_graph"),
+             ("cpu_eager", "tpu_graph")]
+    for x, y in pairs:
+        if curves.get(x) and curves.get(y):
+            diffs[f"{x}_vs_{y}"] = max_rel_diff(curves[x], curves[y])
+
+    artifact = {
+        "config": {"model": "examples/cnn/model/cnn.py", "batch": 32,
+                   "steps": a.steps, "lr": 0.05, "momentum": 0.9,
+                   "data": "synthetic CIFAR-shaped, seed 0",
+                   "tolerance_rel": TOL_REL},
+        "curves": curves, "max_rel_diffs": diffs, "errors": errors,
+    }
+    path = os.path.join(_ROOT, "PARITY_cifar10.json")
+    with open(path, "w") as f:
+        json.dump(artifact, f, indent=1)
+    print(f"wrote {path}")
+    print(json.dumps({"max_rel_diffs": diffs, "errors": errors}))
+
+    bad = {k: v for k, v in diffs.items() if v > TOL_REL}
+    if bad:
+        print(f"PARITY FAIL: {bad}", file=sys.stderr)
+        sys.exit(1)
+    if not diffs:
+        print("PARITY FAIL: no comparable pairs", file=sys.stderr)
+        sys.exit(1)
+    print("PARITY OK")
+
+
+if __name__ == "__main__":
+    main()
