@@ -53,7 +53,8 @@ def run(args):
 
     if args.precision == "bf16":
         tensor.set_matmul_precision("default")
-        tx_np = tx_np.astype(np.float32)  # params stay fp32; matmuls bf16
+        tensor.set_compute_dtype("bfloat16")  # bf16 activations, fp32 params
+        tx_np = tx_np.astype(np.float32)
 
     sgd = opt.SGD(lr=args.lr, momentum=0.9, weight_decay=1e-5)
     if args.dist:

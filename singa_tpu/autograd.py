@@ -539,9 +539,11 @@ class Equal(Operator):
 
 # ---- matmul family --------------------------------------------------------
 class Mult(Operator):
-    """GEMM/batched matmul. Reference: `autograd.Mult` → `singa::Mult`."""
+    """GEMM/batched matmul. Reference: `autograd.Mult` → `singa::Mult`.
+    Under AMP (`tensor.set_compute_dtype`) operands cast to bf16 here."""
 
     def fn(self, a, b):
+        a, b = tensor_mod.amp_cast(a, b)
         return jnp.matmul(a, b, precision=tensor_mod.get_matmul_precision())
 
 
@@ -554,13 +556,14 @@ class Gemm(Operator):
         self.transA, self.transB = transA, transB
 
     def fn(self, a, b, *c):
+        a, b = tensor_mod.amp_cast(a, b)
         A = a.T if self.transA else a
         B = b.T if self.transB else b
         y = self.alpha * jnp.matmul(
             A, B, precision=tensor_mod.get_matmul_precision()
         )
         if c:
-            y = y + self.beta * c[0]
+            y = y + self.beta * c[0].astype(y.dtype)
         return y
 
 
@@ -572,6 +575,7 @@ class AddBias(Operator):
         self.axis = axis  # 0: per-column bias (add to each row)
 
     def fn(self, x, b):
+        b = b.astype(x.dtype) if b.dtype != x.dtype else b
         return x + b[None, :] if self.axis == 0 else x + b[:, None]
 
 
@@ -869,6 +873,11 @@ class SoftMaxCrossEntropy(Operator):
 
     def forward(self, x):
         t = self.t
+        # Loss math always in fp32 (bf16 logsumexp loses ~2 decimal
+        # digits); under AMP the incoming logits are bf16. backward
+        # returns dx in the original dtype so the vjp chain stays bf16.
+        self._in_dtype = x.dtype
+        x = x.astype(jnp.float32) if x.dtype != jnp.float32 else x
         int_labels = t.ndim == x.ndim - 1 or (
             t.ndim == x.ndim and t.shape[-1] == 1)
         n = x.shape[0] if x.ndim > 1 else 1
@@ -883,12 +892,14 @@ class SoftMaxCrossEntropy(Operator):
             self._pallas_res = (x, lab)
             return jnp.sum(_pk.softmax_xent(x, lab)) / n
         self._pallas_res = None
+        self._valid = None
         if int_labels:
-            t = jax.nn.one_hot(
-                t.reshape(t.shape[: x.ndim - 1]).astype(jnp.int32),
-                x.shape[-1],
-                dtype=x.dtype,
-            )
+            ti = t.reshape(t.shape[: x.ndim - 1]).astype(jnp.int32)
+            # Padding labels (e.g. -1) produce an all-zero one_hot row
+            # -> zero loss; the backward masks the same rows to zero
+            # grad (matching the Pallas kernel's semantics).
+            self._valid = ((ti >= 0) & (ti < x.shape[-1]))[..., None]
+            t = jax.nn.one_hot(ti, x.shape[-1], dtype=x.dtype)
         self._onehot = t
         logp = jax.nn.log_softmax(x, axis=-1)
         self._p = jnp.exp(logp)
@@ -901,8 +912,11 @@ class SoftMaxCrossEntropy(Operator):
             x, lab = self._pallas_res
             g = jnp.full((x.shape[0],), dy / self._n, jnp.float32)
             dx, _ = _pk._softmax_xent_bwd((x, lab), g)
-            return dx
-        return dy * (self._p - self._onehot) / self._n
+            return dx.astype(self._in_dtype)
+        dx = dy * (self._p - self._onehot) / self._n
+        if self._valid is not None:
+            dx = jnp.where(self._valid, dx, 0.0)
+        return dx.astype(self._in_dtype)
 
 
 class MeanSquareError(Operator):

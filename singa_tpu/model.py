@@ -26,6 +26,7 @@ import json
 import os
 import time
 import zipfile
+from collections import OrderedDict
 from contextlib import contextmanager
 from typing import Dict, List, Optional
 
@@ -77,23 +78,21 @@ class Model(Layer):
         dev = inputs[0].device if inputs else None
         if dev is not None:
             dev.EnableGraph(use_graph)
-        # One real forward initializes all lazy params. On an
-        # accelerator device this would dispatch hundreds of one-op
-        # programs through PJRT (each separately compiled — minutes on
-        # a remote TPU); run it on the host XLA CPU backend instead and
-        # migrate the created params over. Threefry RNG is
-        # backend-deterministic, so init values are identical.
-        needs_host_init = (
-            inputs and not self.param_tensors()
-            and ((dev is not None and dev.lang != "cpp")
-                 or mesh is not None
-                 or any(not getattr(t.data, "is_fully_addressable", True)
-                        for t in inputs)))
-        if needs_host_init:
-            self._host_init_forward(inputs, dev)
-        else:
-            # Params already exist (a forward ran before compile) or
-            # inputs are host-side: run the tracing forward in place.
+        # One forward initializes all lazy params. Running it eagerly
+        # dispatches hundreds of one-op XLA programs (each separately
+        # compiled — 100-330 s for ResNet-50, scaling with batch); so
+        # by default it runs as ONE jitted program on the host XLA CPU
+        # backend at batch 1 (lazy init only reads feature dims), and
+        # the created params migrate to `dev`. Threefry RNG is
+        # backend-deterministic, so init values are identical either
+        # way. Falls back to the eager path if the trace fails (e.g. a
+        # custom initialize() that inspects concrete values).
+        if inputs and not self.param_tensors():
+            if not self._jit_init_forward(inputs, dev):
+                self._host_init_forward(inputs, dev)
+        elif inputs:
+            # Params already exist (a forward ran before compile):
+            # run the tracing forward in place.
             self.forward(*inputs)
         self._use_graph = use_graph or mesh is not None
         self._mesh, self._rules, self._batch_specs = mesh, rules, batch_specs
@@ -101,6 +100,80 @@ class Model(Layer):
         self._jit_fwd = None
         if dev is not None:
             dev.EnableGraph(False)
+
+    def _jit_init_forward(self, inputs, dev) -> bool:
+        """Run the lazy-param-init forward as ONE jitted XLA program on
+        the host CPU backend, then migrate created params/states to
+        `dev`. Returns False (leaving the model untouched) if the init
+        forward is not trace-safe, so `compile` can fall back to the
+        eager `_host_init_forward`.
+
+        Inputs are sliced to batch 1 (leading dim) — lazy `initialize`
+        only reads feature dims — so init cost is independent of batch
+        size; set SINGA_TPU_INIT_FULL_BATCH=1 for models whose forward
+        bakes in the batch dim. The device RNG key is threaded through
+        the program per `next_key` call, so init values and the
+        post-init key state match the eager path bit-for-bit.
+        """
+        from .device import get_default_device
+
+        cpu = get_default_device()
+        full = os.environ.get("SINGA_TPU_INIT_FULL_BATCH", "0") == "1"
+        arrays = []
+        for t in inputs:
+            arr = t.data
+            if not getattr(arr, "is_fully_addressable", True):
+                arr = arr.addressable_shards[0].data
+            arr = np.asarray(arr)
+            if not full and arr.ndim >= 1 and arr.shape[0] > 1:
+                arr = arr[:1]
+            arrays.append(arr)
+        borrow = dev is not None and dev is not cpu
+        key0 = jax.device_put(
+            np.asarray(dev._rng_key if borrow else cpu._rng_key),
+            cpu.jax_device)
+        snap = _lazy_snapshot(self)
+        created = {}
+
+        def init_fn(key, batch):
+            saved_key = cpu._rng_key
+            cpu._rng_key = key
+            try:
+                xs = [tensor_mod.from_raw(b, cpu) for b in batch]
+                self.forward(*xs)
+                created["params"] = self.param_tensors()
+                created["states"] = self.state_tensors()
+                return ([p.data for p in created["params"]],
+                        [s.data for s in created["states"]],
+                        cpu._rng_key)
+            finally:
+                cpu._rng_key = saved_key
+
+        try:
+            pvals, svals, new_key = jax.jit(init_fn)(key0, tuple(arrays))
+        except Exception as e:
+            import sys
+
+            print(f"singa_tpu: jitted init forward failed "
+                  f"({type(e).__name__}: {e}); falling back to eager "
+                  f"init (try SINGA_TPU_INIT_FULL_BATCH=1 if the model "
+                  f"bakes in the batch dim)", file=sys.stderr)
+            _lazy_restore(self, snap)
+            return False
+        for p, v in zip(created["params"], pvals):
+            p.data = v
+            p.device = cpu
+        for s, v in zip(created["states"], svals):
+            s.data = v
+            s.device = cpu
+        if borrow:
+            dev._rng_key = jax.device_put(new_key, dev.jax_device)
+        else:
+            cpu._rng_key = jax.device_put(new_key, cpu.jax_device)
+        if dev is not None and dev is not cpu:
+            for t in self.param_tensors() + self.state_tensors():
+                t.to_device(dev)
+        return True
 
     def _host_init_forward(self, inputs, dev):
         """Run the param-init forward on host CPU, borrowing `dev`'s RNG
@@ -265,6 +338,32 @@ class Model(Layer):
         self._jit_step = None  # state changed: force retrace
         self._jit_fwd = None
         return meta.get("aux", {})
+
+
+def _lazy_snapshot(root: Layer):
+    """Record every layer's lazy-init state (for rollback if a traced
+    init forward fails midway, leaving tracer-valued params behind)."""
+    recs = []
+    stack = [root]
+    while stack:
+        l = stack.pop()
+        recs.append((l, l._initialized,
+                     OrderedDict(l.__dict__.get("_params", ())),
+                     list(l.__dict__.get("_state_attrs", ())),
+                     set(l.sublayers.keys())))
+        stack.extend(l.sublayers.values())
+    return recs
+
+
+def _lazy_restore(root: Layer, recs):
+    for l, inited, params, state_attrs, subkeys in recs:
+        l._initialized = inited
+        l.__dict__["_params"] = OrderedDict(params)
+        l.__dict__["_state_attrs"] = list(state_attrs)
+        subs = l.__dict__.get("_sublayers")
+        if subs is not None:
+            for k in [k for k in subs if k not in subkeys]:
+                del subs[k]
 
 
 def _jsonable(d):

@@ -78,6 +78,11 @@ class ConvHandle:
 @partial(jax.jit, static_argnums=(0,), inline=True)
 def _conv2d_nobias(handle: ConvHandle, x, w):
     ph, pw = handle.padding
+    # fp32 operands: force fp32 accumulation explicitly. bf16 (AMP):
+    # omit preferred_element_type — the MXU still accumulates fp32
+    # internally, and jax 0.9's conv transpose rule rejects mixed
+    # cotangent/operand dtypes when preferred != operand dtype.
+    pref = jnp.float32 if x.dtype == jnp.float32 else None
     return lax.conv_general_dilated(
         x,
         w,
@@ -86,7 +91,7 @@ def _conv2d_nobias(handle: ConvHandle, x, w):
         rhs_dilation=handle.dilation,
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
         feature_group_count=handle.groups,
-        preferred_element_type=jnp.float32,
+        preferred_element_type=pref,
     ).astype(x.dtype)
 
 
@@ -94,7 +99,13 @@ def conv2d(handle: ConvHandle, x, w, b=None):
     """Reference: `GpuConvForward(x, W, b, handle)`.
 
     x: (N, C, H, W); w: (O, C/groups, kh, kw); b: (O,) or None.
+    Under the AMP policy (`tensor.set_compute_dtype`), operands cast to
+    bf16 at this boundary (fp32 MXU accumulation via
+    preferred_element_type) and the output stays bf16.
     """
+    from .. import tensor as tensor_mod
+
+    x, w, b = tensor_mod.amp_cast(x, w, b)
     y = _conv2d_nobias(handle, x, w)
     if b is not None:
         y = y + b.reshape(1, -1, 1, 1)
@@ -123,12 +134,17 @@ def batchnorm_training(handle: BatchNormHandle, x, scale, bias, running_mean, ru
     running state from them).
     """
     axes = tuple(i for i in range(x.ndim) if i != 1)
-    mean = jnp.mean(x, axis=axes)
+    # Statistics always in fp32 (under AMP, x is bf16 but cuDNN-parity
+    # running stats must not drift); the normalized output returns to
+    # x's dtype so bf16 activations stay bf16 through BN.
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes)
     # cuDNN uses biased variance for normalization.
-    var = jnp.var(x, axis=axes)
+    var = jnp.var(xf, axis=axes)
     shape = [1, -1] + [1] * (x.ndim - 2)
     inv = lax.rsqrt(var + handle.eps).reshape(shape)
-    y = (x - mean.reshape(shape)) * inv * scale.reshape(shape) + bias.reshape(shape)
+    y = ((xf - mean.reshape(shape)) * inv * scale.reshape(shape)
+         + bias.reshape(shape)).astype(x.dtype)
     f = handle.factor
     new_rm = (1.0 - f) * running_mean + f * mean
     new_rv = (1.0 - f) * running_var + f * var
@@ -139,9 +155,9 @@ def batchnorm_inference(handle: BatchNormHandle, x, scale, bias, running_mean, r
     """Reference: `GpuBatchNormForwardInference`."""
     shape = [1, -1] + [1] * (x.ndim - 2)
     inv = lax.rsqrt(running_var + handle.eps).reshape(shape)
-    return (x - running_mean.reshape(shape)) * inv * scale.reshape(shape) + bias.reshape(
-        shape
-    )
+    y = (x.astype(jnp.float32) - running_mean.reshape(shape)) * inv \
+        * scale.reshape(shape) + bias.reshape(shape)
+    return y.astype(x.dtype)
 
 
 class PoolingHandle:

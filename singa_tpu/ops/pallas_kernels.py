@@ -97,7 +97,11 @@ def _xent_bwd_kernel(x_ref, lab_ref, g_ref, dx_ref):
     p = e / jnp.sum(e, axis=-1, keepdims=True)
     classes = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
     onehot = (classes == lab).astype(jnp.float32)
-    dx_ref[...] = ((p - onehot) * g).astype(dx_ref.dtype)
+    # Same validity mask as the forward: padding rows (label -1 or
+    # out-of-range) produced zero loss, so they get zero gradient.
+    valid = (lab >= 0) & (lab < x.shape[-1])
+    dx_ref[...] = jnp.where(valid, (p - onehot) * g,
+                            0.0).astype(dx_ref.dtype)
 
 
 def _pad_rows(a, tile):
@@ -239,9 +243,8 @@ def _hist_kernel(x_ref, gmax_ref, hist_ref):
         base = c * _HIST_CHUNK
         counts = jnp.sum((lane + base == idx[:, None])
                          .astype(jnp.float32), axis=0)
-        pl.store(hist_ref, (pl.dslice(c, 1), slice(None)),
-                 pl.load(hist_ref, (pl.dslice(c, 1), slice(None)))
-                 + counts[None, :])
+        hist_ref[pl.dslice(c, 1), :] = (hist_ref[pl.dslice(c, 1), :]
+                                        + counts[None, :])
         return 0
 
     jax.lax.fori_loop(0, _BINS // _HIST_CHUNK, chunk, 0)

@@ -1,0 +1,107 @@
+"""Mixed-precision (bf16 compute / fp32 params) policy tests.
+
+Reference context: the reference gates half precision behind
+`train_cnn.py --precision` + DistOpt's fp16 allreduce
+(src/io/communicator.cc synchHalf); the TPU-native equivalent is the
+`tensor.set_compute_dtype` AMP policy — bf16 activations/gradients,
+fp32 master params and BN statistics.
+"""
+import numpy as np
+import pytest
+
+from singa_tpu import device, layer, model, opt, tensor
+
+
+class _ConvNet(model.Model):
+    def __init__(self):
+        super().__init__()
+        self.conv = layer.Conv2d(8, 3, padding=1)
+        self.bn = layer.BatchNorm2d()
+        self.relu = layer.ReLU()
+        self.pool = layer.MaxPool2d(2, 2)
+        self.flat = layer.Flatten()
+        self.fc = layer.Linear(10)
+
+    def forward(self, x):
+        return self.fc(self.flat(self.pool(self.relu(self.bn(self.conv(x))))))
+
+
+@pytest.fixture
+def amp():
+    tensor.set_compute_dtype("bfloat16")
+    yield
+    tensor.set_compute_dtype(None)
+
+
+def _data(dev, n=8):
+    rs = np.random.RandomState(0)
+    tx = tensor.from_numpy(rs.randn(n, 3, 8, 8).astype(np.float32), device=dev)
+    ty = tensor.from_numpy(rs.randint(0, 10, n).astype(np.int32), device=dev)
+    return tx, ty
+
+
+def test_amp_dtypes_and_convergence_eager(amp):
+    dev = device.get_default_device()
+    dev.SetRandSeed(3)
+    m = _ConvNet()
+    m.set_optimizer(opt.SGD(lr=0.05, momentum=0.9))
+    tx, ty = _data(dev)
+    m.compile([tx], is_train=True, use_graph=False)
+    losses = []
+    for _ in range(10):
+        out, loss = m(tx, ty)
+        losses.append(float(loss.to_numpy()))
+    # activations bf16, loss fp32, params fp32
+    assert out.data.dtype == tensor.bfloat16
+    assert loss.data.dtype == np.float32
+    for p in m.param_tensors():
+        assert p.data.dtype == np.float32, p.name
+    assert losses[-1] < losses[0]
+
+
+def test_amp_graph_mode_matches_eager(amp):
+    dev = device.get_default_device()
+    tx, ty = _data(dev)
+
+    def run(use_graph):
+        dev.SetRandSeed(11)
+        m = _ConvNet()
+        m.set_optimizer(opt.SGD(lr=0.05, momentum=0.9))
+        m.compile([tx], is_train=True, use_graph=use_graph)
+        ls = []
+        for _ in range(5):
+            _, loss = m(tx, ty)
+            ls.append(float(loss.to_numpy()))
+        return ls
+
+    eager, graph = run(False), run(True)
+    # identical program modulo compilation — bf16 math, loose tol
+    np.testing.assert_allclose(eager, graph, rtol=2e-2, atol=2e-2)
+
+
+def test_amp_bn_stats_stay_fp32(amp):
+    dev = device.get_default_device()
+    dev.SetRandSeed(5)
+    m = _ConvNet()
+    m.set_optimizer(opt.SGD(lr=0.05))
+    tx, ty = _data(dev)
+    m.compile([tx], is_train=True, use_graph=False)
+    m(tx, ty)
+    for s in m.state_tensors():
+        assert s.data.dtype == np.float32
+    # running stats actually moved off their init
+    stats = {k: v.to_numpy() for k, v in m.get_states().items()
+             if "running" in k}
+    assert any(np.abs(v).sum() > 0 for k, v in stats.items()
+               if "mean" in k)
+
+
+def test_amp_off_is_pure_fp32():
+    dev = device.get_default_device()
+    dev.SetRandSeed(3)
+    m = _ConvNet()
+    m.set_optimizer(opt.SGD(lr=0.05))
+    tx, ty = _data(dev)
+    m.compile([tx], is_train=True, use_graph=False)
+    out, loss = m(tx, ty)
+    assert out.data.dtype == np.float32

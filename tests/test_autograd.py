@@ -414,3 +414,18 @@ def test_deterministic_grad_order():
     # orders from two identical runs have same relative structure
     o1, o2 = run(), run()
     assert len(o1) == len(o2) == 3
+
+
+def test_softmax_cross_entropy_padding_labels_zero_grad():
+    """Padding labels (-1) contribute zero loss AND zero gradient on
+    the jnp path — must match the Pallas kernel's masking
+    (pallas_kernels._xent_bwd_kernel)."""
+    rs = np.random.RandomState(3)
+    logits_np = rs.randn(5, 7).astype(np.float32)
+    labels = np.array([0, -1, 3, -1, 6], np.int32)
+    x = param(logits_np)
+    loss = autograd.softmax_cross_entropy(x, tensor.from_numpy(labels))
+    grads = {id(p): g for p, g in autograd.backward(loss)}
+    g = np.asarray(grads[id(x)].to_numpy())
+    assert np.abs(g[[1, 3]]).max() == 0.0, "padding rows leaked gradient"
+    assert np.abs(g[[0, 2, 4]]).max() > 0

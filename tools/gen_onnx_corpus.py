@@ -1,0 +1,388 @@
+"""Generate the ONNX conformance corpus (VERDICT r3 Missing #3).
+
+Reference context: the reference gets hundreds of conformance cases for
+free from `onnx.backend.test` (`test/python/test_onnx_backend.py`,
+SURVEY.md §4.2). This environment has no `onnx` package, so the corpus
+is generated offline with the in-repo wire-compatible proto
+(`singa_tpu.proto.onnx_ir_pb2`): one tiny single-node model per
+importer mapping, inputs drawn from a fixed seed, expected outputs
+computed by *independent numpy implementations* of the ONNX operator
+spec (NOT by the import path under test).
+
+Outputs (committed):
+  tests/onnx_corpus/<case>.onnx   — serialized ModelProto
+  tests/onnx_corpus/<case>.npz    — in_0..  / out_0..  arrays
+  tests/onnx_corpus/manifest.json — case -> {op, n_in, n_out, rtol, atol}
+
+tests/test_onnx_conformance.py sweeps the corpus and fails if any
+`sonnx._IMPORTERS` key has no case here.
+
+Run: python tools/gen_onnx_corpus.py
+"""
+import json
+import math
+import os
+import sys
+
+import numpy as np
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, _ROOT)
+
+from singa_tpu import sonnx  # noqa: E402
+from singa_tpu.proto import onnx_ir_pb2 as P  # noqa: E402
+
+OUT_DIR = os.path.join(_ROOT, "tests", "onnx_corpus")
+
+_erf = np.vectorize(math.erf)
+
+
+def _model(op, n_in, consts=(), attrs=None, n_out=1, value_attr=None):
+    """Single-node ModelProto: runtime inputs in_0..;, then initializer
+    inputs (consts) in declaration order, -> out_0..;."""
+    mp = P.ModelProto()
+    mp.ir_version = 8
+    g = mp.graph
+    g.name = f"conformance_{op}"
+    in_names = [f"in_{i}" for i in range(n_in)]
+    const_names = []
+    for i, arr in enumerate(consts):
+        name = f"c_{i}"
+        g.initializer.append(sonnx.to_tensor_proto(name, np.asarray(arr)))
+        const_names.append(name)
+    out_names = [f"out_{i}" for i in range(n_out)]
+    node = g.node.add()
+    node.op_type = op
+    node.name = f"{op}_0"
+    node.input.extend(in_names + const_names)
+    node.output.extend(out_names)
+    for k, v in (attrs or {}).items():
+        if v is not None:
+            node.attribute.append(sonnx._make_attr(k, v))
+    if value_attr is not None:  # Constant's TensorProto attribute
+        a = node.attribute.add()
+        a.name = "value"
+        a.type = P.AttributeProto.TENSOR
+        a.t.CopyFrom(sonnx.to_tensor_proto("value", value_attr))
+    for name in in_names:
+        g.input.add().name = name
+    for name in out_names:
+        g.output.add().name = name
+    return mp
+
+
+def _rng(seed=0):
+    return np.random.RandomState(seed)
+
+
+def _f(shape, seed=0, lo=-2.0, hi=2.0):
+    return _rng(seed).uniform(lo, hi, shape).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# numpy references for the compound ops
+# ---------------------------------------------------------------------------
+def np_conv2d(x, w, b=None, stride=(1, 1), pads=(0, 0), dilation=(1, 1),
+              groups=1):
+    n, cin, h, wd = x.shape
+    cout, cin_g, kh, kw = w.shape
+    ph, pw = pads
+    xp = np.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+    dh, dw = dilation
+    oh = (h + 2 * ph - dh * (kh - 1) - 1) // stride[0] + 1
+    ow = (wd + 2 * pw - dw * (kw - 1) - 1) // stride[1] + 1
+    y = np.zeros((n, cout, oh, ow), np.float32)
+    cpg_out = cout // groups
+    for gi in range(groups):
+        for oc in range(gi * cpg_out, (gi + 1) * cpg_out):
+            for i in range(oh):
+                for j in range(ow):
+                    patch = xp[:, gi * cin_g:(gi + 1) * cin_g,
+                               i * stride[0]:i * stride[0] + dh * kh:dh,
+                               j * stride[1]:j * stride[1] + dw * kw:dw]
+                    y[:, oc, i, j] = np.sum(
+                        patch * w[oc][None], axis=(1, 2, 3))
+    if b is not None:
+        y += b.reshape(1, -1, 1, 1)
+    return y
+
+
+def np_pool(x, k, s, is_max):
+    n, c, h, w = x.shape
+    oh, ow = (h - k) // s + 1, (w - k) // s + 1
+    y = np.zeros((n, c, oh, ow), np.float32)
+    red = np.max if is_max else np.mean
+    for i in range(oh):
+        for j in range(ow):
+            y[:, :, i, j] = red(
+                x[:, :, i * s:i * s + k, j * s:j * s + k], axis=(2, 3))
+    return y
+
+
+def np_softmax(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+def np_depth_to_space(x, bs):
+    n, c, h, w = x.shape
+    y = x.reshape(n, bs, bs, c // bs**2, h, w)
+    y = y.transpose(0, 3, 4, 1, 5, 2)
+    return y.reshape(n, c // bs**2, h * bs, w * bs)
+
+
+def np_space_to_depth(x, bs):
+    n, c, h, w = x.shape
+    y = x.reshape(n, c, h // bs, bs, w // bs, bs)
+    y = y.transpose(0, 3, 5, 1, 2, 4)
+    return y.reshape(n, c * bs**2, h // bs, w // bs)
+
+
+# ---------------------------------------------------------------------------
+# Case table. Each entry: name -> (model, inputs, expected, rtol, atol)
+# ---------------------------------------------------------------------------
+def build_cases():
+    cases = {}
+
+    def add(name, model, inputs, expected, rtol=1e-5, atol=1e-5):
+        assert name not in cases, name
+        cases[name] = (model, list(inputs), list(expected), rtol, atol)
+
+    x = _f((3, 5))
+    xpos = _f((3, 5), lo=0.1, hi=2.0)
+    unit = _f((3, 5), lo=-0.97, hi=0.97)
+
+    for op, fn, arr in [
+        ("Relu", lambda v: np.maximum(v, 0), x),
+        ("Sigmoid", lambda v: 1 / (1 + np.exp(-v)), x),
+        ("Tanh", np.tanh, x),
+        ("Abs", np.abs, x),
+        ("Exp", np.exp, x),
+        ("Log", np.log, xpos),
+        ("Sqrt", np.sqrt, xpos),
+        ("Neg", np.negative, x),
+        ("Reciprocal", lambda v: 1.0 / v, xpos),
+        ("Erf", lambda v: _erf(v).astype(np.float32), x),
+        ("Ceil", np.ceil, x),
+        ("Floor", np.floor, x),
+        ("Round", lambda v: np.round(v), x),
+        ("Sign", np.sign, x),
+        ("Cos", np.cos, x), ("Sin", np.sin, x), ("Tan", np.tan, unit),
+        ("Acos", np.arccos, unit), ("Asin", np.arcsin, unit),
+        ("Atan", np.arctan, x),
+        ("Cosh", np.cosh, x), ("Sinh", np.sinh, x),
+        ("Acosh", np.arccosh, _f((3, 5), lo=1.1, hi=3.0)),
+        ("Asinh", np.arcsinh, x), ("Atanh", np.arctanh, unit),
+        ("Softplus", lambda v: np.log1p(np.exp(-np.abs(v)))
+         + np.maximum(v, 0), x),
+        ("Softsign", lambda v: v / (1 + np.abs(v)), x),
+        ("Gelu", lambda v: 0.5 * v * (1 + _erf(v / math.sqrt(2))), x),
+        ("Identity", lambda v: v, x),
+    ]:
+        add(op.lower(), _model(op, 1),
+            [arr], [fn(arr).astype(np.float32)], rtol=1e-4, atol=1e-5)
+
+    a, b = _f((3, 5), 1), _f((3, 5), 2, lo=0.5, hi=2.0)
+    for op, fn in [("Add", np.add), ("Sub", np.subtract),
+                   ("Mul", np.multiply), ("Div", np.divide),
+                   ("Min", np.minimum), ("Max", np.maximum)]:
+        add(op.lower(), _model(op, 2), [a, b],
+            [fn(a, b).astype(np.float32)])
+    add("pow", _model("Pow", 2), [b, a], [np.power(b, a)], rtol=1e-4)
+    for op, fn in [("Less", np.less), ("Greater", np.greater),
+                   ("Equal", np.equal)]:
+        add(op.lower(), _model(op, 2), [a, a if op == "Equal" else b],
+            [fn(a, a if op == "Equal" else b)])
+    m1, m2 = _f((3, 4), 3), _f((4, 2), 4)
+    add("matmul", _model("MatMul", 2), [m1, m2], [m1 @ m2], rtol=1e-4)
+
+    add("softmax", _model("Softmax", 1, attrs={"axis": -1}), [x],
+        [np_softmax(x)])
+    add("logsoftmax", _model("LogSoftmax", 1, attrs={"axis": -1}), [x],
+        [np.log(np_softmax(x))], rtol=1e-4, atol=1e-5)
+    add("elu", _model("Elu", 1, attrs={"alpha": 1.5}), [x],
+        [np.where(x > 0, x, 1.5 * (np.exp(x) - 1)).astype(np.float32)],
+        rtol=1e-4)
+    add("selu", _model("Selu", 1,
+                       attrs={"alpha": 1.67326, "gamma": 1.0507}), [x],
+        [(1.0507 * np.where(x > 0, x, 1.67326 * (np.exp(x) - 1))
+          ).astype(np.float32)], rtol=1e-4)
+    add("leakyrelu", _model("LeakyRelu", 1, attrs={"alpha": 0.1}), [x],
+        [np.where(x > 0, x, 0.1 * x).astype(np.float32)])
+    add("hardsigmoid", _model("HardSigmoid", 1,
+                              attrs={"alpha": 0.25, "beta": 0.4}), [x],
+        [np.clip(0.25 * x + 0.4, 0, 1).astype(np.float32)])
+    add("clip", _model("Clip", 1, consts=[np.float32(-0.5),
+                                          np.float32(0.8)]), [x],
+        [np.clip(x, -0.5, 0.8)])
+    add("cast", _model("Cast", 1, attrs={"to": int(P.TensorProto.INT32)}),
+        [x * 3], [(x * 3).astype(np.int32)])
+
+    # Gemm: alpha*A'*B + beta*C
+    A, B, C = _f((4, 3), 5), _f((4, 2), 6), _f((3, 2), 7)
+    add("gemm", _model("Gemm", 3, attrs={"alpha": 0.5, "beta": 1.5,
+                                         "transA": 1, "transB": 0}),
+        [A, B, C], [0.5 * (A.T @ B) + 1.5 * C], rtol=1e-4)
+
+    # Conv: plain, strided+padded, grouped
+    xc = _f((2, 3, 7, 7), 8)
+    w0 = _f((4, 3, 3, 3), 9, lo=-0.5, hi=0.5)
+    b0 = _f((4,), 10)
+    add("conv", _model("Conv", 1, consts=[w0, b0],
+                       attrs={"kernel_shape": [3, 3]}),
+        [xc], [np_conv2d(xc, w0, b0)], rtol=1e-3, atol=1e-4)
+    add("conv_stride_pad",
+        _model("Conv", 1, consts=[w0],
+               attrs={"kernel_shape": [3, 3], "strides": [2, 2],
+                      "pads": [1, 1, 1, 1]}),
+        [xc], [np_conv2d(xc, w0, stride=(2, 2), pads=(1, 1))],
+        rtol=1e-3, atol=1e-4)
+    wg = _f((4, 1, 3, 3), 11, lo=-0.5, hi=0.5)
+    xg = _f((2, 4, 6, 6), 12)
+    add("conv_group",
+        _model("Conv", 1, consts=[wg],
+               attrs={"kernel_shape": [3, 3], "group": 4}),
+        [xg], [np_conv2d(xg, wg, groups=4)], rtol=1e-3, atol=1e-4)
+
+    # BatchNormalization (inference)
+    scale, bias = _f((3,), 13, lo=0.5, hi=1.5), _f((3,), 14)
+    mean, var = _f((3,), 15), _f((3,), 16, lo=0.5, hi=1.5)
+    eps = 1e-5
+    bn_y = (scale.reshape(1, -1, 1, 1)
+            * (xc - mean.reshape(1, -1, 1, 1))
+            / np.sqrt(var.reshape(1, -1, 1, 1) + eps)
+            + bias.reshape(1, -1, 1, 1)).astype(np.float32)
+    add("batchnormalization",
+        _model("BatchNormalization", 1, consts=[scale, bias, mean, var],
+               attrs={"epsilon": eps}),
+        [xc], [bn_y], rtol=1e-4, atol=1e-4)
+
+    add("maxpool", _model("MaxPool", 1,
+                          attrs={"kernel_shape": [2, 2],
+                                 "strides": [2, 2]}),
+        [xc], [np_pool(xc, 2, 2, True)])
+    add("averagepool", _model("AveragePool", 1,
+                              attrs={"kernel_shape": [2, 2],
+                                     "strides": [2, 2]}),
+        [xc], [np_pool(xc, 2, 2, False)], rtol=1e-4)
+    add("globalaveragepool", _model("GlobalAveragePool", 1), [xc],
+        [xc.mean(axis=(2, 3), keepdims=True)], rtol=1e-4)
+
+    add("reshape", _model("Reshape", 1,
+                          consts=[np.asarray([5, 3], np.int64)]), [x],
+        [x.reshape(5, 3)])
+    add("flatten", _model("Flatten", 1, attrs={"axis": 1}), [xc],
+        [xc.reshape(2, -1)])
+    add("transpose", _model("Transpose", 1,
+                            attrs={"perm": [1, 0, 2, 3]}), [xc],
+        [xc.transpose(1, 0, 2, 3)])
+    add("concat", _model("Concat", 2, attrs={"axis": 1}), [a, b],
+        [np.concatenate([a, b], axis=1)])
+    add("slice", _model("Slice", 1,
+                        consts=[np.asarray([1, 0], np.int64),
+                                np.asarray([3, 4], np.int64),
+                                np.asarray([0, 1], np.int64)]),
+        [x], [x[1:3, 0:4]])
+    add("split", _model("Split", 1, attrs={"axis": 1, "split": [2, 3]},
+                        n_out=2),
+        [x], [x[:, :2], x[:, 2:]])
+    idx = np.asarray([[0, 2], [1, 0]], np.int32)
+    add("gather", _model("Gather", 2, attrs={"axis": 0}), [x, idx],
+        [x[idx]])
+    add("tile", _model("Tile", 1, consts=[np.asarray([2, 3], np.int64)]),
+        [x], [np.tile(x, (2, 3))])
+    x1 = x[:, :, None]
+    add("squeeze", _model("Squeeze", 1,
+                          consts=[np.asarray([2], np.int64)]), [x1], [x])
+    add("unsqueeze", _model("Unsqueeze", 1,
+                            consts=[np.asarray([0], np.int64)]), [x],
+        [x[None]])
+    add("pad", _model("Pad", 1,
+                      consts=[np.asarray([0, 1, 0, 2], np.int64),
+                              np.float32(1.5)]),
+        [x], [np.pad(x, ((0, 0), (1, 2)), constant_values=1.5)])
+    add("expand", _model("Expand", 1,
+                         consts=[np.asarray([2, 3, 5], np.int64)]), [x],
+        [np.broadcast_to(x, (2, 3, 5)).copy()])
+    xd = _f((1, 8, 2, 3), 17)
+    add("depthtospace", _model("DepthToSpace", 1,
+                               attrs={"blocksize": 2, "mode": "DCR"}),
+        [xd], [np_depth_to_space(xd, 2)])
+    xs = _f((1, 2, 4, 6), 18)
+    add("spacetodepth", _model("SpaceToDepth", 1,
+                               attrs={"blocksize": 2}),
+        [xs], [np_space_to_depth(xs, 2)])
+    # Where: cond must be initializer input[0] (importer contract)
+    cond = np.asarray([[True, False, True, False, True]] * 3)
+    mp = P.ModelProto(); mp.ir_version = 8  # noqa: E702
+    g = mp.graph
+    g.name = "conformance_Where"
+    g.initializer.append(sonnx.to_tensor_proto("cond", cond))
+    n = g.node.add(); n.op_type = "Where"; n.name = "Where_0"  # noqa: E702
+    n.input.extend(["cond", "in_0", "in_1"])
+    n.output.append("out_0")
+    g.input.add().name = "in_0"
+    g.input.add().name = "in_1"
+    g.output.add().name = "out_0"
+    add("where", mp, [a, b], [np.where(cond, a, b)])
+
+    ind = np.asarray([0, 2, 1], np.int32)
+    add("onehot", _model("OneHot", 1,
+                         consts=[np.asarray([4], np.int64),
+                                 np.asarray([0.0, 1.0], np.float32)],
+                         attrs={"axis": -1}),
+        [ind], [np.eye(4, dtype=np.float32)[ind]])
+
+    add("reducesum", _model("ReduceSum", 1,
+                            consts=[np.asarray([1], np.int64)],
+                            attrs={"keepdims": 1}),
+        [x], [x.sum(axis=1, keepdims=True)], rtol=1e-4)
+    add("reducemean", _model("ReduceMean", 1,
+                             attrs={"axes": [0], "keepdims": 0}),
+        [x], [x.mean(axis=0)], rtol=1e-4)
+    add("reducemax", _model("ReduceMax", 1,
+                            attrs={"axes": [1], "keepdims": 1}),
+        [x], [x.max(axis=1, keepdims=True)])
+    add("reducemin", _model("ReduceMin", 1,
+                            attrs={"axes": [1], "keepdims": 1}),
+        [x], [x.min(axis=1, keepdims=True)])
+
+    add("dropout", _model("Dropout", 1, attrs={"ratio": 0.5}), [x], [x])
+    lng, lnb = _f((5,), 19, lo=0.5, hi=1.5), _f((5,), 20)
+    mu = x.mean(-1, keepdims=True)
+    sd = np.sqrt(((x - mu) ** 2).mean(-1, keepdims=True) + 1e-5)
+    add("layernormalization",
+        _model("LayerNormalization", 1, consts=[lng, lnb],
+               attrs={"axis": -1, "epsilon": 1e-5}),
+        [x], [((x - mu) / sd * lng + lnb).astype(np.float32)],
+        rtol=1e-4, atol=1e-4)
+    cval = _f((2, 3), 21)
+    add("constant", _model("Constant", 0, value_attr=cval), [], [cval])
+    return cases
+
+
+def main():
+    os.makedirs(OUT_DIR, exist_ok=True)
+    cases = build_cases()
+    covered = {c[0].graph.node[0].op_type for c in cases.values()}
+    missing = sorted(set(sonnx._IMPORTERS) - covered)
+    if missing:
+        print(f"WARNING: importer ops without corpus case: {missing}",
+              file=sys.stderr)
+    manifest = {}
+    for name, (mp, inputs, expected, rtol, atol) in sorted(cases.items()):
+        sonnx.save(mp, os.path.join(OUT_DIR, f"{name}.onnx"))
+        arrays = {f"in_{i}": arr for i, arr in enumerate(inputs)}
+        arrays.update({f"out_{i}": arr for i, arr in enumerate(expected)})
+        np.savez(os.path.join(OUT_DIR, f"{name}.npz"), **arrays)
+        manifest[name] = {"op": mp.graph.node[0].op_type,
+                          "n_in": len(inputs), "n_out": len(expected),
+                          "rtol": rtol, "atol": atol}
+    with open(os.path.join(OUT_DIR, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+    print(f"wrote {len(cases)} cases to {OUT_DIR} "
+          f"({len(covered)} ops covered)")
+
+
+if __name__ == "__main__":
+    main()
