@@ -1,0 +1,109 @@
+"""Eager per-op dispatch overhead vs graph mode (SURVEY.md §7
+hard-part #4: "op-executable cache from day one"; VERDICT r3 Weak #9).
+
+Measures the MLP config (the reference's `examples/mlp`) in both
+execution modes and reports µs/op. Eager mode dispatches each
+`Operator` as its own XLA program through jax's C++ dispatch cache —
+that cache IS the op-executable cache the survey demands (keyed on
+primitive + shapes + dtypes); this benchmark quantifies what it costs
+vs the single fused program graph mode compiles.
+
+Run: python benchmarks/eager_overhead.py  [--steps N] [--cpu]
+Writes a row suitable for BASELINE.md to stdout.
+"""
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..")))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--cpu", action="store_true")
+    a = ap.parse_args()
+
+    import jax
+
+    if a.cpu:
+        jax.config.update("jax_platforms", "cpu")
+        from jax.extend.backend import clear_backends
+
+        clear_backends()
+
+    from singa_tpu import device, layer, model, opt, tensor
+
+    class MLP(model.Model):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = layer.Linear(256)
+            self.r1 = layer.ReLU()
+            self.fc2 = layer.Linear(256)
+            self.r2 = layer.ReLU()
+            self.fc3 = layer.Linear(10)
+
+        def forward(self, x):
+            return self.fc3(self.r2(self.fc2(self.r1(self.fc1(x)))))
+
+    dev = device.get_default_device()
+    rs = np.random.RandomState(0)
+    tx = tensor.from_numpy(rs.randn(64, 784).astype(np.float32),
+                           device=dev)
+    ty = tensor.from_numpy(rs.randint(0, 10, 64).astype(np.int32),
+                           device=dev)
+
+    results = {}
+    for mode, use_graph in (("eager", False), ("graph", True)):
+        dev.SetRandSeed(0)
+        m = MLP()
+        m.set_optimizer(opt.SGD(lr=0.05, momentum=0.9))
+        m.compile([tx], is_train=True, use_graph=use_graph)
+        for _ in range(5):  # warm every dispatch/executable cache
+            out, loss = m(tx, ty)
+        loss.data.block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(a.steps):
+            out, loss = m(tx, ty)
+        loss.data.block_until_ready()
+        results[mode] = (time.perf_counter() - t0) / a.steps
+
+    # op count for the eager step: fwd 8 ops (3 matmul + 3 bias-add via
+    # Linear, 2 relu ≈ 8 Operator calls) + xent + backward ~2x fwd +
+    # 5 SGD updates — count it live instead of guessing:
+    from singa_tpu import autograd
+
+    n_ops = 0
+    orig = autograd.Operator.__call__
+
+    def counting(self, *args, **kw):
+        nonlocal n_ops
+        n_ops += 1
+        return orig(self, *args, **kw)
+
+    autograd.Operator.__call__ = counting
+    try:
+        dev.SetRandSeed(0)
+        m2 = MLP()
+        m2.set_optimizer(opt.SGD(lr=0.05, momentum=0.9))
+        m2.compile([tx], is_train=True, use_graph=False)
+        n_ops = 0
+        m2(tx, ty)
+    finally:
+        autograd.Operator.__call__ = orig
+
+    eager, graph = results["eager"], results["graph"]
+    per_op_us = eager / max(n_ops, 1) * 1e6
+    print(f"platform={jax.default_backend()} steps={a.steps} "
+          f"fwd_ops_per_step={n_ops}")
+    print(f"eager_step_ms={eager * 1e3:.3f} graph_step_ms="
+          f"{graph * 1e3:.3f} ratio={eager / graph:.2f}x "
+          f"eager_us_per_op={per_op_us:.1f}")
+
+
+if __name__ == "__main__":
+    main()

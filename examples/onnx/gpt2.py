@@ -1,0 +1,220 @@
+"""GPT-2-shaped decoder via SONNX: local ONNX builder + import +
+generate + fine-tune.
+
+Reference parity: `examples/onnx/gpt2.py` — download GPT-2 from the
+ONNX model zoo, import with `sonnx.prepare`, generate token-by-token
+(SURVEY.md §2.3). This environment has no network, so
+`build_gpt2_onnx` constructs a GPT-2-shaped *decoder* ONNX model
+locally through the in-repo proto writer: learned word+position
+embeddings, pre-LN transformer blocks with CAUSAL self-attention (the
+autoregressive mask enters as a constant additive -1e9 upper-triangle
+matrix — the same trick real GPT-2 ONNX exports use), GELU FFN, final
+LayerNorm, and a weight-tied LM head (logits = h @ word_emb^T via a
+Transpose node on the embedding initializer).
+
+Run:  python gpt2.py [--steps N] [--gen M] [--onnx FILE]
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.abspath(os.path.join(_HERE, "..", "..")))
+
+from singa_tpu import opt, sonnx, tensor  # noqa: E402
+from singa_tpu.proto import onnx_ir_pb2 as P  # noqa: E402
+
+from bert import _node  # noqa: E402  (shared proto node helper)
+
+
+def build_gpt2_onnx(vocab=512, seq=32, d=64, heads=4, layers=2, seed=0):
+    """GPT-2-shaped causal LM as an ONNX ModelProto.
+
+    input_ids[int32, B x S] -> wte + wpe -> L x pre-LN causal block ->
+    final LN -> tied LM head -> logits[B x S x vocab].
+    """
+    assert d % heads == 0
+    dh = d // heads
+    rs = np.random.RandomState(seed)
+    mp = P.ModelProto()
+    mp.ir_version = 8
+    op = mp.opset_import.add()
+    op.domain = ""
+    op.version = 17
+    g = mp.graph
+    g.name = f"gpt2_l{layers}_d{d}_h{heads}"
+
+    def init(name, arr):
+        g.initializer.append(sonnx.to_tensor_proto(name, arr))
+        return name
+
+    def w(name, *shape, scale=None):
+        scale = scale or (1.0 / np.sqrt(shape[0]))
+        return init(name, (rs.randn(*shape) * scale).astype(np.float32))
+
+    def zeros(name, *shape):
+        return init(name, np.zeros(shape, np.float32))
+
+    def ones(name, *shape):
+        return init(name, np.ones(shape, np.float32))
+
+    vi = g.input.add()
+    vi.name = "input_ids"
+    vi.type.tensor_type.elem_type = 6  # INT32
+    vi.type.tensor_type.shape.dim.add().dim_param = "B"
+    vi.type.tensor_type.shape.dim.add().dim_value = seq
+
+    w("wte", vocab, d, scale=0.02)
+    init("wpe", (rs.randn(seq, d) * 0.02).astype(np.float32))
+    _node(g, "Gather", ["wte", "input_ids"], ["tok_emb"], axis=0)
+    _node(g, "Add", ["tok_emb", "wpe"], ["h0"])
+
+    # causal mask: -1e9 strictly-upper triangle, added to the scores
+    mask = np.triu(np.full((seq, seq), -1e9, np.float32), k=1)
+    init("causal_mask", mask)
+    init("attn_scale", np.asarray(1.0 / np.sqrt(dh), np.float32))
+    init("head_split", np.asarray([0, 0, heads, dh], np.int64))
+    init("head_merge", np.asarray([0, 0, d], np.int64))
+
+    h = "h0"
+    for li in range(layers):
+        p = f"l{li}_"
+        # pre-LN attention
+        ones(p + "ln1_g", d)
+        zeros(p + "ln1_b", d)
+        _node(g, "LayerNormalization", [h, p + "ln1_g", p + "ln1_b"],
+              [p + "ln1"], axis=-1, epsilon=1e-5)
+        for proj in ("q", "k", "v"):
+            w(p + f"W{proj}", d, d)
+            zeros(p + f"b{proj}", d)
+            _node(g, "MatMul", [p + "ln1", p + f"W{proj}"],
+                  [p + proj + "_mm"])
+            _node(g, "Add", [p + proj + "_mm", p + f"b{proj}"], [p + proj])
+            _node(g, "Reshape", [p + proj, "head_split"],
+                  [p + proj + "_4d"])
+        _node(g, "Transpose", [p + "q_4d"], [p + "qh"],
+              perm=[0, 2, 1, 3])
+        _node(g, "Transpose", [p + "k_4d"], [p + "kT"],
+              perm=[0, 2, 3, 1])
+        _node(g, "Transpose", [p + "v_4d"], [p + "vh"],
+              perm=[0, 2, 1, 3])
+        _node(g, "MatMul", [p + "qh", p + "kT"], [p + "scores_raw"])
+        _node(g, "Mul", [p + "scores_raw", "attn_scale"],
+              [p + "scores_scaled"])
+        _node(g, "Add", [p + "scores_scaled", "causal_mask"],
+              [p + "scores"])
+        _node(g, "Softmax", [p + "scores"], [p + "probs"], axis=-1)
+        _node(g, "MatMul", [p + "probs", p + "vh"], [p + "ctx"])
+        _node(g, "Transpose", [p + "ctx"], [p + "ctx_t"],
+              perm=[0, 2, 1, 3])
+        _node(g, "Reshape", [p + "ctx_t", "head_merge"], [p + "merged"])
+        w(p + "Wo", d, d)
+        zeros(p + "bo", d)
+        _node(g, "MatMul", [p + "merged", p + "Wo"], [p + "attn_mm"])
+        _node(g, "Add", [p + "attn_mm", p + "bo"], [p + "attn_out"])
+        _node(g, "Add", [h, p + "attn_out"], [p + "res1"])
+        # pre-LN GELU FFN
+        ones(p + "ln2_g", d)
+        zeros(p + "ln2_b", d)
+        _node(g, "LayerNormalization",
+              [p + "res1", p + "ln2_g", p + "ln2_b"], [p + "ln2"],
+              axis=-1, epsilon=1e-5)
+        w(p + "Wfc", d, 4 * d)
+        zeros(p + "bfc", 4 * d)
+        _node(g, "MatMul", [p + "ln2", p + "Wfc"], [p + "fc_mm"])
+        _node(g, "Add", [p + "fc_mm", p + "bfc"], [p + "fc"])
+        _node(g, "Gelu", [p + "fc"], [p + "gelu"])
+        w(p + "Wproj", 4 * d, d)
+        zeros(p + "bproj", d)
+        _node(g, "MatMul", [p + "gelu", p + "Wproj"], [p + "proj_mm"])
+        _node(g, "Add", [p + "proj_mm", p + "bproj"], [p + "ffn_out"])
+        _node(g, "Add", [p + "res1", p + "ffn_out"], [p + "hout"])
+        h = p + "hout"
+
+    ones("lnf_g", d)
+    zeros("lnf_b", d)
+    _node(g, "LayerNormalization", [h, "lnf_g", "lnf_b"], ["hf"],
+          axis=-1, epsilon=1e-5)
+    # weight-tied LM head: logits = hf @ wte^T
+    _node(g, "Transpose", ["wte"], ["wte_T"], perm=[1, 0])
+    _node(g, "MatMul", ["hf", "wte_T"], ["logits"])
+    g.output.add().name = "logits"
+    return mp
+
+
+class GPT2(sonnx.SONNXModel):
+    """Causal-LM fine-tune head over the imported graph: next-token
+    cross-entropy (shift-by-one) instead of SONNXModel's default
+    classifier loss."""
+
+    def train_one_batch(self, x, y):
+        from singa_tpu import autograd
+
+        out = self.forward(x)
+        logits = out[0] if isinstance(out, tuple) else out
+        b, s, v = logits.shape
+        flat = autograd.reshape(logits, (b * s, v))
+        tgt = tensor.from_numpy(
+            y.to_numpy().reshape(-1).astype(np.int32))
+        loss = autograd.softmax_cross_entropy(flat, tgt)
+        self._optimizer.backward_and_update(loss)
+        return out, loss
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=4)
+    ap.add_argument("--gen", type=int, default=8)
+    ap.add_argument("--onnx", default="/tmp/gpt2_small.onnx")
+    ap.add_argument("--seq", type=int, default=32)
+    a = ap.parse_args()
+
+    vocab, seq = 512, a.seq
+    print(f"building GPT-2-shaped decoder -> {a.onnx}")
+    mp = build_gpt2_onnx(vocab=vocab, seq=seq)
+    sonnx.save(mp, a.onnx)
+    print(f"  wrote {os.path.getsize(a.onnx) / 1e6:.1f} MB, "
+          f"{len(mp.graph.node)} nodes")
+
+    rs = np.random.RandomState(0)
+    m = GPT2(sonnx.load(a.onnx))
+
+    print("causality check: future tokens must not affect past logits")
+    ids = rs.randint(0, vocab, (1, seq)).astype(np.int32)
+    m.eval()
+    base = m.forward(tensor.from_numpy(ids)).to_numpy()
+    ids2 = ids.copy()
+    ids2[0, -1] = (ids2[0, -1] + 7) % vocab  # perturb the LAST token
+    pert = m.forward(tensor.from_numpy(ids2)).to_numpy()
+    delta_past = np.abs(pert[0, :-1] - base[0, :-1]).max()
+    assert delta_past < 1e-4, f"causal leak: {delta_past}"
+    print(f"  ok (past-logit delta {delta_past:.1e})")
+
+    print(f"greedy generation, {a.gen} tokens (sliding window)")
+    window = ids.copy()
+    generated = []
+    for _ in range(a.gen):
+        logits = m.forward(tensor.from_numpy(window)).to_numpy()
+        nxt = int(logits[0, -1].argmax())
+        generated.append(nxt)
+        window = np.concatenate(
+            [window[:, 1:], [[nxt]]], axis=1).astype(np.int32)
+    print(f"  tokens: {generated}")
+
+    print(f"fine-tuning (next-token CE) for {a.steps} steps")
+    m.train()
+    m.set_optimizer(opt.SGD(lr=0.1, momentum=0.9))
+    x_np = rs.randint(0, vocab, (2, seq)).astype(np.int32)
+    # shift-by-one targets
+    y_np = np.concatenate([x_np[:, 1:], x_np[:, :1]], axis=1)
+    tx, ty = tensor.from_numpy(x_np), tensor.from_numpy(y_np)
+    for s in range(a.steps):
+        _, loss = m.train_one_batch(tx, ty)
+        print(f"  step {s}: loss {float(loss.to_numpy()):.4f}")
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

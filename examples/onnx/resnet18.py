@@ -1,0 +1,77 @@
+"""ResNet-18 export -> import -> eval round trip via SONNX.
+
+Reference parity: `examples/onnx/resnet18.py` — download ResNet-18
+from the ONNX model zoo and run it with `sonnx.prepare` (SURVEY.md
+§2.3). This environment has no network, so the zoo download is
+replaced by exporting the in-repo native ResNet-18
+(`examples/cnn/model/resnet.py`) to an ONNX file with `sonnx.to_onnx`
+— producing exactly the Conv/BatchNormalization/MaxPool/Relu/Add/
+GlobalAveragePool/Gemm op stream a zoo ResNet contains — then
+importing that file back and checking output parity, top-1 agreement,
+and fine-tunability of the imported graph.
+
+Run:  python resnet18.py [--steps N] [--onnx FILE]
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.abspath(os.path.join(_HERE, "..", "..")))
+sys.path.insert(0, os.path.abspath(os.path.join(_HERE, "..", "cnn",
+                                                "model")))
+
+from singa_tpu import opt, sonnx, tensor  # noqa: E402
+
+
+def export_resnet18(path: str, num_classes: int = 10, img: int = 32):
+    """Build the native ResNet-18 and export it to `path`."""
+    import resnet
+
+    m = resnet.create_model(depth=18, num_classes=num_classes)
+    x = tensor.from_numpy(
+        np.random.RandomState(0).randn(2, 3, img, img).astype(np.float32))
+    m.compile([x], is_train=False, use_graph=False)
+    m.eval()
+    ref = m.forward(x).to_numpy()
+    mp = sonnx.to_onnx(m, [x])
+    sonnx.save(mp, path)
+    return ref, x
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=4)
+    ap.add_argument("--onnx", default="/tmp/resnet18.onnx")
+    ap.add_argument("--img", type=int, default=32)
+    a = ap.parse_args()
+
+    print(f"exporting native ResNet-18 -> {a.onnx}")
+    ref, x = export_resnet18(a.onnx, img=a.img)
+    size = os.path.getsize(a.onnx)
+    print(f"  wrote {size / 1e6:.1f} MB")
+
+    print("importing with sonnx.prepare and checking parity")
+    rep = sonnx.prepare(sonnx.load(a.onnx))
+    out = rep.run([x])[0].to_numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+    agree = (out.argmax(-1) == ref.argmax(-1)).mean()
+    print(f"  max |diff| = {np.abs(out - ref).max():.2e}, "
+          f"top-1 agreement {agree:.0%}")
+
+    print(f"fine-tuning the imported graph for {a.steps} steps")
+    m = sonnx.SONNXModel(sonnx.load(a.onnx))
+    m.set_optimizer(opt.SGD(lr=0.05, momentum=0.9))
+    m.train()
+    y = tensor.from_numpy(
+        np.random.RandomState(1).randint(0, 10, 2).astype(np.int32))
+    for s in range(a.steps):
+        _, loss = m.train_one_batch(x, y)
+        print(f"  step {s}: loss {float(loss.to_numpy()):.4f}")
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

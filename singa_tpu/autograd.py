@@ -107,20 +107,74 @@ class Operator:
         return tuple(outs) if multiple else outs[0]
 
     # -- default implementations via jax.vjp ------------------------------
+    def cache_key(self):
+        """Hashable config tuple that fully determines `fn`'s behavior
+        (the op-executable cache key, SURVEY §7 hard-part #4). Return
+        None (the default) to disable caching for this op. Ops whose fn
+        reads global policy (matmul precision / AMP dtype) must fold
+        `_policy_key()` in."""
+        return None
+
     def forward(self, *xs):
         if self.requires_grad:
+            # Eager op-executable cache: per-call jax.vjp retraces fn
+            # (measured ~3 ms/op on CPU, 30x a graph step —
+            # benchmarks/eager_overhead.py); for config-keyed ops reuse
+            # jitted fwd/bwd executables instead. Tracer inputs (graph
+            # mode) keep the plain vjp path: the whole step is traced
+            # once anyway, and the cached bwd's forward recompute would
+            # double traced FLOPs.
+            key = None
+            if not any(isinstance(x, jax.core.Tracer) for x in xs):
+                key = self.cache_key()
+            if key is not None:
+                fwd, bwd = _op_executables(type(self), key, self)
+                self._cached_bwd = bwd
+                self._bwd_xs = xs
+                return fwd(*xs)
             ys, self._vjp = jax.vjp(self.fn, *xs)
             return ys
         return self.fn(*xs)
 
     def backward(self, *dys):
-        assert self._vjp is not None, f"{self.name}: backward before forward"
         cot = dys[0] if self.num_outputs == 1 else tuple(dys)
+        if getattr(self, "_cached_bwd", None) is not None:
+            grads = self._cached_bwd(cot, *self._bwd_xs)
+            return grads if len(grads) > 1 else grads[0]
+        assert self._vjp is not None, f"{self.name}: backward before forward"
         grads = self._vjp(cot)
         return grads if len(grads) > 1 else grads[0]
 
     def fn(self, *xs):  # pragma: no cover - must be overridden
         raise NotImplementedError(type(self).__name__)
+
+
+_EXEC_CACHE: dict = {}
+
+
+def _policy_key():
+    return (tensor_mod.get_matmul_precision(),
+            str(tensor_mod.get_compute_dtype()))
+
+
+def _op_executables(cls, key, op):
+    """Jitted (fwd, bwd) executables for an op class + config key.
+    The closure captures the FIRST instance seen with this key —
+    sound because cache_key() contracts that fn is pure given the key.
+    bwd recomputes the forward inside one fused program (residuals
+    live in registers/VMEM instead of a Python closure)."""
+    ck = (cls, key)
+    ent = _EXEC_CACHE.get(ck)
+    if ent is None:
+        fwd = jax.jit(lambda *a: cls.fn(op, *a))
+
+        def bwd_fn(cot, *a):
+            _, vjp = jax.vjp(lambda *b: cls.fn(op, *b), *a)
+            return vjp(cot)
+
+        ent = (fwd, jax.jit(bwd_fn))
+        _EXEC_CACHE[ck] = ent
+    return ent
 
 
 def _ones_like(arr):
@@ -777,6 +831,44 @@ class Where(Operator):
         return jnp.where(self.cond != 0, a, b)
 
 
+class ScatterElements(Operator):
+    """ONNX ScatterElements (reduction='none'): copy of x with
+    `updates` written at `indices` along `axis`. Indices/updates are
+    attributes (the sonnx importer requires them constant); gradient
+    flows to x only (scattered positions get zero — their value came
+    from `updates`)."""
+
+    def __init__(self, indices, updates, axis: int = 0):
+        super().__init__()
+        self.axis = axis
+        idx = indices.data if isinstance(indices, Tensor) else indices
+        upd = updates.data if isinstance(updates, Tensor) else updates
+        self.indices = jnp.asarray(idx).astype(jnp.int32)
+        self.updates = jnp.asarray(upd)
+
+    def fn(self, x):
+        axis = self.axis % x.ndim
+        grids = list(jnp.meshgrid(
+            *[jnp.arange(s) for s in self.indices.shape], indexing="ij"))
+        grids[axis] = self.indices
+        return x.at[tuple(grids)].set(self.updates.astype(x.dtype))
+
+
+class Einsum(Operator):
+    """ONNX Einsum — jnp.einsum with a vjp-derived backward."""
+
+    def __init__(self, equation: str):
+        super().__init__()
+        self.equation = equation
+
+    def fn(self, *xs):
+        xs = tensor_mod.amp_cast(*xs)
+        if not isinstance(xs, tuple):
+            xs = (xs,)
+        return jnp.einsum(self.equation, *xs,
+                          precision=tensor_mod.get_matmul_precision())
+
+
 class OneHot(Operator):
     """Non-differentiable. Reference: `autograd.OneHot`."""
 
@@ -1238,6 +1330,35 @@ def conv2d(handle, x, w, b=None):
     return _Conv2d(handle)(x, w, b) if b is not None else _Conv2d(handle)(x, w)
 
 
+class _ConvTranspose2d(Operator):
+    """ONNX ConvTranspose → `native.conv_transpose2d` (the cuDNN
+    backward-data path the reference reuses for deconvolution)."""
+
+    def __init__(self, handle):
+        super().__init__()
+        self.handle = handle
+
+    def fn(self, x, w, *b):
+        return native.conv_transpose2d(self.handle, x, w,
+                                       b[0] if b else None)
+
+
+class InstanceNorm(Operator):
+    """ONNX InstanceNormalization → `native.instance_norm`."""
+
+    def __init__(self, eps: float = 1e-5):
+        super().__init__()
+        self.eps = eps
+
+    def fn(self, x, scale, bias):
+        return native.instance_norm(x, scale, bias, self.eps)
+
+
+def conv_transpose2d(handle, x, w, b=None):
+    op = _ConvTranspose2d(handle)
+    return op(x, w, b) if b is not None else op(x, w)
+
+
 def pooling_2d(handle, x):
     return _Pooling2d(handle)(x)
 
@@ -1265,3 +1386,39 @@ def embedding(w, indices):
 
 def cast(x, to):
     return Cast(to)(x)
+
+
+# ---------------------------------------------------------------------------
+# Op-executable cache keys (SURVEY §7 hard-part #4). Stateless ops key
+# on (); config ops fold their attributes; matmul/conv ops also fold
+# the global precision/AMP policy their fn reads.
+# ---------------------------------------------------------------------------
+for _cls in (ReLU, Sigmoid, Tanh, Abs, Exp, Log, Sqrt, Square, Sign,
+             Negative, Reciprocal, Erf, Ceil, Floor, Round, Cos, Sin,
+             Tan, Acos, Asin, Atan, Cosh, Sinh, Tanh_, Acosh, Asinh,
+             Atanh, SoftPlus, SoftSign, Gelu, Identity, Add, Sub, Mul,
+             Div, Pow, Minimum, Maximum, Less, Greater, Equal,
+             GlobalAveragePool):
+    _cls.cache_key = lambda self: ()
+del _cls
+Mult.cache_key = lambda self: _policy_key()
+Gemm.cache_key = lambda self: (self.alpha, self.beta, self.transA,
+                               self.transB) + _policy_key()
+Einsum.cache_key = lambda self: (self.equation,) + _policy_key()
+AddBias.cache_key = lambda self: (self.axis,)
+Reshape.cache_key = lambda self: (self.shape,)
+Flatten.cache_key = lambda self: (self.axis,)
+Transpose.cache_key = lambda self: (self.axes,)
+SoftMax.cache_key = lambda self: (self.axis,)
+LogSoftMax.cache_key = lambda self: (self.axis,)
+_Conv2d.cache_key = lambda self: (
+    self.handle.in_channels, self.handle.out_channels,
+    self.handle.kernel_size, self.handle.stride, self.handle.padding,
+    self.handle.dilation, self.handle.groups) + _policy_key()
+_ConvTranspose2d.cache_key = lambda self: (
+    self.handle.in_channels, self.handle.out_channels,
+    self.handle.kernel_size, self.handle.stride, self.handle.padding,
+    self.handle.output_padding, self.handle.groups) + _policy_key()
+_Pooling2d.cache_key = lambda self: (
+    self.handle.kernel_size, self.handle.stride, self.handle.padding,
+    self.handle.is_max, self.handle.count_include_pad)

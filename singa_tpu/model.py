@@ -183,10 +183,15 @@ class Model(Layer):
         Multi-controller inputs (global arrays spanning processes) are
         replaced by their local shard for this pass — lazy init only
         reads feature dims, which batch shardings leave whole.
+
+        Uses the same batch-1 slicing policy as `_jit_init_forward` so
+        the two init paths leave identical model state (params by RNG
+        determinism; BN running stats because both see the same slice).
         """
         from .device import get_default_device
 
         cpu = get_default_device()
+        full = os.environ.get("SINGA_TPU_INIT_FULL_BATCH", "0") == "1"
         borrow = dev is not None and dev is not cpu
         if borrow:
             saved_cpu_key = cpu._rng_key
@@ -197,8 +202,11 @@ class Model(Layer):
                 arr = t.data
                 if not getattr(arr, "is_fully_addressable", True):
                     arr = arr.addressable_shards[0].data
+                arr = np.asarray(arr)
+                if not full and arr.ndim >= 1 and arr.shape[0] > 1:
+                    arr = arr[:1]
                 h = t.clone()
-                h.data = jax.device_put(np.asarray(arr), cpu.jax_device)
+                h.data = jax.device_put(arr, cpu.jax_device)
                 h.device = cpu
                 host_inputs.append(h)
             self.forward(*host_inputs)

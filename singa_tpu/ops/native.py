@@ -112,6 +112,77 @@ def conv2d(handle: ConvHandle, x, w, b=None):
     return y
 
 
+class ConvTransposeHandle:
+    """Config for 2-d transposed convolution (ONNX ConvTranspose;
+    reference: the cuDNN backward-data path the reference reuses for
+    deconvolution). Weight layout is ONNX/torch IOHW:
+    (in_channels, out_channels // groups, kh, kw)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size,
+                 stride=1, padding=0, output_padding=0, groups=1,
+                 bias=True):
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        self.kernel_size = _pair(kernel_size)
+        self.stride = _pair(stride)
+        self.padding = _pair(padding)
+        self.output_padding = _pair(output_padding)
+        self.groups = groups
+        self.bias = bias
+
+
+@partial(jax.jit, static_argnums=(0,), inline=True)
+def _conv_transpose2d_nobias(handle: ConvTransposeHandle, x, w):
+    """Transposed conv as an input-dilated conv with the flipped,
+    IO-swapped kernel — the same lowering XLA uses for conv input
+    gradients, so it rides the MXU like a forward conv."""
+    g = handle.groups
+    cin, cog, kh, kw = w.shape
+    # IOHW -> OIHW per group, spatial flip
+    wg = w.reshape(g, cin // g, cog, kh, kw)
+    wg = jnp.transpose(wg, (0, 2, 1, 3, 4))
+    w2 = wg.reshape(g * cog, cin // g, kh, kw)[:, :, ::-1, ::-1]
+    ph, pw = handle.padding
+    oph, opw = handle.output_padding
+    pad = ((kh - 1 - ph, kh - 1 - ph + oph),
+           (kw - 1 - pw, kw - 1 - pw + opw))
+    pref = jnp.float32 if x.dtype == jnp.float32 else None
+    return lax.conv_general_dilated(
+        x, w2,
+        window_strides=(1, 1),
+        padding=pad,
+        lhs_dilation=handle.stride,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=g,
+        preferred_element_type=pref,
+    ).astype(x.dtype)
+
+
+def conv_transpose2d(handle: ConvTransposeHandle, x, w, b=None):
+    """x: (N, C_in, H, W); w: (C_in, C_out/groups, kh, kw)."""
+    from .. import tensor as tensor_mod
+
+    x, w, b = tensor_mod.amp_cast(x, w, b)
+    y = _conv_transpose2d_nobias(handle, x, w)
+    if b is not None:
+        y = y + b.reshape(1, -1, 1, 1)
+    return y
+
+
+def instance_norm(x, scale, bias, eps: float = 1e-5):
+    """ONNX InstanceNormalization: per-(N, C) normalization over the
+    spatial dims; scale/bias are per-channel. Statistics in fp32
+    (matches the BN policy under AMP)."""
+    axes = tuple(range(2, x.ndim))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    shape = [1, -1] + [1] * (x.ndim - 2)
+    y = (xf - mean) * lax.rsqrt(var + eps) * scale.reshape(shape) \
+        + bias.reshape(shape)
+    return y.astype(x.dtype)
+
+
 class BatchNormHandle:
     """Reference: `BatchNormHandle` / `CudnnBatchNormHandle`.
 

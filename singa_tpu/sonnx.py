@@ -340,6 +340,23 @@ def _export_node(op, in_names: List[str], out_names: List[str],
         gb.node("BatchNormalization",
                 list(in_names) + [mean, var], out_names,
                 epsilon=op.handle.eps, momentum=1.0 - op.handle.factor)
+    elif cls == "_ConvTranspose2d":
+        h = op.handle
+        ph, pw = h.padding
+        gb.node("ConvTranspose", in_names, out_names,
+                kernel_shape=h.kernel_size, strides=h.stride,
+                pads=[ph, pw, ph, pw],
+                output_padding=list(h.output_padding), group=h.groups)
+    elif cls == "InstanceNorm":
+        gb.node("InstanceNormalization", in_names, out_names,
+                epsilon=op.eps)
+    elif cls == "ScatterElements":
+        ins = [in_names[0],
+               gb.const(np.asarray(op.indices, np.int64), "indices"),
+               gb.const(np.asarray(op.updates), "updates")]
+        gb.node("ScatterElements", ins, out_names, axis=op.axis)
+    elif cls == "Einsum":
+        gb.node("Einsum", in_names, out_names, equation=op.equation)
     else:
         raise ValueError(
             f"sonnx export: op {cls} has no ONNX mapping "
@@ -524,6 +541,58 @@ def _import_conv(ctx, node):
         dilation=tuple(_attr(node, "dilations", [1, 1])),
         groups=group, bias=b is not None)
     return autograd.conv2d(handle, x, w, b)
+
+
+def _import_convtranspose(ctx, node):
+    x = ctx.tensor(node.input[0])
+    w = ctx.tensor(node.input[1])  # IOHW: (C_in, C_out/g, kh, kw)
+    b = (ctx.tensor(node.input[2])
+         if len(node.input) > 2 and node.input[2] else None)
+    # Reject what the handle cannot represent rather than silently
+    # computing the wrong shape (the _sym_pads convention).
+    if list(_attr(node, "dilations", [1, 1])) != [1, 1]:
+        raise ValueError("sonnx: ConvTranspose dilations != 1 "
+                         "unsupported")
+    if _attr(node, "output_shape") is not None:
+        raise ValueError("sonnx: ConvTranspose output_shape is "
+                         "unsupported; re-export with explicit pads/"
+                         "output_padding")
+    group = _attr(node, "group", 1)
+    cin, cog, kh, kw = w.shape
+    opads = tuple(_attr(node, "output_padding", [0, 0]))
+    handle = native.ConvTransposeHandle(
+        cin, cog * group, (kh, kw),
+        stride=tuple(_attr(node, "strides", [1, 1])),
+        padding=_sym_pads(node),
+        output_padding=opads,
+        groups=group, bias=b is not None)
+    return autograd.conv_transpose2d(handle, x, w, b)
+
+
+def _import_instancenorm(ctx, node):
+    return autograd.InstanceNorm(_attr(node, "epsilon", 1e-5))(
+        ctx.tensor(node.input[0]), ctx.tensor(node.input[1]),
+        ctx.tensor(node.input[2]))
+
+
+def _import_scatter(ctx, node):
+    indices = ctx.const(node.input[1])
+    updates = ctx.const(node.input[2])
+    if indices is None or updates is None:
+        raise ValueError(
+            "sonnx: ScatterElements indices/updates must be "
+            "constants/initializers")
+    if _attr(node, "reduction", "none") != "none":
+        raise ValueError("sonnx: ScatterElements reduction != 'none' "
+                         "unsupported")
+    return autograd.ScatterElements(
+        indices, updates, _attr(node, "axis", 0))(
+        ctx.tensor(node.input[0]))
+
+
+def _import_einsum(ctx, node):
+    return autograd.Einsum(_attr(node, "equation"))(
+        *[ctx.tensor(i) for i in node.input])
 
 
 def _import_bn(ctx, node):
@@ -777,6 +846,10 @@ _IMPORTERS = {
     "Dropout": _import_dropout,
     "LayerNormalization": _import_layernorm,
     "Constant": _import_constant,
+    "ConvTranspose": _import_convtranspose,
+    "InstanceNormalization": _import_instancenorm,
+    "ScatterElements": _import_scatter,
+    "Einsum": _import_einsum,
 }
 
 

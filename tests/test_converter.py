@@ -1,0 +1,167 @@
+"""Caffe prototxt importer tests (reference: `python/singa/converter.py`
+and `test/python/test_converter.py`-style round trips, SURVEY.md P8)."""
+import numpy as np
+import pytest
+
+from singa_tpu import converter, device, opt, tensor
+
+LENET = """
+name: "LeNetish"
+layer { name: "data" type: "Input" top: "data"
+  input_param { shape { dim: 2 dim: 1 dim: 28 dim: 28 } } }
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 6 kernel_size: 5 stride: 1 pad: 2 } }
+layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+layer { name: "pool1" type: "Pooling" bottom: "conv1" top: "pool1"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 } }
+layer { name: "conv2" type: "Convolution" bottom: "pool1" top: "conv2"
+  convolution_param { num_output: 16 kernel_size: 5 } }
+layer { name: "relu2" type: "ReLU" bottom: "conv2" top: "conv2" }
+layer { name: "pool2" type: "Pooling" bottom: "conv2" top: "pool2"
+  pooling_param { pool: AVE kernel_size: 2 stride: 2 } }
+layer { name: "ip1" type: "InnerProduct" bottom: "pool2" top: "ip1"
+  inner_product_param { num_output: 32 } }
+layer { name: "relu3" type: "ReLU" bottom: "ip1" top: "ip1" }
+layer { name: "drop1" type: "Dropout" bottom: "ip1" top: "ip1"
+  dropout_param { dropout_ratio: 0.3 } }
+layer { name: "ip2" type: "InnerProduct" bottom: "ip1" top: "ip2"
+  inner_product_param { num_output: 10 } }
+layer { name: "prob" type: "Softmax" bottom: "ip2" top: "prob" }
+"""
+
+RESBLOCK = """
+name: "resblockish"
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "c1"
+  convolution_param { num_output: 4 kernel_size: 3 pad: 1 bias_term: false } }
+layer { name: "bn1" type: "BatchNorm" bottom: "c1" top: "c1" }
+layer { name: "scale1" type: "Scale" bottom: "c1" top: "c1" }
+layer { name: "relu1" type: "ReLU" bottom: "c1" top: "c1" }
+layer { name: "conv2" type: "Convolution" bottom: "c1" top: "c2"
+  convolution_param { num_output: 4 kernel_size: 3 pad: 1 bias_term: false } }
+layer { name: "fuse" type: "Eltwise" bottom: "c1" bottom: "c2" top: "sum"
+  eltwise_param { operation: SUM } }
+layer { name: "cat" type: "Concat" bottom: "c1" bottom: "sum" top: "cat"
+  concat_param { axis: 1 } }
+"""
+
+
+def test_parse_prototxt_structure():
+    cfg = converter.parse_prototxt(LENET)
+    assert cfg["name"] == "LeNetish"
+    layers = cfg["layer"]
+    assert len(layers) == 12
+    assert layers[1]["convolution_param"]["num_output"] == 6
+    assert layers[3]["pooling_param"]["pool"] == "MAX"
+    assert layers[9]["dropout_param"]["dropout_ratio"] == 0.3
+
+
+def test_lenet_forward_and_train(tmp_path):
+    path = tmp_path / "lenet.prototxt"
+    path.write_text(LENET)
+    net = converter.CaffeConverter(str(path)).create_net()
+    x = tensor.from_numpy(
+        np.random.RandomState(0).randn(2, 1, 28, 28).astype(np.float32))
+    net.compile([x], is_train=False, use_graph=False)
+    net.eval()
+    out = net.forward(x)
+    assert out.shape == (2, 10)
+    probs = out.to_numpy()
+    np.testing.assert_allclose(probs.sum(-1), 1.0, rtol=1e-5)
+
+    # trainability: a few steps reduce the loss
+    net.train()
+    net.set_optimizer(opt.SGD(lr=0.1, momentum=0.9))
+    y = tensor.from_numpy(np.arange(2).astype(np.int32))
+    losses = []
+    for _ in range(6):
+        _, loss = net.train_one_batch(x, y)
+        losses.append(float(loss.to_numpy()))
+    assert losses[-1] < losses[0]
+
+
+def test_bn_scale_eltwise_concat(tmp_path):
+    path = tmp_path / "res.prototxt"
+    path.write_text(RESBLOCK)
+    net = converter.CaffeConverter(str(path)).create_net()
+    x = tensor.from_numpy(
+        np.random.RandomState(1).randn(2, 3, 8, 8).astype(np.float32))
+    net.compile([x], is_train=False, use_graph=False)
+    net.eval()
+    out = net.forward(x)
+    assert out.shape == (2, 8, 8, 8)  # 4 + 4 channels concatenated
+
+
+def test_weight_loading(tmp_path):
+    path = tmp_path / "lenet.prototxt"
+    path.write_text(LENET)
+    rs = np.random.RandomState(2)
+    weights = {
+        "conv1/0": rs.randn(6, 1, 5, 5).astype(np.float32) * 0.1,
+        "conv1/1": rs.randn(6).astype(np.float32) * 0.1,
+        "ip2/0": rs.randn(10, 32).astype(np.float32) * 0.1,  # caffe (out,in)
+        "ip2/1": np.zeros(10, np.float32),
+    }
+    npz = tmp_path / "w.npz"
+    np.savez(npz, **weights)
+    net = converter.CaffeConverter(str(path), str(npz)).create_net()
+    x = tensor.from_numpy(rs.randn(2, 1, 28, 28).astype(np.float32))
+    net.compile([x], is_train=False, use_graph=False)
+    got_w = net._catalog["conv1"].W.to_numpy()
+    np.testing.assert_array_equal(got_w, weights["conv1/0"])
+    got_ip = net._catalog["ip2"].W.to_numpy()
+    np.testing.assert_array_equal(got_ip, weights["ip2/0"].T)
+
+
+def test_unsupported_layer_raises(tmp_path):
+    path = tmp_path / "bad.prototxt"
+    path.write_text(
+        'layer { name: "l" type: "LRN" bottom: "d" top: "o" }')
+    with pytest.raises(ValueError, match="LRN"):
+        converter.CaffeConverter(str(path)).create_net()
+
+
+def test_bn_scale_weight_loading(tmp_path):
+    """Caffe BN blobs (mean/var/factor) + Scale blobs (gamma/beta) bind
+    onto the folded BatchNorm2d (review r4 finding)."""
+    path = tmp_path / "bn.prototxt"
+    path.write_text(RESBLOCK)
+    rs = np.random.RandomState(3)
+    weights = {
+        "conv1/0": rs.randn(4, 3, 3, 3).astype(np.float32) * 0.1,
+        "bn1/0": rs.randn(4).astype(np.float32),          # running mean
+        "bn1/1": rs.rand(4).astype(np.float32) + 0.5,     # running var
+        "bn1/2": np.asarray([2.0], np.float32),           # scale factor
+        "scale1/0": rs.rand(4).astype(np.float32) + 0.5,  # gamma
+        "scale1/1": rs.randn(4).astype(np.float32),       # beta
+    }
+    npz = tmp_path / "w.npz"
+    np.savez(npz, **weights)
+    net = converter.CaffeConverter(str(path), str(npz)).create_net()
+    x = tensor.from_numpy(rs.randn(2, 3, 8, 8).astype(np.float32))
+    net.compile([x], is_train=False, use_graph=False)
+    bn = net._catalog["bn1"]
+    np.testing.assert_allclose(bn.running_mean.to_numpy(),
+                               weights["bn1/0"] / 2.0, rtol=1e-6)
+    np.testing.assert_allclose(bn.running_var.to_numpy(),
+                               weights["bn1/1"] / 2.0, rtol=1e-6)
+    np.testing.assert_array_equal(bn.scale.to_numpy(),
+                                  weights["scale1/0"])
+    np.testing.assert_array_equal(bn.bias.to_numpy(),
+                                  weights["scale1/1"])
+
+
+def test_rect_kernel_repeated_field(tmp_path):
+    """`kernel_size: 1 kernel_size: 7` builds a 1x7 conv, not 1x1."""
+    path = tmp_path / "rect.prototxt"
+    path.write_text('''
+layer { name: "c" type: "Convolution" bottom: "d" top: "c"
+  convolution_param { num_output: 2 kernel_size: 1 kernel_size: 7
+                      pad_h: 0 pad_w: 3 } }
+''')
+    net = converter.CaffeConverter(str(path)).create_net()
+    x = tensor.from_numpy(
+        np.random.RandomState(0).randn(1, 3, 5, 9).astype(np.float32))
+    net.compile([x], is_train=False, use_graph=False)
+    out = net.forward(x)
+    assert out.shape == (1, 2, 5, 9)
+    assert net._catalog["c"].W.shape == (2, 3, 1, 7)

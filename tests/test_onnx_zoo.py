@@ -1,0 +1,61 @@
+"""ONNX zoo-style example tests: resnet18 round trip + GPT-2-shaped
+decoder (reference: `examples/onnx/{resnet18,gpt2}.py`, SURVEY.md
+§2.3 — VERDICT r3 Missing #4)."""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.join(_ROOT, "examples", "onnx"))
+sys.path.insert(0, os.path.join(_ROOT, "examples", "cnn", "model"))
+
+from singa_tpu import opt, sonnx, tensor  # noqa: E402
+
+
+def test_resnet18_export_import_eval_roundtrip(tmp_path):
+    from resnet18 import export_resnet18
+
+    path = str(tmp_path / "r18.onnx")
+    ref, x = export_resnet18(path, img=32)
+    rep = sonnx.prepare(sonnx.load(path))
+    out = rep.run([x])[0].to_numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+    ops = {n.op_type for n in sonnx.load(path).graph.node}
+    # the zoo-ResNet op stream
+    assert {"Conv", "BatchNormalization", "Relu", "Add",
+            "GlobalAveragePool"} <= ops
+
+
+def test_gpt2_causality_and_finetune(tmp_path):
+    from gpt2 import GPT2, build_gpt2_onnx
+
+    vocab, seq = 64, 12
+    mp = build_gpt2_onnx(vocab=vocab, seq=seq, d=32, heads=2, layers=1)
+    path = str(tmp_path / "gpt2.onnx")
+    sonnx.save(mp, path)
+    m = GPT2(sonnx.load(path))
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, vocab, (1, seq)).astype(np.int32)
+    m.eval()
+    base = m.forward(tensor.from_numpy(ids)).to_numpy()
+    assert base.shape == (1, seq, vocab)
+    # causal: perturbing the last token leaves earlier logits unchanged
+    ids2 = ids.copy()
+    ids2[0, -1] = (ids2[0, -1] + 7) % vocab
+    pert = m.forward(tensor.from_numpy(ids2)).to_numpy()
+    assert np.abs(pert[0, :-1] - base[0, :-1]).max() < 1e-4
+    # ...and DOES change the last position's logits
+    assert np.abs(pert[0, -1] - base[0, -1]).max() > 1e-4
+
+    m.train()
+    m.set_optimizer(opt.SGD(lr=0.1, momentum=0.9))
+    x_np = rs.randint(0, vocab, (2, seq)).astype(np.int32)
+    y_np = np.concatenate([x_np[:, 1:], x_np[:, :1]], axis=1)
+    tx, ty = tensor.from_numpy(x_np), tensor.from_numpy(y_np)
+    losses = []
+    for _ in range(5):
+        _, loss = m.train_one_batch(tx, ty)
+        losses.append(float(loss.to_numpy()))
+    assert losses[-1] < losses[0]

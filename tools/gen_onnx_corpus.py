@@ -358,6 +358,61 @@ def build_cases():
         rtol=1e-4, atol=1e-4)
     cval = _f((2, 3), 21)
     add("constant", _model("Constant", 0, value_attr=cval), [], [cval])
+
+    # ConvTranspose: numpy reference scatters each input pixel through
+    # the kernel: y[n,co,i*s+a-p, j*s+b-p] += x[n,ci,i,j]*w[ci,co,a,b]
+    def np_conv_transpose(x, w, stride=1, pad=0):
+        n, cin, h, wd = x.shape
+        _, cout, kh, kw = w.shape
+        oh = (h - 1) * stride - 2 * pad + kh
+        ow = (wd - 1) * stride - 2 * pad + kw
+        y = np.zeros((n, cout, oh + 2 * pad, ow + 2 * pad), np.float32)
+        for i in range(h):
+            for j in range(wd):
+                contrib = np.einsum("nc,cokl->nokl", x[:, :, i, j], w)
+                y[:, :, i * stride:i * stride + kh,
+                  j * stride:j * stride + kw] += contrib
+        return (y[:, :, pad:y.shape[2] - pad, pad:y.shape[3] - pad]
+                if pad else y)
+
+    xt = _f((2, 3, 4, 4), 22)
+    wt = _f((3, 5, 3, 3), 23, lo=-0.5, hi=0.5)  # IOHW
+    add("convtranspose",
+        _model("ConvTranspose", 1, consts=[wt],
+               attrs={"kernel_shape": [3, 3]}),
+        [xt], [np_conv_transpose(xt, wt)], rtol=1e-3, atol=1e-4)
+    add("convtranspose_stride_pad",
+        _model("ConvTranspose", 1, consts=[wt],
+               attrs={"kernel_shape": [3, 3], "strides": [2, 2],
+                      "pads": [1, 1, 1, 1]}),
+        [xt], [np_conv_transpose(xt, wt, stride=2, pad=1)],
+        rtol=1e-3, atol=1e-4)
+
+    isc, ibi = _f((3,), 24, lo=0.5, hi=1.5), _f((3,), 25)
+    imu = xc.mean(axis=(2, 3), keepdims=True)
+    isd = np.sqrt(xc.var(axis=(2, 3), keepdims=True) + 1e-5)
+    add("instancenormalization",
+        _model("InstanceNormalization", 1, consts=[isc, ibi],
+               attrs={"epsilon": 1e-5}),
+        [xc], [((xc - imu) / isd * isc.reshape(1, -1, 1, 1)
+                + ibi.reshape(1, -1, 1, 1)).astype(np.float32)],
+        rtol=1e-4, atol=1e-4)
+
+    sidx = np.asarray([[1, 0, 2], [0, 2, 1]], np.int64)
+    supd = _f((2, 3), 26)
+    sexp = x.copy()
+    for r in range(2):
+        for cidx in range(3):
+            sexp[r, sidx[r, cidx]] = supd[r, cidx]
+    add("scatterelements",
+        _model("ScatterElements", 1, consts=[sidx, supd],
+               attrs={"axis": 1}),
+        [x], [sexp])
+
+    e1, e2 = _f((2, 3, 4), 27), _f((2, 4, 5), 28)
+    add("einsum", _model("Einsum", 2,
+                         attrs={"equation": "bij,bjk->bik"}),
+        [e1, e2], [np.einsum("bij,bjk->bik", e1, e2)], rtol=1e-4)
     return cases
 
 
