@@ -43,9 +43,18 @@ def main():
     ap.add_argument("--small", action="store_true",
                     help="tiny shapes (mechanics check; use with "
                          "--interpret off-TPU)")
+    ap.add_argument("--cpu", action="store_true",
+                    help="pin the XLA CPU backend in-process (avoids "
+                         "dialing the TPU tunnel at all)")
     args = ap.parse_args()
 
     import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+        from jax.extend.backend import clear_backends
+
+        clear_backends()
     import jax.numpy as jnp
 
     from singa_tpu.ops import pallas_kernels as pk
@@ -98,6 +107,32 @@ def main():
         t_pal = timeit(f_pal, g, iters=max(5, args.iters // 5))
         t_ref = timeit(f_ref, g, iters=max(5, args.iters // 5))
         rows.append((f"topk_sparsify 1% of 2^{n.bit_length()-1}",
+                     t_ref * 1e6, t_pal * 1e6, err))
+
+    # --- fused (flash) attention vs XLA plain attention -------------------
+    from singa_tpu.parallel.ring_attention import plain_attention
+
+    attn_shapes = ([(1, 2, 128, 32)] if args.small
+                   else [(8, 12, 512, 64), (4, 16, 1024, 64),
+                         (2, 16, 2048, 128)])
+    for b, h, s, d in attn_shapes:
+        q = jnp.asarray(rs.randn(b, h, s, d).astype(np.float32))
+        k = jnp.asarray(rs.randn(b, h, s, d).astype(np.float32))
+        v = jnp.asarray(rs.randn(b, h, s, d).astype(np.float32))
+
+        f_pal = jax.jit(jax.grad(
+            lambda q, k, v: jnp.sum(pk.flash_attention(q, k, v, True)),
+            argnums=(0, 1, 2)))
+        f_ref = jax.jit(jax.grad(
+            lambda q, k, v: jnp.sum(plain_attention(q, k, v, causal=True)),
+            argnums=(0, 1, 2)))
+        gp, gr = f_pal(q, k, v), f_ref(q, k, v)
+        err = max(float(jnp.max(jnp.abs(a - b_)))
+                  for a, b_ in zip(gp, gr))
+        it = max(3, args.iters // 10)
+        t_pal = timeit(f_pal, q, k, v, iters=it)
+        t_ref = timeit(f_ref, q, k, v, iters=it)
+        rows.append((f"flash_attn fwd+bwd {b}x{h}x{s}x{d}",
                      t_ref * 1e6, t_pal * 1e6, err))
 
     # --- fused dropout vs jax.random (TPU only) ---------------------------

@@ -1,0 +1,43 @@
+"""singa_tpu — a TPU-native deep-learning framework with the
+capabilities of Apache SINGA (reference: mlinking/singa).
+
+Layer map (mirrors SURVEY.md §1, re-designed TPU-first):
+
+    examples/            train scripts (MLP/CNN/RNN/ONNX)
+    sonnx                ONNX import/export over the op registry
+    model / layer / opt  training API (Model.compile, Layer, SGD..DistOpt)
+    autograd             Operator registry + tape-free backward()
+    tensor / device      Tensor over jax.Array; TpuDevice over PJRT
+    ops/                 op catalogue as XLA HLO + Pallas kernels
+    parallel/            mesh, DP/TP/SP shardings, ring attention
+    io/ + native/        record IO, snapshot, C++ runtime pieces
+"""
+
+__version__ = "0.1.0"
+
+from . import autograd  # noqa: F401
+from . import data  # noqa: F401
+from . import device  # noqa: F401
+from . import initializer  # noqa: F401
+from . import io  # noqa: F401
+from . import layer  # noqa: F401
+from . import loss  # noqa: F401
+from . import metric  # noqa: F401
+from . import model  # noqa: F401
+from . import opt  # noqa: F401
+from . import rnn  # noqa: F401
+from . import snapshot  # noqa: F401
+from . import sonnx  # noqa: F401
+from . import tensor  # noqa: F401
+from .model import Model  # noqa: F401
+from .device import (  # noqa: F401
+    CppCPU,
+    Device,
+    Platform,
+    TpuDevice,
+    create_cpu_device,
+    create_tpu_device,
+    create_tpu_device_on,
+    get_default_device,
+)
+from .tensor import Tensor  # noqa: F401

@@ -140,10 +140,6 @@ class Operator:
         cot = dys[0] if self.num_outputs == 1 else tuple(dys)
         if getattr(self, "_cached_bwd", None) is not None:
             grads = self._cached_bwd(cot, *self._bwd_xs)
-            # Drop the pinned activations: the first instance per
-            # config lives forever inside the _EXEC_CACHE closure, and
-            # holding its inputs would leak device memory.
-            self._cached_bwd = self._bwd_xs = None
             return grads if len(grads) > 1 else grads[0]
         assert self._vjp is not None, f"{self.name}: backward before forward"
         grads = self._vjp(cot)

@@ -162,18 +162,13 @@ class CaffeNet(model_mod.Model):
                     bias=bool(p.get("bias_term", True)), name=nm)
             elif typ == "Pooling":
                 p = ld.get("pooling_param", {})
-                is_max = str(p.get("pool", "MAX")).upper() == "MAX"
-                if p.get("global_pooling"):
-                    # kernel = whole spatial extent (ResNet/GoogLeNet
-                    # deploy nets); lowered to a keepdims reduction.
-                    lay = ("globalpool", is_max)
-                else:
-                    kh, kw = _pair_of(p, "kernel_size")
-                    sh, sw = _pair_of(p, "stride", 1)
-                    ph, pw = _pair_of(p, "pad", 0)
-                    cls = (layer_mod.MaxPool2d if is_max
-                           else layer_mod.AvgPool2d)
-                    lay = cls((kh, kw), (sh, sw), (ph, pw), name=nm)
+                kh, kw = _pair_of(p, "kernel_size")
+                sh, sw = _pair_of(p, "stride", 1)
+                ph, pw = _pair_of(p, "pad", 0)
+                cls = (layer_mod.MaxPool2d
+                       if str(p.get("pool", "MAX")).upper() == "MAX"
+                       else layer_mod.AvgPool2d)
+                lay = cls((kh, kw), (sh, sw), (ph, pw), name=nm)
             elif typ == "InnerProduct":
                 p = ld.get("inner_product_param", {})
                 lay = layer_mod.Linear(
@@ -187,10 +182,7 @@ class CaffeNet(model_mod.Model):
                 # BatchNorm2d already carries γ/β, so Scale folds away.
                 lay = "identity"
             elif typ == "ReLU":
-                slope = float(ld.get("relu_param", {})
-                              .get("negative_slope", 0.0))
-                lay = (layer_mod.LeakyReLU(slope, name=nm) if slope
-                       else layer_mod.ReLU(name=nm))
+                lay = layer_mod.ReLU(name=nm)
             elif typ == "Sigmoid":
                 lay = layer_mod.Sigmoid(name=nm)
             elif typ == "TanH":
@@ -243,11 +235,6 @@ class CaffeNet(model_mod.Model):
                 out = ins[0]
             elif lay == "softmax":
                 out = autograd.SoftMax(-1)(ins[0])
-            elif isinstance(lay, tuple) and lay[0] == "globalpool":
-                if lay[1]:  # global MAX pool
-                    out = autograd.Max([2, 3], keepdims=1)(ins[0])
-                else:
-                    out = autograd.GlobalAveragePool()(ins[0])
             elif isinstance(lay, tuple) and lay[0] == "concat":
                 out = autograd.cat(ins, lay[1])
             elif isinstance(lay, tuple) and lay[0] == "eltwise":

@@ -1,0 +1,350 @@
+"""Device abstraction for the TPU-native framework.
+
+Reference parity: SINGA's `include/singa/core/device.h` /
+`src/core/device/device.cc` (`Device`, `CppCPU`, `CudaGPU`, `Platform`).
+The reference routes every tensor op through
+`Device::Exec(fn, read_blocks, write_blocks)`, which either runs the
+lambda immediately (eager) or buffers it into a `Graph` for later
+`Graph::Run()` (graph mode).
+
+TPU-native redesign: XLA already *is* a buffering/fusing scheduler, so
+`TpuDevice` does not reimplement SINGA's block-level graph. Eager ops
+dispatch straight to jax (async, per-op compiled+cached by XLA); "graph
+mode" is realized one level up, in `model.Model.compile(use_graph=True)`,
+which traces the entire train step into a single `jax.jit` program —
+the idiomatic XLA equivalent of SINGA's `Graph::Run()` replay
+(SURVEY.md §1 "eager-by-default, graph-by-opt-in").
+
+What *is* kept from the reference Device API:
+  - `SetRandSeed` — counter-based RNG (threefry) replaces curand.
+  - `Sync` — fences the device stream (was `cudaStreamSynchronize`).
+  - `EnableGraph`/`graph_enabled` — consulted by `Model.compile`.
+  - `SetVerbosity`/`PrintTimeProfiling`/`SetSkipIteration` — the per-op
+    profiling table (reference: cudaEvent timing inside `Graph::Run`,
+    `src/core/scheduler/scheduler.cc`); here backed by op-level wall
+    timing in eager mode, and in graph (jit) mode by measured step
+    times plus a per-HLO-instruction cost breakdown of the compiled
+    program (`hlo_profile.py`) — fused regions are attributed back to
+    framework ops via `jax.named_scope` metadata.
+"""
+from __future__ import annotations
+
+import collections
+import os
+import time
+from typing import Optional
+
+import jax
+import numpy as np
+
+__all__ = [
+    "Device",
+    "CppCPU",
+    "TpuDevice",
+    "Platform",
+    "create_cpu_device",
+    "create_tpu_device",
+    "create_tpu_device_on",
+    "create_tpu_devices",
+    "get_default_device",
+    "enable_lazy_alloc",  # no-op parity shim
+    # Migration aliases (reference names):
+    "create_cuda_gpu",
+    "create_cuda_gpu_on",
+    "create_cuda_gpus",
+]
+
+
+class Device:
+    """Base device. Reference: `singa::Device` (include/singa/core/device.h).
+
+    Each instance wraps one `jax.Device` and owns a counter-based RNG
+    key stream (replacing the reference's per-device curand generator).
+    """
+
+    _next_uid = 0
+
+    def __init__(self, jax_device, lang: str):
+        self.jax_device = jax_device
+        self.lang = lang  # "cpp" | "tpu"  (reference: kCpp / kCuda / kOpencl)
+        self.id = getattr(jax_device, "id", 0)
+        self.uid = Device._next_uid
+        Device._next_uid += 1
+        # Commit the key to this device so every op that consumes it
+        # (and therefore every random fill) executes HERE — an
+        # uncommitted key would drag CPU-tensor RNG onto the default
+        # accelerator.
+        self._rng_key = jax.device_put(jax.random.PRNGKey(0), jax_device)
+        # Graph-capture flag, consulted by Model.compile (reference:
+        # Device::EnableGraph / graph_enabled_).
+        self._graph_enabled = False
+        # Profiling state (reference: Device::SetVerbosity /
+        # PrintTimeProfiling / SetSkipIteration).
+        self._verbosity = 0
+        self._skip_iteration = 5
+        self._op_times = collections.defaultdict(lambda: [0.0, 0])
+        self._iteration = 0
+        # Graph-mode profiles: label -> {"rows": [...], "step_s": float}
+        # (filled by model._JitStep when verbosity > 0; see
+        # hlo_profile.py for the cost model).
+        self._graph_profiles = {}
+
+    # ---- RNG ------------------------------------------------------------
+    def SetRandSeed(self, seed: int) -> None:
+        """Reference: `Device::SetRandSeed` (curand seed → threefry key)."""
+        self._rng_key = jax.device_put(jax.random.PRNGKey(seed),
+                                       self.jax_device)
+
+    set_rand_seed = SetRandSeed
+
+    def next_key(self):
+        """Split and return a fresh PRNG key (counter-based, reproducible)."""
+        self._rng_key, sub = jax.random.split(self._rng_key)
+        return sub
+
+    # ---- Execution ------------------------------------------------------
+    def put(self, array):
+        """Place a host array onto this device (async)."""
+        return jax.device_put(array, self.jax_device)
+
+    def Sync(self) -> None:
+        """Fence: block until all prior work on this device is done.
+
+        Reference: `CudaGPU::Sync` → `cudaStreamSynchronize`. A bare
+        device_put is NOT a fence (transfers ride a separate stream);
+        instead enqueue a trivial *execution* — PJRT executes programs
+        on a device in FIFO submission order — and block on its result.
+        """
+        x = jax.device_put(np.zeros((), np.float32), self.jax_device)
+        _sync_kernel(x).block_until_ready()
+
+    sync = Sync
+
+    # ---- Graph-mode flag -------------------------------------------------
+    def EnableGraph(self, flag: bool) -> None:
+        """Reference: `Device::EnableGraph`. Consulted by Model.compile."""
+        self._graph_enabled = bool(flag)
+
+    @property
+    def graph_enabled(self) -> bool:
+        return self._graph_enabled
+
+    # ---- Profiling -------------------------------------------------------
+    def SetVerbosity(self, v: int) -> None:
+        self._verbosity = int(v)
+
+    def SetSkipIteration(self, k: int) -> None:
+        self._skip_iteration = int(k)
+
+    def StepIteration(self) -> None:
+        self._iteration += 1
+
+    def RecordOpTime(self, name: str, seconds: float) -> None:
+        if self._verbosity > 0 and self._iteration >= self._skip_iteration:
+            t = self._op_times[name]
+            t[0] += seconds
+            t[1] += 1
+
+    def TimeOp(self, name: str):
+        """Context manager timing one op when verbosity > 0."""
+        return _OpTimer(self, name)
+
+    def PrintTimeProfiling(self) -> str:
+        """Reference: `Device::PrintTimeProfiling` — per-op time table.
+
+        Eager ops report measured wall times; graph (jit) runs report
+        the measured step time plus the compiled program's per-op XLA
+        cost breakdown (hlo_profile.py)."""
+        lines = ["Time Profiling:"]
+        total = sum(t for t, _ in self._op_times.values())
+        for name, (t, n) in sorted(
+            self._op_times.items(), key=lambda kv: -kv[1][0]
+        ):
+            avg_us = (t / max(n, 1)) * 1e6
+            pct = 100.0 * t / total if total else 0.0
+            lines.append(
+                f"  OP = {name:<28} Time = {avg_us:10.3f} us x {n:<6d} ({pct:5.1f}%)"
+            )
+        out = "\n".join(lines)
+        for label, prof in self._graph_profiles.items():
+            from . import hlo_profile
+
+            out += f"\n[{label}]\n" + hlo_profile.format_table(
+                prof["rows"], prof.get("step_s"))
+        print(out)
+        return out
+
+    def ResetTimeProfiling(self) -> None:
+        self._op_times.clear()
+        self._graph_profiles.clear()
+        self._iteration = 0
+
+    # ---- Misc ------------------------------------------------------------
+    def __repr__(self):
+        return f"<{type(self).__name__} id={self.id} lang={self.lang}>"
+
+
+@jax.jit
+def _sync_kernel(x):
+    return x + 1
+
+
+class _OpTimer:
+    def __init__(self, dev: Device, name: str):
+        self.dev, self.name = dev, name
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.dev.RecordOpTime(self.name, time.perf_counter() - self.t0)
+        return False
+
+
+class CppCPU(Device):
+    """Host CPU device. Reference: `singa::CppCPU` (src/core/device/cpp_cpu.cc)."""
+
+    def __init__(self, jax_device=None):
+        if jax_device is None:
+            # Local, not global: under multi-controller launch
+            # (train_multiprocess/train_mpi), jax.devices() lists other
+            # processes' devices too, and the host device must be one
+            # this process can address.
+            jax_device = jax.local_devices(backend="cpu")[0]
+        super().__init__(jax_device, lang="cpp")
+
+
+class TpuDevice(Device):
+    """TPU device backed by XLA/PJRT-managed HBM buffers.
+
+    This is the north-star component: the reference's `CudaGPU`
+    (src/core/device/cuda_gpu.cc: cnmem pool + cublas/cudnn/curand
+    handles + stream) re-imagined for TPU. There is no custom memory
+    pool — PJRT owns HBM (SURVEY.md §7: "no custom allocator") — and no
+    handle zoo — XLA compiles and caches per-op executables.
+    """
+
+    def __init__(self, jax_device):
+        super().__init__(jax_device, lang="tpu")
+
+
+class Platform:
+    """Device discovery/factory.
+
+    Reference: `singa::Platform` (src/core/device/platform.cc) —
+    `GetNumGPUs`, `CreateCudaGPUs`, `DeviceQuery`. Here: enumerate
+    PJRT devices; TPU when available, else CPU.
+    """
+
+    _cache: dict = {}
+
+    @staticmethod
+    def GetNumTPUs() -> int:
+        try:
+            return len(_backend_devices("tpu"))
+        except RuntimeError:
+            return 0
+
+    # Reference-name alias so `Platform.GetNumGPUs()` keeps working.
+    GetNumGPUs = GetNumTPUs
+
+    @staticmethod
+    def GetNumCPUs() -> int:
+        return len(_backend_devices("cpu"))
+
+    @staticmethod
+    def CreateTpuDevices(num: int):
+        devs = _accel_devices()
+        if len(devs) < num:
+            raise ValueError(
+                f"requested {num} accelerator devices, only {len(devs)} present"
+            )
+        return [Platform._get(TpuDevice, d) for d in devs[:num]]
+
+    CreateCudaGPUs = CreateTpuDevices
+
+    @staticmethod
+    def CreateTpuDeviceOn(device_id: int):
+        devs = _accel_devices()
+        for d in devs:
+            if d.id == device_id:
+                return Platform._get(TpuDevice, d)
+        raise ValueError(f"no accelerator device with id {device_id}")
+
+    @staticmethod
+    def DeviceQuery(device_id: int = 0) -> str:
+        devs = jax.devices()
+        lines = [f"{len(devs)} device(s):"]
+        for d in devs:
+            lines.append(
+                f"  id={d.id} platform={d.platform} kind={getattr(d, 'device_kind', '?')}"
+            )
+        return "\n".join(lines)
+
+    @staticmethod
+    def _get(cls, jax_device):
+        key = (cls.__name__, jax_device.id, jax_device.platform)
+        if key not in Platform._cache:
+            Platform._cache[key] = cls(jax_device)
+        return Platform._cache[key]
+
+
+def _backend_devices(platform: str):
+    return jax.devices(platform)
+
+
+def _accel_devices():
+    """Accelerator devices: real TPUs if present, else the CPU backend's
+    (possibly virtual, via --xla_force_host_platform_device_count) devices.
+    The CPU fallback is what makes the whole stack CI-testable."""
+    for platform in ("tpu", "axon"):
+        try:
+            devs = jax.devices(platform)
+            if devs:
+                return devs
+        except RuntimeError:
+            continue
+    return jax.devices()
+
+
+_default_device: Optional[Device] = None
+
+
+def get_default_device() -> Device:
+    """Reference: `Platform::GetDefaultDevice` — the host CppCPU."""
+    global _default_device
+    if _default_device is None:
+        _default_device = CppCPU()
+    return _default_device
+
+
+def create_cpu_device() -> CppCPU:
+    return get_default_device()
+
+
+def create_tpu_device() -> Device:
+    """First accelerator device (TPU if present; CPU device 0 otherwise)."""
+    return Platform._get(TpuDevice, _accel_devices()[0])
+
+
+def create_tpu_device_on(device_id: int) -> Device:
+    return Platform.CreateTpuDeviceOn(device_id)
+
+
+def create_tpu_devices(num: int):
+    return Platform.CreateTpuDevices(num)
+
+
+def enable_lazy_alloc(flag: bool) -> None:
+    """Parity shim: reference toggles cnmem lazy allocation; PJRT owns HBM."""
+
+
+# ---------------------------------------------------------------------------
+# Migration aliases: the reference's Python API spells these
+# `device.create_cuda_gpu*` (python/singa/device.py). Keep the names so
+# reference user code ports by import-swap; they build TPU devices here.
+# ---------------------------------------------------------------------------
+create_cuda_gpu = create_tpu_device
+create_cuda_gpu_on = create_tpu_device_on
+create_cuda_gpus = create_tpu_devices

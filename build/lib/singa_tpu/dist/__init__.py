@@ -1,0 +1,18 @@
+"""Distributed communication backend.
+
+Reference parity: `src/io/communicator.cc` + `include/singa/io/
+communicator.h` — SINGA's NCCL `Communicator` (the entire data-parallel
+engine: synch/fusedSynch/synchHalf/sparsification over dedicated CUDA
+streams) and its `NcclIdHolder` bootstrap token.
+
+TPU-native redesign: XLA collectives over the device mesh (`psum` /
+`all_gather` riding ICI; DCN across slices), driven single-controller.
+There is no NCCL, no MPI: rank bookkeeping becomes mesh axes, stream
+overlap becomes XLA's latency-hiding scheduler, and fp16 compression
+becomes bf16 (`singa_tpu/dist/communicator.py`).
+"""
+from .communicator import (  # noqa: F401
+    Communicator,
+    NcclIdHolder,
+    init_distributed,
+)

@@ -1,0 +1,259 @@
+"""Graph-mode per-op profiling: XLA HLO cost breakdown.
+
+Reference parity: the reference times every graph node with cudaEvent
+pairs inside `Graph::Run` and prints a per-op table via
+`Device::PrintTimeProfiling` (src/core/scheduler/scheduler.cc,
+SURVEY.md §5). In the TPU design the whole training step is ONE fused
+XLA program, so "per-op kernel times" do not exist post-fusion; the
+honest equivalent is:
+
+  * measured wall time of the compiled step (recorded by `_JitStep`
+    into the device's op-time table), plus
+  * a per-HLO-instruction cost breakdown of the optimized program —
+    FLOPs computed analytically from dot/convolution dimension numbers,
+    bytes from operand/result shapes — with each top-level instruction
+    attributed back to the framework op that produced it via the
+    `op_name` metadata that `autograd.Operator.__call__` stamps with
+    `jax.named_scope`.
+
+Estimated per-region time = (region FLOPs / program FLOPs) x measured
+step time; the table is explicit that these are cost-model estimates,
+not per-kernel measurements.
+
+No TensorFlow/profiler-plugin dependency: this parses the HLO text
+that PJRT already returns (`compiled.as_text()`).
+"""
+from __future__ import annotations
+
+import math
+import re
+from typing import Dict, List, Optional
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
+    "c64": 8, "c128": 16, "s4": 1, "u4": 1, "f8e4m3fn": 1, "f8e5m2": 1,
+}
+
+# `%name = f32[2,3]{1,0} opcode(...)` (also matches tuple-typed results
+# loosely; those get shape=None).
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?(?P<name>[\w.\-]+)\s*=\s*"
+    r"(?P<dtype>[a-z][a-z0-9]*)\[(?P<shape>[0-9,]*)\]\S*\s+"
+    r"(?P<opcode>[\w\-]+)\(")
+_TUPLE_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?(?P<name>[\w.\-]+)\s*=\s*\("
+    r".*?\)\s+(?P<opcode>[\w\-]+)\(")
+_COMP_RE = re.compile(r"^(?:ENTRY\s+)?%?(?P<name>[\w.\-]+)\s*"
+                      r"(?:\([^)]*\))?\s*->.*\{\s*$")
+_OPERANDS_RE = re.compile(r"\(([^)]*)\)")
+_OPNAME_RE = re.compile(r'op_name="([^"]*)"')
+_CALLS_RE = re.compile(r"calls=%?([\w.\-]+)")
+_CONTRACT_RE = re.compile(r"lhs_contracting_dims=\{([0-9,]*)\}")
+_DIMLABELS_RE = re.compile(r"dim_labels=(\w+)_(\w+)->(\w+)")
+
+
+def _shape_of(type_str: str):
+    m = re.match(r"([a-z][a-z0-9]*)\[([0-9,]*)\]", type_str)
+    if not m:
+        return None
+    dims = [int(d) for d in m.group(2).split(",") if d] if m.group(2) else []
+    return m.group(1), dims
+
+
+def _numel(dims: List[int]) -> int:
+    return int(math.prod(dims)) if dims else 1
+
+
+class _Instr:
+    __slots__ = ("name", "dtype", "dims", "opcode", "line")
+
+    def __init__(self, name, dtype, dims, opcode, line):
+        self.name, self.dtype, self.dims = name, dtype, dims
+        self.opcode, self.line = opcode, line
+
+
+def _parse_computations(hlo_text: str) -> Dict[str, List[_Instr]]:
+    """Split module text into computations -> instruction lists."""
+    comps: Dict[str, List[_Instr]] = {}
+    current: Optional[str] = None
+    for raw in hlo_text.splitlines():
+        line = raw.rstrip()
+        if current is None:
+            m = _COMP_RE.match(line.strip())
+            if m and line.rstrip().endswith("{"):
+                current = m.group("name")
+                comps[current] = []
+            continue
+        if line.strip() == "}":
+            current = None
+            continue
+        m = _INSTR_RE.match(line)
+        if m:
+            dims = ([int(d) for d in m.group("shape").split(",") if d]
+                    if m.group("shape") else [])
+            comps[current].append(_Instr(
+                m.group("name"), m.group("dtype"), dims,
+                m.group("opcode"), line))
+            continue
+        m = _TUPLE_INSTR_RE.match(line)
+        if m:
+            comps[current].append(_Instr(
+                m.group("name"), None, None, m.group("opcode"), line))
+    return comps
+
+
+def _instr_flops(ins: _Instr, shapes: Dict[str, tuple]) -> float:
+    """Analytic FLOPs for one instruction (0 for data movement)."""
+    op = ins.opcode
+    if op in ("parameter", "constant", "tuple", "get-tuple-element",
+              "bitcast", "copy", "reshape", "transpose", "broadcast",
+              "slice", "concatenate", "gather", "scatter", "pad",
+              "dynamic-slice", "dynamic-update-slice", "iota",
+              "convert", "reverse", "copy-start", "copy-done",
+              "all-gather", "all-reduce", "reduce-scatter",
+              "collective-permute", "partition-id", "replica-id"):
+        return 0.0
+    out_n = _numel(ins.dims) if ins.dims is not None else 0
+    if op == "dot":
+        m = _OPERANDS_RE.search(ins.line)
+        c = _CONTRACT_RE.search(ins.line)
+        if m and c:
+            ops = [o.strip().lstrip("%") for o in m.group(1).split(",")]
+            lhs = shapes.get(ops[0].split(" ")[0]) if ops else None
+            if lhs:
+                cdims = [int(d) for d in c.group(1).split(",") if d]
+                k = _numel([lhs[1][d] for d in cdims if d < len(lhs[1])])
+                return 2.0 * out_n * k
+        return 2.0 * out_n  # fallback
+    if op == "convolution":
+        m = _OPERANDS_RE.search(ins.line)
+        dl = _DIMLABELS_RE.search(ins.line)
+        if m and dl:
+            ops = [o.strip().lstrip("%") for o in m.group(1).split(",")]
+            rhs = shapes.get(ops[1].split(" ")[0]) if len(ops) > 1 else None
+            if rhs:
+                o_pos = dl.group(2).index("o")
+                rhs_n = _numel(rhs[1])
+                o_size = rhs[1][o_pos] if o_pos < len(rhs[1]) else 1
+                return 2.0 * out_n * rhs_n / max(o_size, 1)
+        return 2.0 * out_n
+    if op in ("exponential", "log", "tanh", "logistic", "power", "rsqrt",
+              "sqrt", "sine", "cosine", "erf", "atan2", "expm1",
+              "log-plus-one", "cbrt"):
+        return 8.0 * out_n  # transcendental: several flops each
+    if op == "reduce":
+        # ~1 flop per reduced input element; approximate via operand.
+        m = _OPERANDS_RE.search(ins.line)
+        if m:
+            ops = [o.strip().lstrip("%") for o in m.group(1).split(",")]
+            src = shapes.get(ops[0].split(" ")[0]) if ops else None
+            if src:
+                return float(_numel(src[1]))
+        return float(out_n)
+    if op in ("reduce-window", "select-and-scatter"):
+        return float(out_n) * 9.0  # window size unknown; assume 3x3-ish
+    if op == "rng-bit-generator":
+        return 16.0 * out_n
+    # default: elementwise-ish, 1 flop/element
+    return float(out_n)
+
+
+def _instr_bytes(ins: _Instr) -> float:
+    if ins.dims is None or ins.dtype is None:
+        return 0.0
+    return float(_numel(ins.dims)) * _DTYPE_BYTES.get(ins.dtype, 4)
+
+
+def profile_hlo(hlo_text: str) -> List[dict]:
+    """Per top-level-instruction cost rows for the ENTRY computation.
+
+    Returns rows {op, hlo, flops, out_bytes} where `op` is the
+    framework-level op_name path (from named_scope metadata) and
+    fusions include their fused computation's FLOPs.
+    """
+    comps = _parse_computations(hlo_text)
+    if not comps:
+        return []
+    # ENTRY computation: jax names it e.g. "main.123"; it is the one
+    # whose name starts with "main" or the last parsed.
+    entry = None
+    for name in comps:
+        if name.startswith("main"):
+            entry = name
+    if entry is None:
+        entry = list(comps.keys())[-1]
+
+    shapes: Dict[str, tuple] = {}
+    for instrs in comps.values():
+        for ins in instrs:
+            if ins.dims is not None:
+                shapes[ins.name] = (ins.dtype, ins.dims)
+
+    # FLOPs per computation (for fusion attribution); resolve nested
+    # calls iteratively to a fixed point.
+    comp_flops: Dict[str, float] = {}
+    for _ in range(4):
+        for cname, instrs in comps.items():
+            total = 0.0
+            for ins in instrs:
+                if ins.opcode == "fusion" or ins.opcode in ("call", "map"):
+                    cm = _CALLS_RE.search(ins.line)
+                    if cm:
+                        total += comp_flops.get(cm.group(1), 0.0)
+                        continue
+                total += _instr_flops(ins, shapes)
+            comp_flops[cname] = total
+
+    rows: List[dict] = []
+    for ins in comps[entry]:
+        if ins.opcode in ("parameter", "constant", "tuple",
+                          "get-tuple-element"):
+            continue
+        if ins.opcode in ("fusion", "call", "map"):
+            cm = _CALLS_RE.search(ins.line)
+            flops = comp_flops.get(cm.group(1), 0.0) if cm else 0.0
+        else:
+            flops = _instr_flops(ins, shapes)
+        opname = _OPNAME_RE.search(ins.line)
+        label = opname.group(1) if opname else ins.name
+        # Strip the jit(...) prefix; keep the scoped path.
+        label = re.sub(r"^jit\([^)]*\)/", "", label)
+        rows.append({"op": label, "hlo": ins.opcode, "flops": flops,
+                     "out_bytes": _instr_bytes(ins)})
+    return rows
+
+
+def aggregate(rows: List[dict], top: int = 0) -> List[dict]:
+    """Group rows by framework op (first two named_scope segments)."""
+    groups: Dict[str, dict] = {}
+    for r in rows:
+        parts = [p for p in r["op"].split("/") if p]
+        key = "/".join(parts[:2]) if parts else r["hlo"]
+        g = groups.setdefault(key, {"op": key, "flops": 0.0,
+                                    "out_bytes": 0.0, "count": 0})
+        g["flops"] += r["flops"]
+        g["out_bytes"] += r["out_bytes"]
+        g["count"] += 1
+    out = sorted(groups.values(), key=lambda g: -g["flops"])
+    return out[:top] if top else out
+
+
+def format_table(rows: List[dict], measured_step_s: Optional[float] = None,
+                 top: int = 25) -> str:
+    """Human-readable graph profile table (printed by
+    Device.PrintTimeProfiling when graph-mode profiles exist)."""
+    agg = aggregate(rows, top=top)
+    total_flops = sum(r["flops"] for r in rows) or 1.0
+    lines = ["Graph (XLA) cost profile"
+             + (f"  [measured step: {measured_step_s * 1e3:.2f} ms]"
+                if measured_step_s else "")
+             + f"  total ~{total_flops / 1e9:.2f} GFLOP:"]
+    for g in agg:
+        pct = 100.0 * g["flops"] / total_flops
+        est = (f"  est {measured_step_s * g['flops'] / total_flops * 1e3:8.3f} ms"
+               if measured_step_s else "")
+        lines.append(
+            f"  OP = {g['op']:<40} FLOPs = {g['flops'] / 1e6:12.2f} M "
+            f"({pct:5.1f}%) x {g['count']:<4d}{est}")
+    return "\n".join(lines)

@@ -1,0 +1,211 @@
+"""PIL-backed image augmentation pipeline + JPEG codec.
+
+Reference parity:
+  * `python/singa/image_tool.py` — the chainable `ImageTool` (load ->
+    resize/rotate/crop/flip/color ops -> get), PIL-based there too.
+  * `src/io/jpg_{encoder,decoder}.cc` (SURVEY.md N19) — the
+    reference's JPEG codec is OpenCV-backed and optional; here the
+    same optional-external-dependency role is filled by PIL
+    (`JPGEncoder`/`JPGDecoder`), which this image ships. CSV and raw
+    codecs are native C++ (native/src/csv.cc, image.cc).
+
+Arrays are HWC uint8 (PIL convention) at the tool boundary;
+`to_chw_float` converts to the CHW float32 layout the conv stack eats.
+"""
+from __future__ import annotations
+
+import io as _stdio
+import random
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+try:
+    from PIL import Image, ImageEnhance
+except ImportError:  # pragma: no cover - PIL ships in this image
+    Image = None
+
+
+def _require_pil():
+    if Image is None:
+        raise RuntimeError("image_tool requires PIL (Pillow)")
+
+
+# ---------------------------------------------------------------------------
+# JPEG codec (reference: JPGEncoder/JPGDecoder)
+# ---------------------------------------------------------------------------
+class JPGDecoder:
+    """bytes (JPEG/PNG/...) -> HWC uint8 array."""
+
+    def decode(self, data: bytes) -> np.ndarray:
+        _require_pil()
+        img = Image.open(_stdio.BytesIO(data)).convert("RGB")
+        return np.asarray(img, np.uint8)
+
+
+class JPGEncoder:
+    """HWC uint8 array -> JPEG bytes."""
+
+    def __init__(self, quality: int = 90):
+        self.quality = quality
+
+    def encode(self, arr: np.ndarray) -> bytes:
+        _require_pil()
+        buf = _stdio.BytesIO()
+        Image.fromarray(np.asarray(arr, np.uint8)).save(
+            buf, format="JPEG", quality=self.quality)
+        return buf.getvalue()
+
+
+def to_chw_float(arr: np.ndarray) -> np.ndarray:
+    """HWC uint8 -> CHW float32 (the conv-stack layout)."""
+    return np.ascontiguousarray(
+        np.asarray(arr, np.float32).transpose(2, 0, 1))
+
+
+def from_chw_float(arr: np.ndarray) -> np.ndarray:
+    return np.asarray(np.clip(arr, 0, 255), np.uint8).transpose(1, 2, 0)
+
+
+# ---------------------------------------------------------------------------
+# Chainable augmentation tool (reference: image_tool.ImageTool)
+# ---------------------------------------------------------------------------
+class ImageTool:
+    """Holds a working list of PIL images; every op maps the list
+    (one input can fan out, e.g. crop5). `get()` returns HWC uint8
+    arrays. Reference semantics: ops ending in `_by_range` sample one
+    parameter uniformly; `_by_list` applies every listed parameter."""
+
+    def __init__(self, seed: Optional[int] = None):
+        _require_pil()
+        self._imgs: List["Image.Image"] = []
+        self._rng = random.Random(seed)
+
+    # -- IO ----------------------------------------------------------------
+    def load(self, path_or_bytes) -> "ImageTool":
+        if isinstance(path_or_bytes, (bytes, bytearray)):
+            img = Image.open(_stdio.BytesIO(path_or_bytes))
+        else:
+            img = Image.open(path_or_bytes)
+        self._imgs = [img.convert("RGB")]
+        return self
+
+    def set(self, arr: np.ndarray) -> "ImageTool":
+        self._imgs = [Image.fromarray(np.asarray(arr, np.uint8))]
+        return self
+
+    def get(self) -> List[np.ndarray]:
+        return [np.asarray(im, np.uint8) for im in self._imgs]
+
+    def get_one(self) -> np.ndarray:
+        return self.get()[0]
+
+    # -- geometry ----------------------------------------------------------
+    def resize_by_list(self, sizes: Sequence[int]) -> "ImageTool":
+        """Resize shorter side to each size in `sizes` (fan-out)."""
+        out = []
+        for im in self._imgs:
+            for s in sizes:
+                out.append(_resize_short(im, s))
+        self._imgs = out
+        return self
+
+    def resize_by_range(self, lo: int, hi: int) -> "ImageTool":
+        s = self._rng.randint(lo, hi)
+        self._imgs = [_resize_short(im, s) for im in self._imgs]
+        return self
+
+    def rotate_by_list(self, angles: Sequence[float]) -> "ImageTool":
+        self._imgs = [im.rotate(a) for im in self._imgs for a in angles]
+        return self
+
+    def rotate_by_range(self, lo: float, hi: float) -> "ImageTool":
+        a = self._rng.uniform(lo, hi)
+        self._imgs = [im.rotate(a) for im in self._imgs]
+        return self
+
+    def random_crop(self, size) -> "ImageTool":
+        h, w = (size, size) if isinstance(size, int) else size
+        out = []
+        for im in self._imgs:
+            if im.width < w or im.height < h:
+                raise ValueError(
+                    f"crop {h}x{w} larger than image "
+                    f"{im.height}x{im.width}")
+            x0 = self._rng.randint(0, im.width - w)
+            y0 = self._rng.randint(0, im.height - h)
+            out.append(im.crop((x0, y0, x0 + w, y0 + h)))
+        self._imgs = out
+        return self
+
+    def crop5(self, size) -> "ImageTool":
+        """Center + 4 corners (reference crop5 test-time augmentation)."""
+        h, w = (size, size) if isinstance(size, int) else size
+        out = []
+        for im in self._imgs:
+            W, H = im.width, im.height
+            if W < w or H < h:
+                raise ValueError(f"crop {h}x{w} larger than {H}x{W}")
+            boxes = [
+                ((W - w) // 2, (H - h) // 2),
+                (0, 0), (W - w, 0), (0, H - h), (W - w, H - h),
+            ]
+            out.extend(im.crop((x, y, x + w, y + h)) for x, y in boxes)
+        self._imgs = out
+        return self
+
+    def flip(self, prob: float = 0.5) -> "ImageTool":
+        """Random horizontal flip per image."""
+        self._imgs = [
+            im.transpose(Image.FLIP_LEFT_RIGHT)
+            if self._rng.random() < prob else im
+            for im in self._imgs
+        ]
+        return self
+
+    def flip2(self) -> "ImageTool":
+        """Fan out: each image -> (original, h-flipped)."""
+        self._imgs = [x for im in self._imgs
+                      for x in (im, im.transpose(Image.FLIP_LEFT_RIGHT))]
+        return self
+
+    # -- color -------------------------------------------------------------
+    def color_cast(self, offset: int = 20) -> "ImageTool":
+        """Add a random per-channel offset in [-offset, offset]."""
+        out = []
+        for im in self._imgs:
+            arr = np.asarray(im, np.int16)
+            cast = np.asarray(
+                [self._rng.randint(-offset, offset) for _ in range(3)],
+                np.int16)
+            out.append(Image.fromarray(
+                np.clip(arr + cast, 0, 255).astype(np.uint8)))
+        self._imgs = out
+        return self
+
+    def enhance(self, scale: float = 0.2) -> "ImageTool":
+        """Random brightness/contrast/sharpness in [1-scale, 1+scale]."""
+        out = []
+        for im in self._imgs:
+            for enh in (ImageEnhance.Brightness, ImageEnhance.Contrast,
+                        ImageEnhance.Sharpness):
+                im = enh(im).enhance(
+                    1.0 + self._rng.uniform(-scale, scale))
+            out.append(im)
+        self._imgs = out
+        return self
+
+
+def _resize_short(im, s: int):
+    if im.width <= im.height:
+        return im.resize((s, max(1, round(im.height * s / im.width))),
+                         Image.BILINEAR)
+    return im.resize((max(1, round(im.width * s / im.height)), s),
+                     Image.BILINEAR)
+
+
+def load_img(path, grayscale: bool = False):
+    """Reference: `image_tool.load_img`."""
+    _require_pil()
+    img = Image.open(path)
+    return img.convert("L" if grayscale else "RGB")

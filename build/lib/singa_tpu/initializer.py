@@ -1,0 +1,66 @@
+"""Parameter initializers. Reference: `python/singa/initializer.py`
+(`he_uniform`, `he_normal`, `xavier` (glorot), `uniform`, `gaussian`).
+Each fills an existing Tensor in place using its device's RNG stream.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from .tensor import Tensor
+
+
+def _fans(t: Tensor):
+    shape = t.shape
+    if len(shape) == 2:
+        fan_in, fan_out = shape[0], shape[1]
+    elif len(shape) >= 3:
+        # conv OIHW: receptive field x channels
+        rf = int(np.prod(shape[2:]))
+        fan_in, fan_out = shape[1] * rf, shape[0] * rf
+    else:
+        fan_in = fan_out = int(np.prod(shape)) if shape else 1
+    return fan_in, fan_out
+
+
+def uniform(t: Tensor, low=0.0, high=1.0):
+    t.uniform(low, high)
+
+
+def gaussian(t: Tensor, mean=0.0, std=0.01):
+    t.gaussian(mean, std)
+
+
+def constant(t: Tensor, value=0.0):
+    t.set_value(value)
+
+
+def he_uniform(t: Tensor, mode: str = "fan_in"):
+    """Reference: `initializer.he_uniform` — U(-limit, limit),
+    limit = sqrt(6 / fan)."""
+    fan_in, fan_out = _fans(t)
+    fan = fan_in if mode == "fan_in" else fan_out
+    limit = math.sqrt(6.0 / max(fan, 1))
+    t.uniform(-limit, limit)
+
+
+def he_normal(t: Tensor, mode: str = "fan_in"):
+    fan_in, fan_out = _fans(t)
+    fan = fan_in if mode == "fan_in" else fan_out
+    t.gaussian(0.0, math.sqrt(2.0 / max(fan, 1)))
+
+
+def xavier_uniform(t: Tensor):
+    """Glorot uniform: U(-sqrt(6/(fan_in+fan_out)), +)."""
+    fan_in, fan_out = _fans(t)
+    limit = math.sqrt(6.0 / max(fan_in + fan_out, 1))
+    t.uniform(-limit, limit)
+
+
+xavier = xavier_uniform
+
+
+def xavier_normal(t: Tensor):
+    fan_in, fan_out = _fans(t)
+    t.gaussian(0.0, math.sqrt(2.0 / max(fan_in + fan_out, 1)))

@@ -1,0 +1,478 @@
+"""Stateful layers over autograd ops.
+
+Reference parity: `python/singa/layer.py` (SINGA 3.1+ API) — `Layer`
+with lazy shape-inferred parameter creation on first call, hierarchical
+name scoping, `get_params/set_params` (trainable) and
+`get_states/set_states` (params + non-trainable state like BN running
+stats), and the layer catalogue: Linear, Conv2d, SeparableConv2d,
+BatchNorm2d, MaxPool2d, AvgPool2d, Dropout, Flatten, activation
+layers, Cat, Embedding. RNN/LSTM/GRU live in `singa_tpu.rnn`.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from . import autograd, initializer, tensor as tensor_mod
+from .ops import native
+from .tensor import Tensor
+
+
+class Layer:
+    """Reference: `layer.Layer`.
+
+    Parameters are created lazily in `initialize(*inputs)` on the first
+    call, so input shapes are inferred — the reference's signature
+    behavior. Sublayers and params are discovered via attribute
+    assignment; hierarchical names are `parent.child.param`.
+    """
+
+    sep = "."
+
+    def __init__(self, name: Optional[str] = None):
+        self.name = name or type(self).__name__
+        self._initialized = False
+        self._parent = None
+
+    # -- attribute registration -------------------------------------------
+    def __setattr__(self, key, value):
+        if isinstance(value, Layer):
+            self.__dict__.setdefault("_sublayers", OrderedDict())[key] = value
+        elif isinstance(value, Tensor) and getattr(value, "stores_grad", False):
+            self.__dict__.setdefault("_params", OrderedDict())[key] = value
+        object.__setattr__(self, key, value)
+
+    @property
+    def sublayers(self) -> "OrderedDict[str, Layer]":
+        return self.__dict__.get("_sublayers", OrderedDict())
+
+    @property
+    def own_params(self) -> "OrderedDict[str, Tensor]":
+        return self.__dict__.get("_params", OrderedDict())
+
+    # -- lifecycle ---------------------------------------------------------
+    def initialize(self, *xs):
+        """Create parameters from example inputs. Override in layers."""
+
+    def forward(self, *xs):
+        raise NotImplementedError
+
+    def __call__(self, *xs):
+        if not self._initialized:
+            self.initialize(*xs)
+            self._initialized = True
+        return self.forward(*xs)
+
+    def register_param(self, attr: str, t: Tensor):
+        t.requires_grad = True
+        t.stores_grad = True
+        setattr(self, attr, t)
+        return t
+
+    def register_state(self, attr: str, t: Tensor):
+        """Non-trainable state (e.g. BN running stats)."""
+        t.requires_grad = False
+        t.stores_grad = False
+        self.__dict__.setdefault("_state_attrs", []).append(attr)
+        object.__setattr__(self, attr, t)
+        return t
+
+    # -- param / state trees ----------------------------------------------
+    def get_params(self, prefix: str = "") -> Dict[str, Tensor]:
+        """Reference: `Layer.get_params` — name → trainable Tensor."""
+        base = prefix + self.name if prefix == "" else prefix
+        out: Dict[str, Tensor] = {}
+        for pname, p in self.own_params.items():
+            full = base + self.sep + pname
+            p.name = full
+            out[full] = p
+        for lname, sub in self.sublayers.items():
+            out.update(sub.get_params(base + self.sep + lname))
+        return out
+
+    def set_params(self, params: Dict[str, object], prefix: str = "") -> None:
+        base = prefix + self.name if prefix == "" else prefix
+        for pname, p in self.own_params.items():
+            full = base + self.sep + pname
+            if full in params:
+                v = params[full]
+                p.copy_from_numpy(np.asarray(v.to_numpy() if isinstance(v, Tensor) else v))
+        for lname, sub in self.sublayers.items():
+            sub.set_params(params, base + self.sep + lname)
+
+    def get_states(self, prefix: str = "") -> Dict[str, Tensor]:
+        """Reference: `Layer.get_states` — params + aux state.
+        Single recursion: own params + own state attrs, then descend."""
+        base = prefix + self.name if prefix == "" else prefix
+        out: Dict[str, Tensor] = {}
+        for pname, p in self.own_params.items():
+            full = base + self.sep + pname
+            p.name = full
+            out[full] = p
+        for attr in self.__dict__.get("_state_attrs", []):
+            t = getattr(self, attr)
+            full = base + self.sep + attr
+            t.name = full
+            out[full] = t
+        for lname, sub in self.sublayers.items():
+            out.update(sub.get_states(base + self.sep + lname))
+        return out
+
+    def set_states(self, states: Dict[str, object], prefix: str = "") -> None:
+        base = prefix + self.name if prefix == "" else prefix
+        self.set_params(states, prefix)
+        for attr in self.__dict__.get("_state_attrs", []):
+            full = base + self.sep + attr
+            if full in states:
+                v = states[full]
+                getattr(self, attr).copy_from_numpy(
+                    np.asarray(v.to_numpy() if isinstance(v, Tensor) else v)
+                )
+        for lname, sub in self.sublayers.items():
+            sub.set_states(states, base + self.sep + lname)
+
+    def state_tensors(self) -> List[Tensor]:
+        """Non-param state tensors (ordered) — graph-mode capture set."""
+        out = [getattr(self, a) for a in self.__dict__.get("_state_attrs", [])]
+        for sub in self.sublayers.values():
+            out.extend(sub.state_tensors())
+        return out
+
+    def param_tensors(self) -> List[Tensor]:
+        out = list(self.own_params.values())
+        for sub in self.sublayers.values():
+            out.extend(sub.param_tensors())
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Concrete layers
+# ---------------------------------------------------------------------------
+class Linear(Layer):
+    """Reference: `layer.Linear(num_output, bias=True)` — in features
+    inferred on first call; y = x W + b with W (in, out)."""
+
+    def __init__(self, num_output: int, bias: bool = True, name=None):
+        super().__init__(name)
+        self.num_output = num_output
+        self.bias = bias
+
+    def initialize(self, x: Tensor):
+        in_features = x.shape[-1]
+        w = Tensor((in_features, self.num_output), device=x.device)
+        initializer.he_uniform(w)
+        self.register_param("W", w)
+        if self.bias:
+            b = Tensor((self.num_output,), device=x.device)
+            b.set_value(0.0)
+            self.register_param("b", b)
+
+    def forward(self, x: Tensor):
+        y = autograd.matmul(x, self.W)
+        if self.bias:
+            y = autograd.add_bias(y, self.b, axis=0)
+        return y
+
+
+class Conv2d(Layer):
+    """Reference: `layer.Conv2d(nb_kernels, kernel_size, stride, padding,
+    dilation, group, bias)` — NCHW, in channels inferred."""
+
+    def __init__(self, nb_kernels: int, kernel_size, stride=1, padding=0,
+                 dilation=1, group=1, bias: bool = True, name=None):
+        super().__init__(name)
+        self.nb_kernels = nb_kernels
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.group = group
+        self.bias = bias
+
+    def initialize(self, x: Tensor):
+        in_channels = x.shape[1]
+        self.handle = native.ConvHandle(
+            in_channels, self.nb_kernels, self.kernel_size,
+            stride=self.stride, padding=self.padding,
+            dilation=self.dilation, groups=self.group, bias=self.bias,
+        )
+        kh, kw = self.handle.kernel_size
+        w = Tensor((self.nb_kernels, in_channels // self.group, kh, kw),
+                   device=x.device)
+        initializer.he_uniform(w)
+        self.register_param("W", w)
+        if self.bias:
+            b = Tensor((self.nb_kernels,), device=x.device)
+            b.set_value(0.0)
+            self.register_param("b", b)
+
+    def forward(self, x: Tensor):
+        if self.bias:
+            return autograd.conv2d(self.handle, x, self.W, self.b)
+        return autograd.conv2d(self.handle, x, self.W)
+
+
+class SeparableConv2d(Layer):
+    """Reference: `layer.SeparableConv2d` — depthwise + pointwise."""
+
+    def __init__(self, nb_kernels: int, kernel_size, stride=1, padding=0,
+                 bias: bool = False, name=None):
+        super().__init__(name)
+        self.depthwise = None  # built at init (needs in_channels)
+        self.nb_kernels = nb_kernels
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.bias = bias
+
+    def initialize(self, x: Tensor):
+        in_channels = x.shape[1]
+        self.depthwise = Conv2d(in_channels, self.kernel_size,
+                                stride=self.stride, padding=self.padding,
+                                group=in_channels, bias=self.bias)
+        self.pointwise = Conv2d(self.nb_kernels, 1, bias=self.bias)
+
+    def forward(self, x: Tensor):
+        return self.pointwise(self.depthwise(x))
+
+
+class BatchNorm2d(Layer):
+    """Reference: `layer.BatchNorm2d(momentum=0.9)`.
+
+    NOTE on momentum semantics: SINGA passes `momentum` to cuDNN as
+    exponentialAverageFactor, i.e. running = (1-m)*running + m*batch.
+    """
+
+    def __init__(self, momentum: float = 0.9, eps: float = 1e-5, name=None):
+        super().__init__(name)
+        self.momentum = momentum
+        self.eps = eps
+
+    def initialize(self, x: Tensor):
+        c = x.shape[1]
+        self.handle = native.BatchNormHandle(factor=self.momentum, eps=self.eps)
+        scale = Tensor((c,), device=x.device)
+        scale.set_value(1.0)
+        self.register_param("scale", scale)
+        bias = Tensor((c,), device=x.device)
+        bias.set_value(0.0)
+        self.register_param("bias", bias)
+        rm = Tensor((c,), device=x.device)
+        rm.set_value(0.0)
+        self.register_state("running_mean", rm)
+        rv = Tensor((c,), device=x.device)
+        rv.set_value(1.0)
+        self.register_state("running_var", rv)
+
+    def forward(self, x: Tensor):
+        op = autograd._BatchNorm2d(self.handle, self.running_mean,
+                                   self.running_var)
+        y = op(x, self.scale, self.bias)
+        if autograd.training and op.new_running_mean is not None:
+            # Rebind state (reference mutates in cuDNN); in graph mode
+            # these become traced outputs captured by Model.compile.
+            self.running_mean.data = op.new_running_mean
+            self.running_var.data = op.new_running_var
+        return y
+
+
+class Pooling2d(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, is_max=True,
+                 name=None):
+        super().__init__(name)
+        self.handle = native.PoolingHandle(kernel_size, stride=stride,
+                                           padding=padding, is_max=is_max)
+
+    def forward(self, x: Tensor):
+        return autograd.pooling_2d(self.handle, x)
+
+
+class MaxPool2d(Pooling2d):
+    """Reference: `layer.MaxPool2d`."""
+
+    def __init__(self, kernel_size, stride=None, padding=0, name=None):
+        super().__init__(kernel_size, stride, padding, is_max=True, name=name)
+
+
+class AvgPool2d(Pooling2d):
+    """Reference: `layer.AvgPool2d`."""
+
+    def __init__(self, kernel_size, stride=None, padding=0, name=None):
+        super().__init__(kernel_size, stride, padding, is_max=False, name=name)
+
+
+class Dropout(Layer):
+    """Reference: `layer.Dropout(ratio)`."""
+
+    def __init__(self, ratio: float = 0.5, name=None):
+        super().__init__(name)
+        self.ratio = ratio
+
+    def forward(self, x: Tensor):
+        # Key comes from the *input's* device each call (never cached:
+        # params may migrate after a host-side init forward).
+        key = (x.device.next_key()
+               if autograd.training and self.ratio > 0.0 else None)
+        return autograd.Dropout(self.ratio, rng_key=key)(x)
+
+
+class Flatten(Layer):
+    """Reference: `layer.Flatten(axis=1)`."""
+
+    def __init__(self, axis: int = 1, name=None):
+        super().__init__(name)
+        self.axis = axis
+
+    def forward(self, x: Tensor):
+        return autograd.flatten(x, self.axis)
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return autograd.relu(x)
+
+
+class Sigmoid(Layer):
+    def forward(self, x):
+        return autograd.sigmoid(x)
+
+
+class Tanh(Layer):
+    def forward(self, x):
+        return autograd.tanh(x)
+
+
+class Softmax(Layer):
+    def __init__(self, axis: int = 1, name=None):
+        super().__init__(name)
+        self.axis = axis
+
+    def forward(self, x):
+        return autograd.softmax(x, self.axis)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope: float = 0.01, name=None):
+        super().__init__(name)
+        self.a = negative_slope
+
+    def forward(self, x):
+        return autograd.LeakyRelu(self.a)(x)
+
+
+class Gelu(Layer):
+    def forward(self, x):
+        return autograd.Gelu()(x)
+
+
+class Cat(Layer):
+    """Reference: `layer.Cat(axis)`."""
+
+    def __init__(self, axis: int = 0, name=None):
+        super().__init__(name)
+        self.axis = axis
+
+    def forward(self, *xs):
+        return autograd.cat(list(xs), self.axis)
+
+
+class Embedding(Layer):
+    """Reference: `layer.Embedding(input_dim, output_dim)` — lookup
+    table, rows selected by int indices."""
+
+    def __init__(self, input_dim: int, output_dim: int, name=None):
+        super().__init__(name)
+        self.input_dim = input_dim
+        self.output_dim = output_dim
+
+    def initialize(self, x: Tensor):
+        w = Tensor((self.input_dim, self.output_dim), device=x.device)
+        initializer.gaussian(w, 0.0, 0.05)
+        self.register_param("W", w)
+
+    def forward(self, x: Tensor):
+        return autograd.embedding(self.W, x)
+
+
+class LayerNorm(Layer):
+    """LayerNorm over the trailing dim; params gamma/beta (lazy)."""
+
+    def __init__(self, eps: float = 1e-5, name=None):
+        super().__init__(name)
+        self.eps = eps
+
+    def initialize(self, x: Tensor):
+        d = x.shape[-1]
+        g = Tensor((d,), device=x.device)
+        b = Tensor((d,), device=x.device)
+        initializer.constant(g, 1.0)
+        initializer.constant(b, 0.0)
+        self.register_param("gamma", g)
+        self.register_param("beta", b)
+
+    def forward(self, x: Tensor):
+        return autograd.layer_norm(x, self.gamma, self.beta, self.eps)
+
+
+class MultiHeadAttention(Layer):
+    """Multi-head self-attention (no reference equivalent — SINGA's
+    attention models arrive only via ONNX import). TPU-first: per-head
+    projections stay one fused GEMM on the MXU; with `mesh` carrying a
+    "seq" axis the score/softmax/value core runs as ring attention
+    (sequence parallelism), and the q/k/v/o projections pick up tensor
+    parallelism from the param sharding rules ("model" axis)."""
+
+    def __init__(self, num_heads: int, causal: bool = True, mesh=None,
+                 dropout: float = 0.0, name=None):
+        super().__init__(name)
+        self.num_heads = num_heads
+        self.causal = causal
+        self.mesh = mesh
+        self.q_proj = Linear(0)  # lazy: sized to d_model on first call
+        self.k_proj = Linear(0)
+        self.v_proj = Linear(0)
+        self.o_proj = Linear(0)
+        self.drop = Dropout(dropout) if dropout else None
+
+    def initialize(self, x: Tensor):
+        d_model = x.shape[-1]
+        if d_model % self.num_heads:
+            raise ValueError(
+                f"d_model {d_model} not divisible by heads {self.num_heads}")
+        for proj in (self.q_proj, self.k_proj, self.v_proj, self.o_proj):
+            proj.num_output = d_model
+
+    def forward(self, x: Tensor):
+        B, S, E = x.shape
+        H = self.num_heads
+        D = E // H
+
+        def split(t):  # [B,S,E] -> [B,H,S,D]
+            t = autograd.reshape(t, (B, S, H, D))
+            return autograd.transpose(t, (0, 2, 1, 3))
+
+        q = split(self.q_proj(x))
+        k = split(self.k_proj(x))
+        v = split(self.v_proj(x))
+        o = autograd.attention(q, k, v, causal=self.causal, mesh=self.mesh)
+        o = autograd.transpose(o, (0, 2, 1, 3))
+        o = autograd.reshape(o, (B, S, E))
+        o = self.o_proj(o)
+        return self.drop(o) if self.drop is not None else o
+
+
+class Sequential(Layer):
+    """Convenience container (reference builds these ad hoc)."""
+
+    def __init__(self, *layers, name=None):
+        super().__init__(name)
+        for i, l in enumerate(layers):
+            setattr(self, f"l{i}", l)
+        self._seq = list(layers)
+
+    def forward(self, x):
+        for l in self._seq:
+            x = l(x)
+        return x

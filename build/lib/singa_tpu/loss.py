@@ -1,0 +1,68 @@
+"""Loss classes.
+
+Reference parity: `python/singa/loss.py` — `Loss` base with
+`forward/backward/evaluate`, `SoftmaxCrossEntropy`, `SquaredError`
+(SURVEY.md §2.2 P9). In the reference these predate autograd and
+compute explicit forward/backward; here they are thin stateful wrappers
+over the differentiable autograd ops, so `backward()` comes for free
+and the classes stay graph-mode (jit) compatible.
+"""
+from __future__ import annotations
+
+from . import autograd
+from .tensor import Tensor
+
+
+class Loss:
+    """Reference: `loss.Loss`."""
+
+    def forward(self, x: Tensor, t: Tensor) -> Tensor:
+        raise NotImplementedError
+
+    def __call__(self, x: Tensor, t: Tensor) -> Tensor:
+        return self.forward(x, t)
+
+    def backward(self) -> Tensor:
+        """Gradient of the last forward()'s loss w.r.t. its input."""
+        if getattr(self, "_last", None) is None:
+            raise RuntimeError("call forward() before backward()")
+        x, l = self._last
+        old = x.stores_grad
+        x.stores_grad = True  # the walk only emits stores_grad tensors
+        try:
+            return autograd.gradients(l)[x]
+        finally:
+            x.stores_grad = old
+
+    def evaluate(self, flag, x: Tensor, t: Tensor) -> float:
+        """Average loss value over the batch (reference signature keeps
+        a train/eval flag; losses are flag-independent here)."""
+        return float(self.forward(x, t).to_numpy())
+
+
+class SoftmaxCrossEntropy(Loss):
+    """Reference: `loss.SoftmaxCrossEntropy` — fused softmax + CE over
+    int labels or one-hot/probability targets."""
+
+    def forward(self, x: Tensor, t: Tensor) -> Tensor:
+        x.requires_grad = True
+        l = autograd.softmax_cross_entropy(x, t)
+        self._last = (x, l)
+        return l
+
+
+class SquaredError(Loss):
+    """Reference: `loss.SquaredError` — batch mean of 0.5*||x - t||^2.
+
+    `autograd.mse_loss` already computes sum((x-t)^2)/(2*batch)
+    (autograd.py MeanSquareError), i.e. the 0.5 factor is built in, so
+    it is returned as-is."""
+
+    def forward(self, x: Tensor, t: Tensor) -> Tensor:
+        x.requires_grad = True
+        l = autograd.mse_loss(x, t)
+        self._last = (x, l)
+        return l
+
+
+MeanSquareError = SquaredError

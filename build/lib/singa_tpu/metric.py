@@ -1,0 +1,74 @@
+"""Evaluation metrics.
+
+Reference parity: `python/singa/metric.py` — `Metric` base with
+`forward/evaluate`, `Accuracy` (top-k), `Precision`, `Recall`
+(SURVEY.md §2.2 P9). Computation happens on-device via jnp and reduces
+to a host scalar only at `evaluate`.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .tensor import Tensor
+
+
+def _arr(x):
+    return x.data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class Metric:
+    """Reference: `metric.Metric`."""
+
+    def forward(self, x, y):
+        """Per-sample metric values (device array)."""
+        raise NotImplementedError
+
+    def evaluate(self, x, y) -> float:
+        """Batch-averaged metric as a host float."""
+        return float(jnp.mean(self.forward(x, y)))
+
+    def __call__(self, x, y) -> float:
+        return self.evaluate(x, y)
+
+
+class Accuracy(Metric):
+    """Reference: `metric.Accuracy(top_k)` — fraction of samples whose
+    true label is within the top-k predictions."""
+
+    def __init__(self, top_k: int = 1):
+        self.top_k = int(top_k)
+
+    def forward(self, x, y):
+        logits, labels = _arr(x), _arr(y)
+        if labels.ndim == logits.ndim:  # one-hot → index
+            labels = jnp.argmax(labels, axis=-1)
+        if self.top_k == 1:
+            return (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32)
+        _, topk = jax.lax.top_k(logits, self.top_k)
+        return jnp.any(topk == labels[..., None], axis=-1).astype(jnp.float32)
+
+
+class Precision(Metric):
+    """Binary precision at threshold 0.5 over probabilities/logits>0."""
+
+    def __init__(self, threshold: float = 0.5):
+        self.threshold = threshold
+
+    def evaluate(self, x, y) -> float:
+        pred = np.asarray(_arr(x)) > self.threshold
+        true = np.asarray(_arr(y)) > 0.5
+        tp = np.logical_and(pred, true).sum()
+        return float(tp / np.maximum(pred.sum(), 1))
+
+
+class Recall(Metric):
+    def __init__(self, threshold: float = 0.5):
+        self.threshold = threshold
+
+    def evaluate(self, x, y) -> float:
+        pred = np.asarray(_arr(x)) > self.threshold
+        true = np.asarray(_arr(y)) > 0.5
+        tp = np.logical_and(pred, true).sum()
+        return float(tp / np.maximum(true.sum(), 1))

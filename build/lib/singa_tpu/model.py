@@ -1,0 +1,707 @@
+"""Model: the user-facing training class.
+
+Reference parity: `python/singa/model.py` — `Model(Layer)` with
+`compile(inputs, is_train, use_graph, sequential)`, user-overridden
+`forward` and `train_one_batch`, `train()/eval()` flags,
+`save_states/load_states` (zip of npz + aux meta), `set_optimizer`.
+
+TPU-native graph mode: the reference's `compile(use_graph=True)` runs
+one traced forward/backward with `Device::EnableGraph(true)`, then
+replays `Graph::Run()` each step (SURVEY.md §1). Here the same
+user-level contract lowers to ONE `jax.jit`-compiled XLA program per
+step: `compile` traces `train_one_batch` with params / layer states /
+optimizer state / RNG key bound to jit tracers, captures their updated
+values as program outputs, and replays the compiled executable each
+call with buffer donation (XLA aliases param memory — the reference's
+in-place Block mutation, done the immutable way).
+
+Eager mode (`use_graph=False`) runs the identical Python code per-op —
+the graph-vs-eager loss parity test is the key invariant kept from the
+reference (`test/python/test_model.py`).
+"""
+from __future__ import annotations
+
+import io
+import json
+import os
+import time
+import zipfile
+from collections import OrderedDict
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+from . import autograd, tensor as tensor_mod
+from .layer import Layer
+from .tensor import Tensor
+
+
+class Model(Layer):
+    """Reference: `model.Model`."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._optimizer = None
+        self._jit_step = None
+        self._jit_fwd = None
+        self._use_graph = False
+        self._mesh = self._rules = self._batch_specs = None
+        self.training = True
+
+    # -- configuration -----------------------------------------------------
+    def set_optimizer(self, optimizer):
+        self._optimizer = optimizer
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def compile(self, inputs: List[Tensor], is_train: bool = True,
+                use_graph: bool = False, sequential: bool = False,
+                mesh=None, rules=None, batch_specs=None):
+        """Reference: `Model.compile` — one tracing pass to initialize
+        params (lazy shape inference), then optionally arm graph mode.
+
+        `sequential` is accepted for API parity (the reference uses it
+        to serialize graph exec; XLA owns scheduling here).
+
+        Mesh mode (TPU-native, no reference equivalent): passing a
+        `jax.sharding.Mesh` turns the compiled step into one SPMD
+        program over the mesh — params laid out by `rules`
+        (`parallel.ShardingRules`), batch dims sharded over the "data"
+        axis (`batch_specs` overrides per-input), gradients reduced by
+        XLA over ICI. This subsumes DistOpt: same math, one program.
+        """
+        self.train(is_train)
+        dev = inputs[0].device if inputs else None
+        if dev is not None:
+            dev.EnableGraph(use_graph)
+        # One forward initializes all lazy params. Running it eagerly
+        # dispatches hundreds of one-op XLA programs (each separately
+        # compiled — 100-330 s for ResNet-50, scaling with batch); so
+        # by default it runs as ONE jitted program on the host XLA CPU
+        # backend at batch 1 (lazy init only reads feature dims), and
+        # the created params migrate to `dev`. Threefry RNG is
+        # backend-deterministic, so init values are identical either
+        # way. Falls back to the eager path if the trace fails (e.g. a
+        # custom initialize() that inspects concrete values).
+        if inputs and not self.param_tensors():
+            if not self._jit_init_forward(inputs, dev):
+                self._host_init_forward(inputs, dev)
+        elif inputs:
+            # Params already exist (a forward ran before compile):
+            # run the tracing forward in place.
+            self.forward(*inputs)
+        self._use_graph = use_graph or mesh is not None
+        self._mesh, self._rules, self._batch_specs = mesh, rules, batch_specs
+        self._jit_step = None  # (re)built lazily on first train_one_batch
+        self._jit_fwd = None
+        if dev is not None:
+            dev.EnableGraph(False)
+
+    def _jit_init_forward(self, inputs, dev) -> bool:
+        """Run the lazy-param-init forward as ONE jitted XLA program on
+        the host CPU backend, then migrate created params/states to
+        `dev`. Returns False (leaving the model untouched) if the init
+        forward is not trace-safe, so `compile` can fall back to the
+        eager `_host_init_forward`.
+
+        Inputs are sliced to batch 1 (leading dim) — lazy `initialize`
+        only reads feature dims — so init cost is independent of batch
+        size; set SINGA_TPU_INIT_FULL_BATCH=1 for models whose forward
+        bakes in the batch dim. The device RNG key is threaded through
+        the program per `next_key` call, so init values and the
+        post-init key state match the eager path bit-for-bit.
+        """
+        from .device import get_default_device
+
+        cpu = get_default_device()
+        full = os.environ.get("SINGA_TPU_INIT_FULL_BATCH", "0") == "1"
+        arrays = []
+        for t in inputs:
+            arr = t.data
+            if not getattr(arr, "is_fully_addressable", True):
+                arr = arr.addressable_shards[0].data
+            arr = np.asarray(arr)
+            if not full and arr.ndim >= 1 and arr.shape[0] > 1:
+                arr = arr[:1]
+            arrays.append(arr)
+        borrow = dev is not None and dev is not cpu
+        key0 = jax.device_put(
+            np.asarray(dev._rng_key if borrow else cpu._rng_key),
+            cpu.jax_device)
+        snap = _lazy_snapshot(self)
+        created = {}
+
+        def init_fn(key, batch):
+            saved_key = cpu._rng_key
+            cpu._rng_key = key
+            try:
+                xs = [tensor_mod.from_raw(b, cpu) for b in batch]
+                self.forward(*xs)
+                created["params"] = self.param_tensors()
+                created["states"] = self.state_tensors()
+                return ([p.data for p in created["params"]],
+                        [s.data for s in created["states"]],
+                        cpu._rng_key)
+            finally:
+                cpu._rng_key = saved_key
+
+        try:
+            pvals, svals, new_key = jax.jit(init_fn)(key0, tuple(arrays))
+        except Exception as e:
+            import sys
+
+            print(f"singa_tpu: jitted init forward failed "
+                  f"({type(e).__name__}: {e}); falling back to eager "
+                  f"init (try SINGA_TPU_INIT_FULL_BATCH=1 if the model "
+                  f"bakes in the batch dim)", file=sys.stderr)
+            _lazy_restore(self, snap)
+            return False
+        for p, v in zip(created["params"], pvals):
+            p.data = v
+            p.device = cpu
+        for s, v in zip(created["states"], svals):
+            s.data = v
+            s.device = cpu
+        if borrow:
+            dev._rng_key = jax.device_put(new_key, dev.jax_device)
+        else:
+            cpu._rng_key = jax.device_put(new_key, cpu.jax_device)
+        if dev is not None and dev is not cpu:
+            for t in self.param_tensors() + self.state_tensors():
+                t.to_device(dev)
+        return True
+
+    def _host_init_forward(self, inputs, dev):
+        """Run the param-init forward on host CPU, borrowing `dev`'s RNG
+        stream so `dev.SetRandSeed(...)` still governs init values, then
+        move every created param/state onto `dev`.
+
+        Multi-controller inputs (global arrays spanning processes) are
+        replaced by their local shard for this pass — lazy init only
+        reads feature dims, which batch shardings leave whole.
+
+        Uses the same batch-1 slicing policy as `_jit_init_forward` so
+        the two init paths leave identical model state (params by RNG
+        determinism; BN running stats because both see the same slice).
+        """
+        from .device import get_default_device
+
+        cpu = get_default_device()
+        full = os.environ.get("SINGA_TPU_INIT_FULL_BATCH", "0") == "1"
+        borrow = dev is not None and dev is not cpu
+        if borrow:
+            saved_cpu_key = cpu._rng_key
+            cpu._rng_key = jax.device_put(dev._rng_key, cpu.jax_device)
+        try:
+            host_inputs = []
+            for t in inputs:
+                arr = t.data
+                if not getattr(arr, "is_fully_addressable", True):
+                    arr = arr.addressable_shards[0].data
+                arr = np.asarray(arr)
+                if not full and arr.ndim >= 1 and arr.shape[0] > 1:
+                    arr = arr[:1]
+                h = t.clone()
+                h.data = jax.device_put(arr, cpu.jax_device)
+                h.device = cpu
+                host_inputs.append(h)
+            self.forward(*host_inputs)
+        finally:
+            if borrow:
+                dev._rng_key = jax.device_put(cpu._rng_key, dev.jax_device)
+                cpu._rng_key = saved_cpu_key
+        if dev is not None and dev is not cpu:
+            for t in self.param_tensors() + self.state_tensors():
+                t.to_device(dev)
+
+    def train(self, mode: bool = True):
+        self.training = mode
+        autograd.training = mode
+
+    def eval(self):
+        self.train(False)
+
+    # -- user-overridable --------------------------------------------------
+    def forward(self, *xs):
+        raise NotImplementedError
+
+    def loss(self, out, ty):
+        """Default loss hook; user models commonly override
+        train_one_batch wholesale (reference examples do)."""
+        return autograd.softmax_cross_entropy(out, ty)
+
+    def optim(self, loss):
+        return self._optimizer.backward_and_update(loss)
+
+    def train_one_batch(self, x: Tensor, y: Tensor):
+        if self._optimizer is None:
+            raise RuntimeError(
+                "train_one_batch requires an optimizer: call "
+                "model.set_optimizer(...) before training"
+            )
+        out = self.forward(x)
+        l = self.loss(out, y)
+        self.optim(l)
+        return out, l
+
+    def __call__(self, *args, **kwargs):
+        """Reference: `Model.__call__` routes to `train_one_batch` in
+        train mode (graph replay when compiled with use_graph) and to
+        `forward` in eval mode."""
+        if self.training and (self._optimizer is not None or len(args) > 1):
+            return self.train_one_batch_dispatch(*args, **kwargs)
+        if self._use_graph and not kwargs:
+            return self.forward_graph(*args)
+        return self.forward(*args, **kwargs)
+
+    # -- graph (jit) execution --------------------------------------------
+    def train_one_batch_graph(self, *batch: Tensor):
+        """Run `train_one_batch` as one compiled XLA program.
+
+        Called automatically by `train_one_batch_dispatch`; also public
+        for direct use. First call traces+compiles; subsequent calls
+        replay with donated buffers.
+        """
+        if self._jit_step is None:
+            if getattr(self, "_mesh", None) is not None:
+                from .parallel.trainer import ShardedJitStep
+
+                self._jit_step = ShardedJitStep(
+                    self, self._mesh, rules=self._rules,
+                    batch_specs=self._batch_specs)
+            else:
+                self._jit_step = _JitStep(self)
+        return self._jit_step(*batch)
+
+    def train_one_batch_dispatch(self, *batch: Tensor):
+        if self._use_graph:
+            return self.train_one_batch_graph(*batch)
+        return self.train_one_batch(*batch)
+
+    def forward_graph(self, *xs: Tensor):
+        """Run `forward` as one compiled XLA program (the eval-path
+        analogue of `train_one_batch_graph`; reference eval replays the
+        same buffered Graph)."""
+        if self._jit_fwd is None:
+            self._jit_fwd = _JitForward(self)
+        return self._jit_fwd(*xs)
+
+    # -- checkpoint --------------------------------------------------------
+    def save_states(self, fpath: str, aux_states: Optional[Dict] = None):
+        """Reference: `Model.save_states` — zipfile of per-tensor npz
+        plus a json meta blob with aux states."""
+        model_states = self.get_states()
+        states = {k: v.to_numpy() for k, v in model_states.items()}
+        aux = aux_states or {}
+        opt_meta = {}
+        if self._optimizer is not None:
+            opt_meta["step_counter"] = int(self._optimizer.step_counter)
+            # Optimizer slots are keyed by id(param) in-memory; persist
+            # them by param NAME so they survive into a fresh process.
+            name_of = {id(t): n for n, t in model_states.items()}
+            for pid, slots in self._optimizer.states.items():
+                pname = name_of.get(pid)
+                if pname is None:
+                    continue
+                for slot, arr in slots.items():
+                    states[f"__opt__/{pname}/{slot}"] = np.asarray(arr)
+        with zipfile.ZipFile(fpath, "w") as zf:
+            for name, arr in states.items():
+                buf = io.BytesIO()
+                np.save(buf, arr)
+                zf.writestr(name.replace("/", "__SLASH__") + ".npy", buf.getvalue())
+            zf.writestr(
+                "__meta__.json",
+                json.dumps({"aux": _jsonable(aux), "opt": opt_meta,
+                            "names": list(states.keys())}),
+            )
+
+    def load_states(self, fpath: str) -> Dict:
+        """Reference: `Model.load_states`. Returns aux states dict."""
+        with zipfile.ZipFile(fpath, "r") as zf:
+            meta = json.loads(zf.read("__meta__.json"))
+            arrays = {}
+            for name in meta["names"]:
+                raw = zf.read(name.replace("/", "__SLASH__") + ".npy")
+                arrays[name] = np.load(io.BytesIO(raw))
+        model_states = {k: v for k, v in arrays.items()
+                        if not k.startswith("__opt__/")}
+        self.set_states(model_states)
+        if self._optimizer is not None and meta.get("opt"):
+            import jax.numpy as jnp
+
+            self._optimizer.step_counter = meta["opt"].get("step_counter", 0)
+            tensor_of = self.get_states()
+            for key, arr in arrays.items():
+                if not key.startswith("__opt__/"):
+                    continue
+                _, pname, slot = key.split("/", 2)
+                t = tensor_of.get(pname)
+                if t is not None:
+                    self._optimizer.states.setdefault(id(t), {})[slot] = jnp.asarray(arr)
+        self._jit_step = None  # state changed: force retrace
+        self._jit_fwd = None
+        return meta.get("aux", {})
+
+
+def _lazy_snapshot(root: Layer):
+    """Record every layer's lazy-init state (for rollback if a traced
+    init forward fails midway, leaving tracer-valued params behind)."""
+    recs = []
+    stack = [root]
+    while stack:
+        l = stack.pop()
+        recs.append((l, l._initialized,
+                     OrderedDict(l.__dict__.get("_params", ())),
+                     list(l.__dict__.get("_state_attrs", ())),
+                     set(l.sublayers.keys())))
+        stack.extend(l.sublayers.values())
+    return recs
+
+
+def _lazy_restore(root: Layer, recs):
+    for l, inited, params, state_attrs, subkeys in recs:
+        l._initialized = inited
+        l.__dict__["_params"] = OrderedDict(params)
+        l.__dict__["_state_attrs"] = list(state_attrs)
+        subs = l.__dict__.get("_sublayers")
+        if subs is not None:
+            for k in [k for k in subs if k not in subkeys]:
+                del subs[k]
+
+
+def _jsonable(d):
+    out = {}
+    for k, v in d.items():
+        if isinstance(v, (int, float, str, bool, list, dict, type(None))):
+            out[k] = v
+        else:
+            out[k] = float(v) if np.isscalar(v) else np.asarray(v).tolist()
+    return out
+
+
+@contextmanager
+def _bound_model(params, states, dev, pvals, svals, key):
+    """Bind tracer/program values onto the live param/state tensors and
+    the device RNG key for the duration of a traced call, restoring the
+    concrete arrays afterwards. The shared functionalization core of
+    `_JitStep` and `_JitForward`."""
+    saved_p = [p.data for p in params]
+    saved_s = [s.data for s in states]
+    saved_key = dev._rng_key
+    try:
+        for p, v in zip(params, pvals):
+            p.data = v
+        for s, v in zip(states, svals):
+            s.data = v
+        dev._rng_key = key
+        yield
+    finally:
+        for p, v in zip(params, saved_p):
+            p.data = v
+        for s, v in zip(states, saved_s):
+            s.data = v
+        dev._rng_key = saved_key
+
+
+def _unwrap_out(out):
+    return jax.tree_util.tree_map(
+        lambda t: t.data if isinstance(t, Tensor) else t,
+        out,
+        is_leaf=lambda t: isinstance(t, Tensor),
+    )
+
+
+class _JitForward:
+    """Compiles `model.forward` into one XLA program (inference path).
+
+    Same functionalization trick as `_JitStep` (via `_bound_model`),
+    minus optimizer state and buffer donation (params are read-only
+    here). The device RNG key is threaded through so eval-time
+    stochastic ops stay reproducible. Layer-state updates made during a
+    training-mode forward (BN running stats) are captured as program
+    outputs and written back.
+
+    Compiled executables are cached per (training-flag, non-Tensor
+    args): the train/eval flag changes the traced program (dropout on /
+    off), and plain-Python positional args are baked in as statics, not
+    traced.
+
+    Mesh mode: when the model was compiled over a mesh, inputs are laid
+    out to match — params by the model's `ShardingRules`, states/key
+    replicated, batch dims sharded — so the sharded train path and this
+    eval path never mix incompatible device commitments.
+    """
+
+    def __init__(self, model: "Model"):
+        self.model = model
+        self.params: List[Tensor] = model.param_tensors()
+        self.states: List[Tensor] = model.state_tensors()
+        self._compiled: Dict = {}
+
+    def _device(self):
+        if self.params:
+            return self.params[0].device
+        from .device import get_default_device
+
+        return get_default_device()
+
+    def _build(self, tensor_pos, statics, nargs):
+        model, params, states = self.model, self.params, self.states
+
+        def fwd_fn(pvals, svals, key, batch):
+            dev = self._device()
+            with _bound_model(params, states, dev, pvals, svals, key):
+                args = [None] * nargs
+                for i, b in zip(tensor_pos, batch):
+                    args[i] = tensor_mod.from_raw(b, dev)
+                it = iter(statics)
+                for i in range(nargs):
+                    if args[i] is None:
+                        args[i] = next(it)
+                out_arrays = _unwrap_out(model.forward(*args))
+                new_s = [s.data for s in states]
+                return out_arrays, new_s, dev._rng_key
+
+        return jax.jit(fwd_fn)
+
+    def _place_inputs(self, pvals, svals, key, batch_arrays):
+        """Mesh-mode placement (single-device: identity)."""
+        mesh = getattr(self.model, "_mesh", None)
+        if mesh is None:
+            return pvals, svals, key, batch_arrays
+        from jax.sharding import NamedSharding
+
+        from .parallel.sharding import (
+            ShardingRules,
+            batch_sharding,
+            replicated,
+        )
+
+        rules = getattr(self.model, "_rules", None) or ShardingRules()
+        name_of = {id(t): n for n, t in self.model.get_params().items()}
+        pvals = [
+            jax.device_put(
+                v, rules.sharding_for(mesh, name_of.get(id(p), ""),
+                                      p.data.shape))
+            for p, v in zip(self.params, pvals)
+        ]
+        rep = replicated(mesh)
+        svals = [jax.device_put(v, rep) for v in svals]
+        key = jax.device_put(key, rep)
+        specs = getattr(self.model, "_batch_specs", None)
+        if specs is not None:
+            shs = [NamedSharding(mesh, s) for s in specs]
+        else:
+            shs = [batch_sharding(mesh, getattr(b, "ndim", 0))
+                   for b in batch_arrays]
+        batch_arrays = tuple(
+            jax.device_put(b, s) for b, s in zip(batch_arrays, shs)
+        )
+        return pvals, svals, key, batch_arrays
+
+    def __call__(self, *xs):
+        tensor_pos = tuple(i for i, x in enumerate(xs)
+                           if isinstance(x, Tensor))
+        statics = tuple(x for x in xs if not isinstance(x, Tensor))
+        batch_arrays = tuple(xs[i].data for i in tensor_pos)
+        try:
+            cache_key = (self.model.training, tensor_pos, statics)
+            fn = self._compiled.get(cache_key)
+        except TypeError:  # unhashable static arg: compile fresh
+            cache_key, fn = None, None
+        if fn is None:
+            fn = self._build(tensor_pos, statics, len(xs))
+            if cache_key is not None:
+                self._compiled[cache_key] = fn
+        dev = self._device()
+        pvals, svals, key, batch_arrays = self._place_inputs(
+            [p.data for p in self.params],
+            [s.data for s in self.states],
+            dev._rng_key, batch_arrays,
+        )
+        out, new_s, new_key = fn(pvals, svals, key, batch_arrays)
+        if self.model.training:
+            for s, v in zip(self.states, new_s):
+                s.data = v
+        # Pin the advanced key back onto the device's own placement so
+        # later eager code stays single-device even when params are
+        # mesh-sharded (cf. _JitStep._restore_key).
+        dev._rng_key = jax.device_put(new_key, dev.jax_device)
+        return jax.tree_util.tree_map(
+            lambda a: tensor_mod.from_raw(a, dev), out
+        )
+
+
+class _JitStep:
+    """Compiles `model.train_one_batch` into a single XLA program.
+
+    The functionalization trick: params, layer states (BN running
+    stats), optimizer slots, and the device RNG key are *bound* to jit
+    tracers before calling the user's Python `train_one_batch`, and
+    their post-step values are collected as program outputs. Outside
+    the trace, concrete arrays round-trip through the compiled
+    executable with `donate_argnums` so XLA reuses the param HBM —
+    the TPU equivalent of the reference scheduler's in-place Block
+    update + memory reuse pass (src/core/scheduler/scheduler.cc).
+    """
+
+    def __init__(self, model: Model):
+        self.model = model
+        self.params: List[Tensor] = model.param_tensors()
+        self.states: List[Tensor] = model.state_tensors()
+        self.opt = model._optimizer
+        self._compiled = None
+        self._hlo_rows = None  # graph-profile cache (hlo_profile.py)
+
+    # ---- optimizer state flattening -------------------------------------
+    def _opt_arrays(self):
+        return [] if self.opt is None else list(self.opt.state_arrays())
+
+    def _bind_opt_arrays(self, arrays):
+        if self.opt is not None:
+            self.opt.set_state_arrays(list(arrays))
+
+    def _device(self):
+        if self.params:
+            return self.params[0].device
+        from .device import get_default_device
+
+        return get_default_device()
+
+    def _build(self, *batch_arrays):
+        model, opt = self.model, self.opt
+        params, states = self.params, self.states
+
+        def step_fn(pvals, svals, ovals, key, step_counter, batch):
+            saved_o = self._opt_arrays()
+            dev = self._device()
+            saved_step = None if opt is None else opt.step_counter
+            with _bound_model(params, states, dev, pvals, svals, key):
+                try:
+                    self._bind_opt_arrays(ovals)
+                    if opt is not None:
+                        opt.step_counter = step_counter
+                    batch_t = [tensor_mod.from_raw(b, dev) for b in batch]
+                    out_arrays = _unwrap_out(model.train_one_batch(*batch_t))
+                    new_p = [p.data for p in params]
+                    new_s = [s.data for s in states]
+                    new_o = self._opt_arrays()
+                    new_key = dev._rng_key
+                    return out_arrays, new_p, new_s, new_o, new_key
+                finally:
+                    self._bind_opt_arrays(saved_o)
+                    if opt is not None and saved_step is not None:
+                        opt.step_counter = saved_step
+
+        # Pre-create optimizer slots so the jit signature (flattened
+        # opt state) is stable from step one. step_counter is traced
+        # (not static) so LR schedules don't retrigger compilation.
+        self._ensure_opt_slots()
+        return jax.jit(step_fn, donate_argnums=(0, 1, 2, 3),
+                       **self._jit_kwargs(batch_arrays))
+
+    def _jit_kwargs(self, batch_arrays):
+        """Hook for sharded subclasses (parallel.trainer.ShardedJitStep)
+        to add in/out shardings over a mesh."""
+        return {}
+
+    def _prepare_inputs(self, pvals, svals, ovals, key, batch_arrays):
+        """Hook: place program inputs (sharded subclasses device_put
+        onto the mesh; identity on one device)."""
+        return pvals, svals, ovals, key, batch_arrays
+
+    def _restore_key(self, new_key, dev):
+        """Hook: the updated RNG key's placement. Sharded subclasses
+        bring it back to the device's own placement so later eager code
+        (fresh param init, dropout outside jit) stays single-device."""
+        return new_key
+
+    def _ensure_opt_slots(self):
+        """Create optimizer state slots with zero arrays so the jit
+        signature (flattened opt state) is stable from step one."""
+        import jax.numpy as jnp
+
+        if self.opt is None:
+            return
+        opt = self.opt
+        base = getattr(opt, "opt", opt)  # DistOpt wraps
+        from .opt import Adam, AdaGrad, RMSProp, SGD
+
+        for p in self.params:
+            st = base.states.setdefault(id(p), {})
+            if isinstance(base, SGD) and base.momentum and "momentum_buf" not in st:
+                # zero buf + buf=m*buf+(1-damp)*g reproduces the lazy
+                # first step (buf=g) exactly when dampening==0; with
+                # dampening>0 the first graph-mode step deviates by the
+                # dampening factor (documented limitation).
+                st["momentum_buf"] = jnp.zeros_like(p.data)
+            elif isinstance(base, RMSProp) and "running_avg" not in st:
+                st["running_avg"] = jnp.zeros_like(p.data)
+            elif isinstance(base, AdaGrad) and "history" not in st:
+                st["history"] = jnp.zeros_like(p.data)
+            elif isinstance(base, Adam):
+                st.setdefault("m", jnp.zeros_like(p.data))
+                st.setdefault("v", jnp.zeros_like(p.data))
+
+    def __call__(self, *batch: Tensor):
+        batch_arrays = tuple(
+            b.data if isinstance(b, Tensor) else b for b in batch
+        )
+        if self._compiled is None:
+            self._compiled = self._build(*batch_arrays)
+        dev = self._device()
+        opt = self.opt
+        pvals = [p.data for p in self.params]
+        svals = [s.data for s in self.states]
+        ovals = self._opt_arrays()
+        step = 0 if opt is None else opt.step_counter
+        pvals, svals, ovals, key, batch_arrays = self._prepare_inputs(
+            pvals, svals, ovals, dev._rng_key, batch_arrays
+        )
+        profiling = dev._verbosity > 0
+        if profiling and getattr(self, "_hlo_rows", None) is None:
+            # One extra lower+compile (shapes only — safe before the
+            # donating call below) yields the optimized HLO for the
+            # per-op cost table (hlo_profile.py).
+            try:
+                from . import hlo_profile
+
+                text = self._compiled.lower(
+                    pvals, svals, ovals, key, step, batch_arrays
+                ).compile().as_text()
+                self._hlo_rows = hlo_profile.profile_hlo(text)
+            except Exception:
+                self._hlo_rows = []
+        t0 = time.perf_counter() if profiling else 0.0
+        out, new_p, new_s, new_o, new_key = self._compiled(
+            pvals, svals, ovals, key, step, batch_arrays
+        )
+        if profiling:
+            jax.block_until_ready(new_key)
+            dt = time.perf_counter() - t0
+            dev.StepIteration()  # graph replay == one iteration (ref)
+            dev.RecordOpTime("train_one_batch[graph]", dt)
+            # Keyed per model so two compiled models on one device
+            # (e.g. a GAN's G and D) keep separate tables.
+            label = f"train_one_batch:{self.model.name or 'model'}" \
+                    f"@{id(self.model) & 0xffff:04x}"
+            prof = dev._graph_profiles.setdefault(
+                label, {"rows": self._hlo_rows or [], "step_s": dt})
+            prof["step_s"] = min(prof["step_s"], dt)
+            prof["rows"] = self._hlo_rows or []
+        for p, v in zip(self.params, new_p):
+            p.data = v
+        for s, v in zip(self.states, new_s):
+            s.data = v
+        self._bind_opt_arrays(new_o)
+        dev._rng_key = self._restore_key(new_key, dev)
+        if opt is not None:
+            opt.step_counter = step + 1
+        return jax.tree_util.tree_map(
+            lambda a: tensor_mod.from_raw(a, dev), out
+        )

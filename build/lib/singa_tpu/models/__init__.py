@@ -1,0 +1,9 @@
+"""singa_tpu.models — the built-in model zoo.
+
+Reference: `examples/cnn/model/*` + `examples/mlp` define the zoo
+in-tree per example; here the canonical definitions live in the
+package (examples wrap them) plus TPU-era additions (TransformerLM
+with ring attention / tensor parallelism).
+"""
+from . import transformer  # noqa: F401
+from .transformer import TransformerLM  # noqa: F401

@@ -1,0 +1,88 @@
+"""Decoder-only transformer LM — the multi-chip flagship.
+
+No reference equivalent (SINGA's only transformer is the SONNX-imported
+BERT, examples/onnx/bert); this model exists to exercise every
+parallelism axis natively:
+
+  * DP   — batch dim over "data" (mesh-mode `Model.compile`);
+  * TP   — q/k/v/o and MLP GEMMs sharded over "model" via the default
+           `parallel.ShardingRules` (Megatron-style column parallel);
+  * SP   — ring attention over "seq" (parallel/ring_attention.py):
+           sequence length scales with the number of chips;
+all inside one jit-ed train step where XLA inserts the ICI collectives.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import autograd, layer, model, tensor
+
+
+class TransformerBlock(layer.Layer):
+    """Pre-norm block: x + MHA(LN(x)); x + MLP(LN(x))."""
+
+    def __init__(self, num_heads: int, d_ff: int, causal: bool = True,
+                 mesh=None, dropout: float = 0.0, name=None):
+        super().__init__(name)
+        self.ln1 = layer.LayerNorm()
+        self.attn = layer.MultiHeadAttention(num_heads, causal=causal,
+                                             mesh=mesh, dropout=dropout)
+        self.ln2 = layer.LayerNorm()
+        self.fc1 = layer.Linear(d_ff)
+        self.act = layer.Gelu()
+        self.fc2 = layer.Linear(0)  # lazily sized to d_model
+        self.drop = layer.Dropout(dropout) if dropout else None
+
+    def initialize(self, x):
+        self.fc2.num_output = x.shape[-1]
+
+    def forward(self, x):
+        x = autograd.add(x, self.attn(self.ln1(x)))
+        h = self.fc2(self.act(self.fc1(self.ln2(x))))
+        if self.drop is not None:
+            h = self.drop(h)
+        return autograd.add(x, h)
+
+
+class TransformerLM(model.Model):
+    """Causal LM over int token ids [B, S] → logits [B, S, vocab]."""
+
+    def __init__(self, vocab_size: int, d_model: int = 256,
+                 num_heads: int = 8, num_layers: int = 4,
+                 d_ff: int | None = None, max_len: int = 1024,
+                 mesh=None, dropout: float = 0.0):
+        super().__init__()
+        d_ff = d_ff or 4 * d_model
+        self.vocab_size = vocab_size
+        self.max_len = max_len
+        self.embed = layer.Embedding(vocab_size, d_model)
+        self.pos_embed = layer.Embedding(max_len, d_model)
+        self.blocks = layer.Sequential(*[
+            TransformerBlock(num_heads, d_ff, causal=True, mesh=mesh,
+                             dropout=dropout)
+            for _ in range(num_layers)
+        ])
+        self.ln_f = layer.LayerNorm()
+        self.head = layer.Linear(vocab_size, bias=False)
+
+    def forward(self, x):
+        B, S = x.shape
+        pos = tensor.from_numpy(np.arange(S, dtype=np.int32))
+        if x.device is not None:
+            pos = pos.to_device(x.device)
+        h = autograd.add(self.embed(x), self.pos_embed(pos))
+        h = self.blocks(h)
+        h = self.ln_f(h)
+        return self.head(h)
+
+    def train_one_batch(self, x, y):
+        out = self.forward(x)                      # [B, S, V]
+        logits = autograd.reshape(out, (-1, self.vocab_size))
+        labels = autograd.reshape(y, (-1,))
+        loss = autograd.softmax_cross_entropy(logits, labels)
+        self._optimizer.backward_and_update(loss)
+        return out, loss
+
+
+def create_model(vocab_size=256, **kwargs):
+    return TransformerLM(vocab_size, **kwargs)

@@ -1,0 +1,23 @@
+"""Native op layer: handle structs + forward functions.
+
+Reference parity: `src/model/operation/{convolution,batchnorm,pooling,
+rnn}.{h,cc}` — the cuDNN/DNNL-backed layer SINGA's autograd calls
+through SWIG. The `*Handle` structs are retained (they carry the
+shape/algorithm metadata the reference caches) but the math re-lowers
+to XLA HLO: `ConvGeneralDilated` for conv, fused normalization ops for
+batchnorm, `ReduceWindow` for pooling, `lax.scan` for RNN/LSTM
+(`singa_tpu.ops.rnn`).
+
+All functions here are pure (jax array in → jax array out), so both
+eager execution and whole-step `jax.jit` tracing reuse them directly;
+gradients come from `jax.vjp` at the autograd layer.
+"""
+from .native import (  # noqa: F401
+    BatchNormHandle,
+    ConvHandle,
+    PoolingHandle,
+    batchnorm_inference,
+    batchnorm_training,
+    conv2d,
+    pooling,
+)

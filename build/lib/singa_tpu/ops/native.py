@@ -1,0 +1,290 @@
+"""Convolution / BatchNorm / Pooling over XLA HLO.
+
+Reference parity:
+  - `src/model/operation/convolution.{h,cc}`: `ConvHandle`,
+    `CudnnConvHandle`, `GpuConvForward/Backward{x,W,b}` → here one
+    `ConvHandle` + `conv2d` via `lax.conv_general_dilated` (backward
+    comes from `jax.vjp`, which XLA lowers to the transposed convs the
+    reference hand-dispatches to cuDNN algos).
+  - `src/model/operation/batchnorm.{h,cc}`: `BatchNormHandle`,
+    `GpuBatchNormForwardTraining/Inference/Backward` → fused-in-XLA
+    normalization; running-stat update semantics preserved
+    (running = (1-momentum)*running + momentum*batch, cuDNN-style
+    exponentialAverageFactor).
+  - `src/model/operation/pooling.{h,cc}`: `PoolingHandle`,
+    `GpuPoolingForward/Backward` max/avg → `lax.reduce_window`.
+
+Layout: NCHW at the API (reference layout); XLA relayouts for the MXU
+internally. Conv accumulates in fp32; input/filter dtype is whatever
+the caller passes (bf16 under mixed-precision policy).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_Pair = Union[int, Tuple[int, int]]
+
+
+def _pair(v: _Pair) -> Tuple[int, int]:
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+class ConvHandle:
+    """Shape/config metadata for a 2-d convolution.
+
+    Reference: `ConvHandle` / `CudnnConvHandle` (algo selection and
+    workspace fields dropped — XLA owns algorithm choice).
+    """
+
+    def __init__(
+        self,
+        in_channels: int,
+        out_channels: int,
+        kernel_size: _Pair,
+        stride: _Pair = 1,
+        padding: _Pair = 0,
+        dilation: _Pair = 1,
+        groups: int = 1,
+        bias: bool = True,
+    ):
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        self.kernel_size = _pair(kernel_size)
+        self.stride = _pair(stride)
+        self.padding = _pair(padding)
+        self.dilation = _pair(dilation)
+        self.groups = groups
+        self.bias = bias
+        if in_channels % groups or out_channels % groups:
+            raise ValueError(
+                f"channels ({in_channels}->{out_channels}) not divisible by groups={groups}"
+            )
+
+    def out_shape(self, h: int, w: int) -> Tuple[int, int]:
+        kh, kw = self.kernel_size
+        sh, sw = self.stride
+        ph, pw = self.padding
+        dh, dw = self.dilation
+        oh = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+        ow = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+        return oh, ow
+
+
+@partial(jax.jit, static_argnums=(0,), inline=True)
+def _conv2d_nobias(handle: ConvHandle, x, w):
+    ph, pw = handle.padding
+    # fp32 operands: force fp32 accumulation explicitly. bf16 (AMP):
+    # omit preferred_element_type — the MXU still accumulates fp32
+    # internally, and jax 0.9's conv transpose rule rejects mixed
+    # cotangent/operand dtypes when preferred != operand dtype.
+    pref = jnp.float32 if x.dtype == jnp.float32 else None
+    return lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=handle.stride,
+        padding=((ph, ph), (pw, pw)),
+        rhs_dilation=handle.dilation,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=handle.groups,
+        preferred_element_type=pref,
+    ).astype(x.dtype)
+
+
+def conv2d(handle: ConvHandle, x, w, b=None):
+    """Reference: `GpuConvForward(x, W, b, handle)`.
+
+    x: (N, C, H, W); w: (O, C/groups, kh, kw); b: (O,) or None.
+    Under the AMP policy (`tensor.set_compute_dtype`), operands cast to
+    bf16 at this boundary (fp32 MXU accumulation via
+    preferred_element_type) and the output stays bf16.
+    """
+    from .. import tensor as tensor_mod
+
+    x, w, b = tensor_mod.amp_cast(x, w, b)
+    y = _conv2d_nobias(handle, x, w)
+    if b is not None:
+        y = y + b.reshape(1, -1, 1, 1)
+    return y
+
+
+class ConvTransposeHandle:
+    """Config for 2-d transposed convolution (ONNX ConvTranspose;
+    reference: the cuDNN backward-data path the reference reuses for
+    deconvolution). Weight layout is ONNX/torch IOHW:
+    (in_channels, out_channels // groups, kh, kw)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size,
+                 stride=1, padding=0, output_padding=0, groups=1,
+                 bias=True):
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        self.kernel_size = _pair(kernel_size)
+        self.stride = _pair(stride)
+        self.padding = _pair(padding)
+        self.output_padding = _pair(output_padding)
+        self.groups = groups
+        self.bias = bias
+
+
+@partial(jax.jit, static_argnums=(0,), inline=True)
+def _conv_transpose2d_nobias(handle: ConvTransposeHandle, x, w):
+    """Transposed conv as an input-dilated conv with the flipped,
+    IO-swapped kernel — the same lowering XLA uses for conv input
+    gradients, so it rides the MXU like a forward conv."""
+    g = handle.groups
+    cin, cog, kh, kw = w.shape
+    # IOHW -> OIHW per group, spatial flip
+    wg = w.reshape(g, cin // g, cog, kh, kw)
+    wg = jnp.transpose(wg, (0, 2, 1, 3, 4))
+    w2 = wg.reshape(g * cog, cin // g, kh, kw)[:, :, ::-1, ::-1]
+    ph, pw = handle.padding
+    oph, opw = handle.output_padding
+    pad = ((kh - 1 - ph, kh - 1 - ph + oph),
+           (kw - 1 - pw, kw - 1 - pw + opw))
+    pref = jnp.float32 if x.dtype == jnp.float32 else None
+    return lax.conv_general_dilated(
+        x, w2,
+        window_strides=(1, 1),
+        padding=pad,
+        lhs_dilation=handle.stride,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=g,
+        preferred_element_type=pref,
+    ).astype(x.dtype)
+
+
+def conv_transpose2d(handle: ConvTransposeHandle, x, w, b=None):
+    """x: (N, C_in, H, W); w: (C_in, C_out/groups, kh, kw)."""
+    from .. import tensor as tensor_mod
+
+    x, w, b = tensor_mod.amp_cast(x, w, b)
+    y = _conv_transpose2d_nobias(handle, x, w)
+    if b is not None:
+        y = y + b.reshape(1, -1, 1, 1)
+    return y
+
+
+def instance_norm(x, scale, bias, eps: float = 1e-5):
+    """ONNX InstanceNormalization: per-(N, C) normalization over the
+    spatial dims; scale/bias are per-channel. Statistics in fp32
+    (matches the BN policy under AMP)."""
+    axes = tuple(range(2, x.ndim))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    shape = [1, -1] + [1] * (x.ndim - 2)
+    y = (xf - mean) * lax.rsqrt(var + eps) * scale.reshape(shape) \
+        + bias.reshape(shape)
+    return y.astype(x.dtype)
+
+
+class BatchNormHandle:
+    """Reference: `BatchNormHandle` / `CudnnBatchNormHandle`.
+
+    `factor` is cuDNN's exponentialAverageFactor (SINGA passes the
+    layer momentum): running = (1-factor)*running + factor*batch.
+    """
+
+    def __init__(self, factor: float = 0.9, eps: float = 1e-5):
+        self.factor = factor
+        self.eps = eps
+
+
+def batchnorm_training(handle: BatchNormHandle, x, scale, bias, running_mean, running_var):
+    """Reference: `GpuBatchNormForwardTraining`.
+
+    Per-channel (axis 1) normalization over (N, H, W). Returns
+    (y, batch_mean, batch_var, new_running_mean, new_running_var);
+    batch stats are returned because the reference caches them for
+    backward (here `jax.vjp` handles that, but the layer still updates
+    running state from them).
+    """
+    axes = tuple(i for i in range(x.ndim) if i != 1)
+    # Statistics always in fp32 (under AMP, x is bf16 but cuDNN-parity
+    # running stats must not drift); the normalized output returns to
+    # x's dtype so bf16 activations stay bf16 through BN.
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes)
+    # cuDNN uses biased variance for normalization.
+    var = jnp.var(xf, axis=axes)
+    shape = [1, -1] + [1] * (x.ndim - 2)
+    inv = lax.rsqrt(var + handle.eps).reshape(shape)
+    y = ((xf - mean.reshape(shape)) * inv * scale.reshape(shape)
+         + bias.reshape(shape)).astype(x.dtype)
+    f = handle.factor
+    new_rm = (1.0 - f) * running_mean + f * mean
+    new_rv = (1.0 - f) * running_var + f * var
+    return y, mean, var, new_rm, new_rv
+
+
+def batchnorm_inference(handle: BatchNormHandle, x, scale, bias, running_mean, running_var):
+    """Reference: `GpuBatchNormForwardInference`."""
+    shape = [1, -1] + [1] * (x.ndim - 2)
+    inv = lax.rsqrt(running_var + handle.eps).reshape(shape)
+    y = (x.astype(jnp.float32) - running_mean.reshape(shape)) * inv \
+        * scale.reshape(shape) + bias.reshape(shape)
+    return y.astype(x.dtype)
+
+
+class PoolingHandle:
+    """Reference: `PoolingHandle` / `CudnnPoolingHandle`."""
+
+    def __init__(
+        self,
+        kernel_size: _Pair,
+        stride: _Pair = None,
+        padding: _Pair = 0,
+        is_max: bool = True,
+        count_include_pad: bool = False,
+    ):
+        self.kernel_size = _pair(kernel_size)
+        self.stride = _pair(stride) if stride is not None else self.kernel_size
+        self.padding = _pair(padding)
+        self.is_max = is_max
+        self.count_include_pad = count_include_pad
+
+    def out_shape(self, h: int, w: int) -> Tuple[int, int]:
+        kh, kw = self.kernel_size
+        sh, sw = self.stride
+        ph, pw = self.padding
+        return (h + 2 * ph - kh) // sh + 1, (w + 2 * pw - kw) // sw + 1
+
+
+@partial(jax.jit, static_argnums=(0,), inline=True)
+def pooling(handle: PoolingHandle, x):
+    """Reference: `GpuPoolingForward` (max/avg) → `lax.reduce_window`."""
+    kh, kw = handle.kernel_size
+    sh, sw = handle.stride
+    ph, pw = handle.padding
+    window = (1, 1, kh, kw)
+    strides = (1, 1, sh, sw)
+    pads = ((0, 0), (0, 0), (ph, ph), (pw, pw))
+    if handle.is_max:
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, init, lax.max, window, strides, pads)
+    s = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
+    if handle.count_include_pad or (ph == 0 and pw == 0):
+        return s / (kh * kw)
+    # Divide by the true (unpadded) window size per position.
+    counts = lax.reduce_window(
+        jnp.ones_like(x), 0.0, lax.add, window, strides, pads
+    )
+    return s / counts
+
+
+# PoolingHandle/ConvHandle/BatchNormHandle participate in jit static args;
+# give them stable hash/eq by config so executable caching works.
+def _cfg(obj):
+    return tuple(sorted((k, v) for k, v in vars(obj).items()))
+
+
+for _cls in (ConvHandle, BatchNormHandle, PoolingHandle):
+    _cls.__hash__ = lambda self: hash((type(self).__name__, _cfg(self)))
+    _cls.__eq__ = lambda self, other: (
+        type(self) is type(other) and _cfg(self) == _cfg(other)
+    )

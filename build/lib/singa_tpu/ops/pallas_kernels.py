@@ -410,10 +410,7 @@ def _attn_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dl_ref,
 
 def _attn_shapes(q):
     b, h, s, d = q.shape
-    # Block dims must be sublane-aligned for the input dtype (f32: 8,
-    # bf16: 16, int8: 32 — use 32 to cover all) or Mosaic rejects the
-    # BlockSpec at lowering.
-    tq = _ATTN_TQ if s >= _ATTN_TQ else -(-s // 32) * 32
+    tq = min(_ATTN_TQ, max(8, s))
     spad = -(-s // tq) * tq
     return b, h, s, d, tq, spad
 

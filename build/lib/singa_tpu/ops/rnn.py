@@ -1,0 +1,211 @@
+"""Recurrent ops: packed-weight RNN/LSTM/GRU as XLA while-loops.
+
+Reference parity: `src/model/operation/rnn.{h,cc}` — `CudnnRNNHandle`
+(LSTM/GRU/tanh/relu modes, packed weight blob, dropout between layers,
+bidirectional), `GpuRNNForwardTraining/Inference`, `GpuRNNBackward{x,W}`.
+
+TPU-native redesign (SURVEY.md §7 "hard parts" #2): cuDNN's fused RNN
+becomes a `lax.scan` over time per layer. The packed-weight-blob API
+edge is kept: one flat 1-D parameter vector per RNN, with a documented
+layout so checkpoints are a single named array like the reference's.
+
+Packing layout (per layer ℓ, per direction d, concatenated flat,
+layers outermost, direction inner):
+
+    W_ih (G*H, in_dim) | W_hh (G*H, H) | b_ih (G*H,) | b_hh (G*H,)
+
+where G = gates-per-cell (1 for tanh/relu, 4 for LSTM, 3 for GRU) and
+gate order follows cuDNN: LSTM = (i, f, g, o); GRU = (r, z, n) with
+*linear-before-reset* semantics, n = tanh(Wn x + bWn + r ⊙ (Rn h + bRn))
+— the cuDNN/ONNX convention, required for Char-RNN loss parity.
+
+Performance: the input projection x·W_ihᵀ for the WHOLE sequence is a
+single large batched matmul hoisted out of the scan (MXU-friendly);
+only the h·W_hhᵀ recurrence runs inside the loop.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+_GATES = {"relu": 1, "tanh": 1, "lstm": 4, "gru": 3}
+
+
+class RNNHandle:
+    """Reference: `CudnnRNNHandle` → `TpuRNNHandle`.
+
+    Carries static configuration + the packed-weight layout table.
+    """
+
+    def __init__(
+        self,
+        input_size: int,
+        hidden_size: int,
+        num_layers: int = 1,
+        mode: str = "lstm",
+        bias: bool = True,
+        dropout: float = 0.0,
+        bidirectional: bool = False,
+    ):
+        mode = mode.lower()
+        if mode not in _GATES:
+            raise ValueError(f"mode must be one of {list(_GATES)}, got {mode!r}")
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.mode = mode
+        self.bias = bias
+        self.dropout = float(dropout)
+        self.bidirectional = bidirectional
+        self.num_directions = 2 if bidirectional else 1
+        self.num_gates = _GATES[mode]
+        # Offset table for the packed blob (static python ints).
+        self._segments = []  # (name, layer, direction, shape, offset)
+        off = 0
+        g, h = self.num_gates, hidden_size
+        for layer in range(num_layers):
+            in_dim = input_size if layer == 0 else h * self.num_directions
+            for d in range(self.num_directions):
+                for name, shape in (
+                    ("W_ih", (g * h, in_dim)),
+                    ("W_hh", (g * h, h)),
+                    ("b_ih", (g * h,)),
+                    ("b_hh", (g * h,)),
+                ):
+                    if not bias and name.startswith("b"):
+                        continue
+                    self._segments.append((name, layer, d, shape, off))
+                    off += int(np.prod(shape))
+        self.weights_size = off
+
+    # -- packed blob helpers ----------------------------------------------
+    def unpack(self, w):
+        """Packed 1-D blob → {(name, layer, dir): array} dict."""
+        out = {}
+        for name, layer, d, shape, off in self._segments:
+            n = int(np.prod(shape))
+            out[(name, layer, d)] = w[off:off + n].reshape(shape)
+        return out
+
+    def pack(self, tensors) -> jnp.ndarray:
+        """Inverse of `unpack` (host-side; used by tests/converters)."""
+        parts = []
+        for name, layer, d, shape, _ in self._segments:
+            parts.append(jnp.asarray(tensors[(name, layer, d)]).reshape(-1))
+        return jnp.concatenate(parts) if parts else jnp.zeros((0,))
+
+    def init_weights(self, key, dtype=jnp.float32) -> jnp.ndarray:
+        """cuDNN-style default init: U(-1/sqrt(H), 1/sqrt(H)) for every
+        segment (matches the reference's and torch's RNN init)."""
+        k = 1.0 / np.sqrt(self.hidden_size)
+        return jax.random.uniform(
+            key, (self.weights_size,), dtype, minval=-k, maxval=k
+        )
+
+    def state_shape(self, batch: int) -> Tuple[int, int, int]:
+        return (self.num_layers * self.num_directions, batch, self.hidden_size)
+
+
+# ---------------------------------------------------------------------------
+# Cell steps (h·W_hhᵀ inside scan; x projections precomputed outside)
+# ---------------------------------------------------------------------------
+def _lstm_step(xw, h, c, W_hh, b_hh):
+    g = xw + h @ W_hh.T + b_hh
+    i, f, gg, o = jnp.split(g, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    gg = jnp.tanh(gg)
+    c = f * c + i * gg
+    h = o * jnp.tanh(c)
+    return h, c
+
+
+def _gru_step(xw, h, W_hh, b_hh):
+    hw = h @ W_hh.T + b_hh  # linear BEFORE reset (cuDNN convention)
+    xr, xz, xn = jnp.split(xw, 3, axis=-1)
+    hr, hz, hn = jnp.split(hw, 3, axis=-1)
+    r = jax.nn.sigmoid(xr + hr)
+    z = jax.nn.sigmoid(xz + hz)
+    n = jnp.tanh(xn + r * hn)
+    return (1.0 - z) * n + z * h
+
+
+def _plain_step(xw, h, W_hh, b_hh, act):
+    return act(xw + h @ W_hh.T + b_hh)
+
+
+def _scan_direction(handle: RNNHandle, mode, xs_proj, h0, c0, W_hh, b_hh,
+                    reverse: bool):
+    """Scan one (layer, direction) over time. xs_proj: (T, B, G*H)."""
+    act = jnp.tanh if mode == "tanh" else jax.nn.relu
+
+    if mode == "lstm":
+        def step(carry, xw):
+            h, c = carry
+            h, c = _lstm_step(xw, h, c, W_hh, b_hh)
+            return (h, c), h
+
+        (hT, cT), ys = lax.scan(step, (h0, c0), xs_proj, reverse=reverse)
+        return ys, hT, cT
+    if mode == "gru":
+        def step(h, xw):
+            h = _gru_step(xw, h, W_hh, b_hh)
+            return h, h
+    else:
+        def step(h, xw):
+            h = _plain_step(xw, h, W_hh, b_hh, act)
+            return h, h
+
+    hT, ys = lax.scan(step, h0, xs_proj, reverse=reverse)
+    return ys, hT, None
+
+
+@partial(jax.jit, static_argnums=(0, 5), inline=True)
+def rnn_forward(handle: RNNHandle, x, hx, cx, w, training: bool = False,
+                dropout_key=None):
+    """Reference: `GpuRNNForwardTraining/Inference`.
+
+    x: (T, B, input_size) — seq-major like cuDNN/SINGA.
+    hx: (L*D, B, H); cx: same (LSTM only, else ignored).
+    w: packed 1-D blob (`handle.weights_size`).
+    Returns (y, hy, cy): y is (T, B, D*H); cy is zeros for non-LSTM.
+    """
+    seg = handle.unpack(w)
+    L, D, H, G = (handle.num_layers, handle.num_directions,
+                  handle.hidden_size, handle.num_gates)
+    zeros_b = jnp.zeros((G * H,), x.dtype)
+    inp = x
+    hys, cys = [], []
+    for layer in range(L):
+        outs = []
+        for d in range(D):
+            W_ih = seg[("W_ih", layer, d)]
+            W_hh = seg[("W_hh", layer, d)]
+            b_ih = seg.get(("b_ih", layer, d), zeros_b)
+            b_hh = seg.get(("b_hh", layer, d), zeros_b)
+            # Hoisted input projection: one (T*B, in)×(in, G*H) matmul.
+            xs_proj = inp @ W_ih.T + b_ih
+            idx = layer * D + d
+            h0 = hx[idx]
+            c0 = cx[idx] if handle.mode == "lstm" else None
+            ys, hT, cT = _scan_direction(
+                handle, handle.mode, xs_proj, h0, c0, W_hh, b_hh,
+                reverse=(d == 1),
+            )
+            outs.append(ys)
+            hys.append(hT)
+            cys.append(cT if cT is not None else jnp.zeros_like(hT))
+        inp = outs[0] if D == 1 else jnp.concatenate(outs, axis=-1)
+        if training and handle.dropout > 0 and layer < L - 1:
+            assert dropout_key is not None, "dropout requires an rng key"
+            lkey = jax.random.fold_in(dropout_key, layer)
+            keep = 1.0 - handle.dropout
+            mask = jax.random.bernoulli(lkey, keep, inp.shape)
+            inp = jnp.where(mask, inp / keep, 0.0).astype(inp.dtype)
+    hy = jnp.stack(hys)
+    cy = jnp.stack(cys)
+    return inp, hy, cy

@@ -1,0 +1,17 @@
+"""singa_tpu.parallel — mesh, shardings, and sequence parallelism.
+
+TPU-native replacement for the reference's entire distribution story
+(NCCL Communicator + DistOpt, SURVEY.md §2.4): parallelism is expressed
+as a named device mesh plus sharding annotations, and XLA inserts the
+ICI/DCN collectives. DP/TP/SP compose in one jit-ed train step
+(`Model.compile(..., mesh=...)`); ring attention provides exact
+long-context attention over the "seq" axis.
+"""
+from .mesh import AXES, auto_mesh, create_mesh, default_balanced_mesh  # noqa: F401
+from .ring_attention import plain_attention, ring_attention  # noqa: F401
+from .sharding import (  # noqa: F401
+    DEFAULT_RULES,
+    ShardingRules,
+    batch_sharding,
+    replicated,
+)

@@ -1,0 +1,93 @@
+"""Device mesh construction for multi-chip parallelism.
+
+The reference's process model is `(global_rank, world_size, local_rank)`
+over NCCL rings (include/singa/io/communicator.h). The TPU-native
+replacement is a named `jax.sharding.Mesh` over the pod's ICI topology:
+axes are *roles* — "data" (DP replicas), "model" (tensor parallel),
+"seq" (sequence/context parallel, ring attention), "pipe" (pipeline
+stages), "expert" (MoE expert parallel) — and XLA routes the matching
+collectives over ICI (intra-slice) or DCN (cross-slice) from the
+sharding annotations alone.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical axis order. Keeping "data" outermost means DP gradient
+# all-reduces ride the widest ICI dimension on real slices.
+AXES = ("data", "model", "seq", "pipe", "expert")
+
+
+def create_mesh(axes: Optional[Dict[str, int]] = None,
+                devices: Optional[Sequence] = None) -> Mesh:
+    """Build a named Mesh from an {axis: size} dict.
+
+    Sizes must multiply to the device count. Axes are laid out in
+    canonical order (`AXES`) regardless of dict order, then any axes
+    the caller invented are appended in insertion order.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if axes is None:
+        axes = {"data": n}
+    names = [a for a in AXES if a in axes] + [
+        a for a in axes if a not in AXES
+    ]
+    sizes = [axes[a] for a in names]
+    total = int(np.prod(sizes)) if sizes else 1
+    if total != n:
+        raise ValueError(
+            f"mesh axes {dict(zip(names, sizes))} multiply to {total}, "
+            f"but {n} devices are available"
+        )
+    arr = np.asarray(devices).reshape(sizes)
+    return Mesh(arr, tuple(names))
+
+
+def auto_mesh(n_devices: Optional[int] = None, *, data: int = 0,
+              model: int = 0, seq: int = 0, pipe: int = 0,
+              expert: int = 0) -> Mesh:
+    """Factor `n_devices` into a mesh, inferring unset (=0) axes.
+
+    Explicitly-set axes are honored; "data" absorbs the remainder.
+    E.g. `auto_mesh(8, model=2, seq=2)` → Mesh(data=2, model=2, seq=2).
+    """
+    devices = jax.devices()
+    n = len(devices) if n_devices is None else n_devices
+    devices = devices[:n]
+    req = {"data": data, "model": model, "seq": seq, "pipe": pipe,
+           "expert": expert}
+    fixed = {k: v for k, v in req.items() if v > 0}
+    prod = int(np.prod(list(fixed.values()))) if fixed else 1
+    if n % prod:
+        raise ValueError(f"{fixed} does not divide {n} devices")
+    if data > 0:
+        # "data" was explicitly requested: honor it exactly.
+        if prod != n:
+            raise ValueError(
+                f"explicit axes {fixed} use {prod} of {n} devices; "
+                f"drop data= to let it absorb the remainder")
+    else:
+        fixed["data"] = n // prod
+    axes = {k: v for k, v in fixed.items() if v > 1} or {"data": 1}
+    return create_mesh(axes, devices)
+
+
+def default_balanced_mesh(n_devices: int) -> Mesh:
+    """Split n into data×model×seq as evenly as powers of two allow —
+    the shape `dryrun_multichip` exercises (dp+tp+sp simultaneously)."""
+    sizes = {"data": 1, "model": 1, "seq": 1}
+    order = ["seq", "model", "data"]  # give spare factors to dp last
+    rem, i = n_devices, 0
+    while rem % 2 == 0 and rem > 1:
+        sizes[order[i % 3]] *= 2
+        rem //= 2
+        i += 1
+    sizes["data"] *= rem  # odd remainder → extra DP replicas
+    return create_mesh({k: v for k, v in sizes.items() if v > 1}
+                       or {"data": 1}, jax.devices()[:n_devices])

@@ -1,0 +1,90 @@
+"""Parameter/batch sharding rules — how named tensors map onto mesh axes.
+
+The reference has exactly one layout: every param replicated, every
+gradient all-reduced (opt.DistOpt over src/io/communicator.cc). Here
+layouts are data: a `ShardingRules` object maps param *names* (the
+`Layer.get_params` dotted path) to `PartitionSpec`s, and XLA/GSPMD
+derives every collective from those annotations. Rules degrade safely:
+an axis that does not exist in the mesh, or whose size does not divide
+the dimension, is dropped (→ replicated on that dim), so one rule set
+works from 1 chip to a pod.
+"""
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Sequence, Tuple
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# (name regex, dim spec). Dim spec entries are mesh-axis names or None;
+# shorter specs are right-padded with None. Matching is first-hit.
+Rule = Tuple[str, Sequence[Optional[str]]]
+
+# Megatron-style tensor parallelism over the "model" axis:
+#  - Linear weights (in, out): shard the output features;
+#  - conv kernels (out_c, in_c, kh, kw): shard output channels;
+#  - embeddings (vocab, dim): shard the vocab (lookup all-reduces).
+# Biases/gains stay replicated — tiny, and it keeps BN/LN trivial.
+DEFAULT_RULES: List[Rule] = [
+    (r"(^|\.)conv\w*\.W$", ("model", None, None, None)),
+    (r"(^|\.)embed\w*\.W$", ("model", None)),
+    (r"(^|\.)(W|weight)$", (None, "model")),
+]
+
+
+class ShardingRules:
+    """First-match name→PartitionSpec table with divisibility fallback."""
+
+    def __init__(self, rules: Optional[List[Rule]] = None):
+        self.rules = list(DEFAULT_RULES if rules is None else rules)
+        self._compiled = [(re.compile(pat), tuple(spec))
+                          for pat, spec in self.rules]
+
+    def spec_for(self, name: str, shape: Sequence[int]) -> P:
+        for pat, spec in self._compiled:
+            if pat.search(name):
+                if len(spec) > len(shape):
+                    continue
+                padded = tuple(spec) + (None,) * (len(shape) - len(spec))
+                return P(*padded)
+        return P()
+
+    def sharding_for(self, mesh: Mesh, name: str,
+                     shape: Sequence[int]) -> NamedSharding:
+        spec = self.spec_for(name, shape)
+        return NamedSharding(mesh, _validate(mesh, spec, shape))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, ndim: int, *, batch_axis: str = "data",
+                   seq_axis: Optional[str] = None,
+                   seq_dim: int = 1) -> NamedSharding:
+    """Input-batch layout: dim 0 over DP replicas, optionally the
+    sequence dim over the SP axis (ring-attention feeds)."""
+    dims: List[Optional[str]] = [None] * ndim
+    if ndim > 0:
+        dims[0] = batch_axis
+    if seq_axis and 0 <= seq_dim < ndim:
+        dims[seq_dim] = seq_axis
+    return NamedSharding(mesh, _validate(mesh, P(*dims), (0,) * ndim))
+
+
+def _validate(mesh: Mesh, spec: P, shape: Sequence[int]) -> P:
+    """Drop axes missing from the mesh or not dividing the dim size
+    (shape entries of 0 mean 'unknown, trust the caller')."""
+    out: List[Optional[str]] = []
+    for d, ax in enumerate(tuple(spec)):
+        if ax is None or ax not in mesh.axis_names:
+            out.append(None)
+            continue
+        size = mesh.shape[ax]
+        if size <= 1 or (d < len(shape) and shape[d] and shape[d] % size):
+            out.append(None)
+        else:
+            out.append(ax)
+    while out and out[-1] is None:
+        out.pop()
+    return P(*out)

@@ -1,0 +1,161 @@
+"""Mesh-mode training step: the whole `train_one_batch` as one SPMD
+program over a named device mesh.
+
+This is the TPU-native successor to the reference's distributed step
+(SURVEY.md §3.3): where `opt.DistOpt` drives one NCCL allreduce per
+gradient from Python, here the *same user code* traces into a single
+jit whose inputs carry `NamedSharding`s — GSPMD partitions the compute
+and inserts the gradient reductions over ICI, and XLA's latency-hiding
+scheduler overlaps them with the backward pass (the hand-tuned c1/c2
+stream trick in src/io/communicator.cc, done by the compiler).
+
+Composes DP ("data" axis: batch dim), TP ("model" axis: param rules),
+and SP ("seq" axis: ring attention ops inside the model) in one step.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..model import _JitStep
+from .sharding import ShardingRules, batch_sharding, replicated
+
+
+class ShardedJitStep(_JitStep):
+    """`_JitStep` with mesh shardings on every program input/output.
+
+    Params/optimizer slots are laid out per `rules` and *re-placed*
+    (jax.device_put) onto the mesh at construction, so step 1 already
+    runs fully sharded; batch arrays are sharded on dim 0 over "data"
+    (override per-input with `batch_specs`, e.g. to also shard the
+    sequence dim over "seq" for ring attention).
+    """
+
+    def __init__(self, model, mesh, rules: Optional[ShardingRules] = None,
+                 batch_axis: str = "data",
+                 batch_specs: Optional[Sequence] = None,
+                 seq_axis: Optional[str] = None, seq_dim: int = 1):
+        super().__init__(model)
+        self.mesh = mesh
+        self.rules = rules or ShardingRules()
+        self.batch_axis = batch_axis
+        self.batch_specs = batch_specs
+        self.seq_axis = seq_axis
+        self.seq_dim = seq_dim
+        self._param_names = {
+            id(t): n for n, t in model.get_params().items()
+        }
+        # Multi-controller: the mesh spans devices of other processes
+        # (launch topologies train_multiprocess.py / train_mpi.py).
+        self._multiproc = any(
+            d.process_index != jax.process_index()
+            for d in np.asarray(mesh.devices).flat)
+        self._ensure_opt_slots()
+        self._place()
+
+    def _gput(self, v, sh):
+        """device_put that works across controllers: a single-device
+        committed array cannot be copied onto non-addressable devices,
+        so bridge through the host value (every controller holds the
+        same value by construction — same seed, same updates)."""
+        if getattr(v, "sharding", None) == sh:
+            return v
+        if self._multiproc and getattr(v, "is_fully_addressable", True):
+            v = np.asarray(v)
+        return jax.device_put(v, sh)
+
+    # -- sharding tables ---------------------------------------------------
+    def _param_shardings(self) -> List:
+        out = []
+        for p in self.params:
+            name = self._param_names.get(id(p), "")
+            out.append(self.rules.sharding_for(self.mesh, name,
+                                               p.data.shape))
+        return out
+
+    def _state_shardings(self) -> List:
+        return [replicated(self.mesh) for _ in self.states]
+
+    def _opt_shardings(self) -> List:
+        """Optimizer slots inherit their param's layout (slot arrays
+        are elementwise companions of the param)."""
+        if self.opt is None:
+            return []
+        by_id = {id(p): s for p, s in zip(self.params,
+                                          self._param_shardings())}
+        out = []
+        for pid, pstate in self.opt.states.items():
+            sh = by_id.get(pid, replicated(self.mesh))
+            out.extend(sh for _ in sorted(pstate))
+        return out
+
+    def _batch_shardings(self, batch_arrays) -> tuple:
+        if self.batch_specs is not None:
+            from jax.sharding import NamedSharding
+
+            return tuple(
+                NamedSharding(self.mesh, spec)
+                for spec in self.batch_specs
+            )
+        return tuple(
+            batch_sharding(self.mesh, getattr(b, "ndim", 0),
+                           batch_axis=self.batch_axis,
+                           seq_axis=self.seq_axis, seq_dim=self.seq_dim)
+            for b in batch_arrays
+        )
+
+    # -- placement ---------------------------------------------------------
+    def _place(self):
+        """Lay existing (single-device) param/state/opt arrays out on
+        the mesh so the first compiled step starts sharded."""
+        for p, sh in zip(self.params, self._param_shardings()):
+            p.data = self._gput(p.data, sh)
+        rep = replicated(self.mesh)
+        for s in self.states:
+            s.data = self._gput(s.data, rep)
+        if self.opt is not None:
+            arrays = self._opt_arrays()
+            shs = self._opt_shardings()
+            self._bind_opt_arrays(
+                [self._gput(a, sh) for a, sh in zip(arrays, shs)]
+            )
+
+    def _prepare_inputs(self, pvals, svals, ovals, key, batch_arrays):
+        """device_put everything to its mesh layout (no-op for arrays
+        already placed — users may rebind p.data to host arrays)."""
+        rep = replicated(self.mesh)
+        pvals = [self._gput(v, s)
+                 for v, s in zip(pvals, self._param_shardings())]
+        svals = [self._gput(v, rep) for v in svals]
+        ovals = [self._gput(v, s)
+                 for v, s in zip(ovals, self._opt_shardings())]
+        key = self._gput(key, rep)
+        batch_arrays = tuple(
+            self._gput(b, s)
+            for b, s in zip(batch_arrays, self._batch_shardings(batch_arrays))
+        )
+        return pvals, svals, ovals, key, batch_arrays
+
+    def _restore_key(self, new_key, dev):
+        if not getattr(new_key, "is_fully_addressable", True):
+            # Replicated over a multi-controller mesh: every process
+            # holds the full value in its local shard; pull that.
+            new_key = new_key.addressable_shards[0].data
+        return jax.device_put(new_key, dev.jax_device)
+
+    # -- jit wiring --------------------------------------------------------
+    def _jit_kwargs(self, batch_arrays):
+        rep = replicated(self.mesh)
+        p_sh = self._param_shardings()
+        s_sh = self._state_shardings()
+        o_sh = self._opt_shardings()
+        in_shardings = (p_sh, s_sh, o_sh, rep, rep,
+                        self._batch_shardings(batch_arrays))
+        # Outputs: (out_arrays, new_p, new_s, new_o, new_key) — model
+        # outputs unconstrained (None = compiler chooses), round-trip
+        # state pinned to its input layout so donation aliases cleanly.
+        out_shardings = (None, p_sh, s_sh, o_sh, rep)
+        return {"in_shardings": in_shardings,
+                "out_shardings": out_shardings}

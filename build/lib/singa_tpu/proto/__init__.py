@@ -1,0 +1,6 @@
+"""Protobuf schemas (reference: src/proto/{core,model,io}.proto).
+
+`onnx_ir_pb2` is generated from `onnx_ir.proto` by protoc
+(`protoc --python_out=. singa_tpu/proto/onnx_ir.proto` from the repo
+root); the generated module is committed so users need no protoc.
+"""

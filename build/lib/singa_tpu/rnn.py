@@ -1,0 +1,96 @@
+"""Recurrent layers: RNN / LSTM / GRU over the packed-weight RNN op.
+
+Reference parity: `python/singa/layer.py` (`RNN`, `LSTM`, `GRU` — the
+cuDNN-handle-backed layers) and `python/singa/autograd.py`'s plain-op
+`RNN/LSTM` classes. One implementation here serves both roles: the
+underlying op is a `lax.scan` (singa_tpu/ops/rnn.py) so there is no
+cudnn/plain split — the graph-mode jit path and the eager path run the
+same program.
+
+API follows the reference: seq-major input (T, B, F) by default,
+`batch_first=True` accepts (B, T, F). `forward(x, hx=None, cx=None)`
+returns `(y, hy)` for RNN/GRU and `(y, (hy, cy))` for LSTM so
+Char-RNN-style state carry works.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from . import autograd
+from .layer import Layer
+from .ops.rnn import RNNHandle
+from .tensor import Tensor
+
+
+class _RNNBase(Layer):
+    mode = "tanh"
+
+    def __init__(self, hidden_size: int, num_layers: int = 1,
+                 bias: bool = True, batch_first: bool = False,
+                 dropout: float = 0.0, bidirectional: bool = False,
+                 name=None):
+        super().__init__(name)
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.bias = bias
+        self.batch_first = batch_first
+        self.dropout = dropout
+        self.bidirectional = bidirectional
+
+    def initialize(self, x: Tensor, hx=None, cx=None):
+        input_size = x.shape[-1]
+        self.handle = RNNHandle(
+            input_size, self.hidden_size, self.num_layers, self.mode,
+            bias=self.bias, dropout=self.dropout,
+            bidirectional=self.bidirectional,
+        )
+        w = Tensor((self.handle.weights_size,), device=x.device)
+        w.data = self.handle.init_weights(x.device.next_key())
+        self.register_param("W", w)
+
+    def _zero_state(self, batch: int, like: Tensor) -> Tensor:
+        t = Tensor(self.handle.state_shape(batch), device=like.device)
+        t.set_value(0.0)
+        return t
+
+    def forward(self, x: Tensor, hx: Optional[Tensor] = None,
+                cx: Optional[Tensor] = None):
+        if self.batch_first:
+            x = autograd.transpose(x, (1, 0, 2))
+        batch = x.shape[1]
+        if hx is None:
+            hx = self._zero_state(batch, x)
+        if cx is None:
+            cx = self._zero_state(batch, x)
+        key = (x.device.next_key()
+               if autograd.training and self.handle.dropout > 0 else None)
+        y, hy, cy = autograd.rnn_op(self.handle, x, hx, cx, self.W,
+                                    rng_key=key)
+        if self.batch_first:
+            y = autograd.transpose(y, (1, 0, 2))
+        if self.mode == "lstm":
+            return y, (hy, cy)
+        return y, hy
+
+
+class RNN(_RNNBase):
+    """Reference: `layer.RNN` (tanh/relu vanilla RNN)."""
+
+    def __init__(self, hidden_size: int, num_layers: int = 1,
+                 nonlinearity: str = "tanh", **kw):
+        super().__init__(hidden_size, num_layers, **kw)
+        if nonlinearity not in ("tanh", "relu"):
+            raise ValueError("nonlinearity must be 'tanh' or 'relu'")
+        self.mode = nonlinearity
+
+
+class LSTM(_RNNBase):
+    """Reference: `layer.LSTM` (cuDNN LSTM → scan; gate order i,f,g,o)."""
+
+    mode = "lstm"
+
+
+class GRU(_RNNBase):
+    """Reference: `layer.GRU` (linear-before-reset, cuDNN semantics)."""
+
+    mode = "gru"

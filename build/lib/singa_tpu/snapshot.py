@@ -1,0 +1,97 @@
+"""Snapshot: name→tensor checkpoint files.
+
+Reference parity: `python/singa/snapshot.py` over the C++
+`singa::Snapshot` (include/singa/io/snapshot.h, src/io/snapshot.cc) —
+a key/value store of parameter tensors written at `<prefix>.model`.
+The reference frames records with BinFile magic words; here the
+container is a zip of .npy payloads plus a json manifest (same format
+family as `Model.save_states`, singa_tpu/model.py) — portable,
+inspectable, and mmap-friendly.
+
+The native BinFile record format itself lives in `singa_tpu.io`
+(C++-backed), for parity with the reference's reader/writer pair.
+"""
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from .device import Device, get_default_device
+from .tensor import Tensor, from_numpy
+
+
+class Snapshot:
+    """Reference: `snapshot.Snapshot(f, mode, buffer_size)` — mode True
+    writes, False reads."""
+
+    SUFFIX = ".model"
+
+    def __init__(self, f: str, mode: bool, buffer_size: int = 10):
+        self.fname = f if f.endswith(self.SUFFIX) else f + self.SUFFIX
+        self.mode = mode
+        self._pending: Dict[str, np.ndarray] = {}
+        if not mode:
+            with zipfile.ZipFile(self.fname, "r") as zf:
+                self._manifest = json.loads(zf.read("__manifest__.json"))
+                self._arrays = {
+                    name: np.load(io.BytesIO(zf.read(name + ".npy")))
+                    for name in self._manifest["names"]
+                }
+
+    def write(self, param_name: str, param_val: Tensor) -> None:
+        """Reference: `Snapshot::Write` — buffer one named tensor."""
+        if not self.mode:
+            raise RuntimeError("snapshot opened for reading")
+        arr = (param_val.to_numpy() if isinstance(param_val, Tensor)
+               else np.asarray(param_val))
+        self._pending[param_name] = arr
+
+    def read(self) -> List[Tuple[str, Tensor]]:
+        """Reference: `Snapshot::Read` — all (name, tensor) pairs."""
+        if self.mode:
+            raise RuntimeError("snapshot opened for writing")
+        dev = get_default_device()
+        return [(n, from_numpy(a, device=dev))
+                for n, a in self._arrays.items()]
+
+    def flush(self) -> None:
+        if self.mode and self._pending:
+            with zipfile.ZipFile(self.fname, "w") as zf:
+                for name, arr in self._pending.items():
+                    buf = io.BytesIO()
+                    np.save(buf, arr)
+                    zf.writestr(name + ".npy", buf.getvalue())
+                zf.writestr("__manifest__.json", json.dumps({
+                    "names": list(self._pending.keys()),
+                    "shapes": {k: list(v.shape)
+                               for k, v in self._pending.items()},
+                    "dtypes": {k: str(v.dtype)
+                               for k, v in self._pending.items()},
+                }))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.flush()
+        return False
+
+    def __del__(self):
+        try:
+            self.flush()
+        except Exception:
+            pass
+
+
+def save(fname: str, params: Dict[str, Tensor]) -> None:
+    with Snapshot(fname, True) as s:
+        for k, v in params.items():
+            s.write(k, v)
+
+
+def load(fname: str) -> Dict[str, Tensor]:
+    return dict(Snapshot(fname, False).read())

@@ -1,0 +1,963 @@
+"""SONNX: ONNX import/export over the autograd op registry.
+
+Reference parity: `python/singa/sonnx.py` (SURVEY.md §2.2 P7) —
+`SingaFrontend.to_onnx` (walk the creator graph, rename ops),
+`SingaBackend.prepare(model, device)` → `SingaRep.run(inputs)`, and
+`SONNXModel` (a `Model` subclass wrapping an imported graph for
+fine-tuning — the BERT config's entry point, SURVEY.md §3.4).
+
+TPU-native difference: the environment has no `onnx` pip package, so
+serialization uses `singa_tpu.proto.onnx_ir_pb2`, a wire-compatible
+subset of the public ONNX schema compiled with protoc — files written
+here load in stock onnx tooling and vice versa. Execution of an
+imported graph dispatches to the same autograd ops as native models,
+so imported graphs are differentiable, jit-able (`Model.compile`) and
+mesh-shardable like everything else.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import autograd, model as model_mod, tensor as tensor_mod
+from .device import get_default_device
+from .ops import native
+from .proto import onnx_ir_pb2 as P
+from .tensor import Tensor
+
+OPSET_VERSION = 13
+IR_VERSION = 8
+
+# ---------------------------------------------------------------------------
+# numpy <-> TensorProto
+# ---------------------------------------------------------------------------
+_NP2ONNX = {
+    np.dtype(np.float32): P.TensorProto.FLOAT,
+    np.dtype(np.uint8): P.TensorProto.UINT8,
+    np.dtype(np.int8): P.TensorProto.INT8,
+    np.dtype(np.uint16): P.TensorProto.UINT16,
+    np.dtype(np.int16): P.TensorProto.INT16,
+    np.dtype(np.int32): P.TensorProto.INT32,
+    np.dtype(np.int64): P.TensorProto.INT64,
+    np.dtype(np.bool_): P.TensorProto.BOOL,
+    np.dtype(np.float16): P.TensorProto.FLOAT16,
+    np.dtype(np.float64): P.TensorProto.DOUBLE,
+    np.dtype(np.uint32): P.TensorProto.UINT32,
+    np.dtype(np.uint64): P.TensorProto.UINT64,
+}
+_ONNX2NP = {v: k for k, v in _NP2ONNX.items()}
+
+
+def to_tensor_proto(name: str, arr) -> P.TensorProto:
+    arr = np.asarray(arr)
+    if arr.dtype == jnp.bfloat16 or str(arr.dtype) == "bfloat16":
+        arr = arr.astype(np.float32)
+    tp = P.TensorProto()
+    tp.name = name
+    tp.dims.extend(arr.shape)
+    tp.data_type = _NP2ONNX[arr.dtype]
+    tp.raw_data = np.ascontiguousarray(arr).tobytes()
+    return tp
+
+
+def to_numpy(tp: P.TensorProto) -> np.ndarray:
+    dtype = _ONNX2NP[tp.data_type]
+    shape = tuple(tp.dims)
+    if tp.raw_data:
+        return np.frombuffer(tp.raw_data, dtype=dtype).reshape(shape).copy()
+    if tp.int32_data and tp.data_type == P.TensorProto.FLOAT16:
+        # The ONNX spec stores fp16 as raw bit patterns in int32_data;
+        # reinterpret, don't numerically cast.
+        return (np.asarray(tp.int32_data, np.int32).astype(np.uint16)
+                .view(np.float16).reshape(shape))
+    if tp.float_data:
+        return np.asarray(tp.float_data, np.float32).astype(dtype).reshape(shape)
+    if tp.int64_data:
+        return np.asarray(tp.int64_data, np.int64).astype(dtype).reshape(shape)
+    if tp.int32_data:
+        return np.asarray(tp.int32_data, np.int32).astype(dtype).reshape(shape)
+    if tp.double_data:
+        return np.asarray(tp.double_data, np.float64).astype(dtype).reshape(shape)
+    return np.zeros(shape, dtype)
+
+
+def _elem_type(dtype) -> int:
+    """ONNX elem_type for a value-info dtype; bf16 maps to BFLOAT16=16
+    (it is not in _NP2ONNX since numpy has no native bfloat16)."""
+    if str(dtype) == "bfloat16":
+        return P.TensorProto.BFLOAT16
+    return _NP2ONNX[np.dtype(dtype)]
+
+
+def _attr(node: P.NodeProto, name: str, default=None):
+    for a in node.attribute:
+        if a.name != name:
+            continue
+        t = a.type
+        if t == P.AttributeProto.FLOAT:
+            return a.f
+        if t == P.AttributeProto.INT:
+            return a.i
+        if t == P.AttributeProto.STRING:
+            return a.s.decode()
+        if t == P.AttributeProto.TENSOR:
+            return to_numpy(a.t)
+        if t == P.AttributeProto.FLOATS:
+            return list(a.floats)
+        if t == P.AttributeProto.INTS:
+            return list(a.ints)
+        if t == P.AttributeProto.STRINGS:
+            return [s.decode() for s in a.strings]
+    return default
+
+
+def _make_attr(name: str, value) -> P.AttributeProto:
+    a = P.AttributeProto()
+    a.name = name
+    if isinstance(value, bool):
+        a.type, a.i = P.AttributeProto.INT, int(value)
+    elif isinstance(value, (int, np.integer)):
+        a.type, a.i = P.AttributeProto.INT, int(value)
+    elif isinstance(value, (float, np.floating)):
+        a.type, a.f = P.AttributeProto.FLOAT, float(value)
+    elif isinstance(value, str):
+        a.type, a.s = P.AttributeProto.STRING, value.encode()
+    elif isinstance(value, np.ndarray):
+        a.type = P.AttributeProto.TENSOR
+        a.t.CopyFrom(to_tensor_proto(name, value))
+    elif isinstance(value, (list, tuple)):
+        if value and isinstance(value[0], (float, np.floating)):
+            a.type = P.AttributeProto.FLOATS
+            a.floats.extend(float(v) for v in value)
+        else:
+            a.type = P.AttributeProto.INTS
+            a.ints.extend(int(v) for v in value)
+    else:
+        raise TypeError(f"unsupported attribute value {value!r}")
+    return a
+
+
+def save(model_proto: P.ModelProto, path: str) -> None:
+    with open(path, "wb") as f:
+        f.write(model_proto.SerializeToString())
+
+
+def load(path: str) -> P.ModelProto:
+    mp = P.ModelProto()
+    with open(path, "rb") as f:
+        mp.ParseFromString(f.read())
+    return mp
+
+
+# ===========================================================================
+# Export: creator-graph walk → ONNX (reference: SingaFrontend)
+# ===========================================================================
+class _GraphBuilder:
+    def __init__(self, graph: P.GraphProto):
+        self.g = graph
+        self._const_count = 0
+
+    def node(self, op_type: str, ins: Sequence[str], outs: Sequence[str],
+             **attrs) -> P.NodeProto:
+        n = self.g.node.add()
+        n.op_type = op_type
+        n.name = f"{op_type}_{len(self.g.node)}"
+        n.input.extend(ins)
+        n.output.extend(outs)
+        for k, v in attrs.items():
+            if v is not None:
+                n.attribute.append(_make_attr(k, v))
+        return n
+
+    def const(self, arr, hint: str = "const") -> str:
+        name = f"{hint}_{self._const_count}"
+        self._const_count += 1
+        self.g.initializer.append(to_tensor_proto(name, np.asarray(arr)))
+        return name
+
+
+# Plain one-to-one renames (no attributes).
+_SIMPLE_EXPORT = {
+    "ReLU": "Relu", "Sigmoid": "Sigmoid", "Tanh": "Tanh", "Tanh_": "Tanh",
+    "Abs": "Abs", "Exp": "Exp", "Log": "Log", "Sqrt": "Sqrt",
+    "Negative": "Neg", "Reciprocal": "Reciprocal", "Erf": "Erf",
+    "Ceil": "Ceil", "Floor": "Floor", "Round": "Round", "Sign": "Sign",
+    "Cos": "Cos", "Sin": "Sin", "Tan": "Tan", "Acos": "Acos",
+    "Asin": "Asin", "Atan": "Atan", "Cosh": "Cosh", "Sinh": "Sinh",
+    "Acosh": "Acosh", "Asinh": "Asinh", "Atanh": "Atanh",
+    "SoftPlus": "Softplus", "SoftSign": "Softsign", "Gelu": "Gelu",
+    "Add": "Add", "Sub": "Sub", "Mul": "Mul", "Div": "Div", "Pow": "Pow",
+    "Minimum": "Min", "Maximum": "Max", "Less": "Less",
+    "Greater": "Greater", "Equal": "Equal", "Mult": "MatMul",
+    "GlobalAveragePool": "GlobalAveragePool",
+}
+
+
+def _export_node(op, in_names: List[str], out_names: List[str],
+                 gb: _GraphBuilder, resolve=lambda t: None) -> None:
+    cls = type(op).__name__
+    if cls == "Square":
+        gb.node("Mul", [in_names[0], in_names[0]], out_names)
+    elif cls == "AddBias":
+        if op.axis == 1:
+            # x + b[:, None]: unsqueeze the bias so ONNX broadcasting
+            # matches the per-row semantics.
+            b2 = out_names[0] + "_bias2d"
+            gb.node("Unsqueeze",
+                    [in_names[1], gb.const(np.asarray([1], np.int64),
+                                           "axes")], [b2])
+            gb.node("Add", [in_names[0], b2], out_names)
+        else:
+            gb.node("Add", in_names, out_names)
+    elif cls in _SIMPLE_EXPORT:
+        gb.node(_SIMPLE_EXPORT[cls], in_names, out_names)
+    elif cls in ("SoftMax", "LogSoftMax"):
+        gb.node("Softmax" if cls == "SoftMax" else "LogSoftmax",
+                in_names, out_names, axis=op.axis)
+    elif cls == "Clip":
+        ins = list(in_names)
+        ins.append(gb.const(np.float32(op.min), "clip_min")
+                   if op.min is not None else "")
+        if op.max is not None:
+            ins.append(gb.const(np.float32(op.max), "clip_max"))
+        gb.node("Clip", ins, out_names)
+    elif cls == "Elu":
+        gb.node("Elu", in_names, out_names, alpha=op.alpha)
+    elif cls == "SeLU":
+        gb.node("Selu", in_names, out_names, alpha=op.alpha, gamma=op.gamma)
+    elif cls == "LeakyRelu":
+        gb.node("LeakyRelu", in_names, out_names, alpha=op.a)
+    elif cls == "HardSigmoid":
+        gb.node("HardSigmoid", in_names, out_names, alpha=op.alpha,
+                beta=op.gamma)
+    elif cls == "Cast":
+        gb.node("Cast", in_names, out_names,
+                to=int(_NP2ONNX[np.dtype(op.to)]))
+    elif cls == "Gemm":
+        gb.node("Gemm", in_names, out_names, alpha=op.alpha, beta=op.beta,
+                transA=op.transA, transB=op.transB)
+    elif cls == "Reshape":
+        shape = gb.const(np.asarray(op.shape, np.int64), "shape")
+        gb.node("Reshape", [in_names[0], shape], out_names)
+    elif cls == "Flatten":
+        gb.node("Flatten", in_names, out_names, axis=op.axis)
+    elif cls == "Transpose":
+        gb.node("Transpose", in_names, out_names, perm=op.axes)
+    elif cls == "Concat":
+        gb.node("Concat", in_names, out_names, axis=op.axis)
+    elif cls == "Slice":
+        ins = [in_names[0],
+               gb.const(np.asarray(op.starts, np.int64), "starts"),
+               gb.const(np.asarray(op.ends, np.int64), "ends"),
+               gb.const(np.asarray(op.axes, np.int64), "axes"),
+               gb.const(np.asarray(op.steps, np.int64), "steps")]
+        gb.node("Slice", ins, out_names)
+    elif cls == "SplitOp":
+        gb.node("Split", [in_names[0],
+                          gb.const(np.asarray(op.parts, np.int64), "split")],
+                out_names, axis=op.axis)
+    elif cls == "Gather":
+        idx = gb.const(np.asarray(op.indices, np.int64), "indices")
+        gb.node("Gather", [in_names[0], idx], out_names, axis=op.axis)
+    elif cls == "Embedding":
+        # Re-link the lookup to the live graph value feeding the
+        # indices (usually the token-id input); bake only if untraceable.
+        idx = resolve(getattr(op, "_indices_src", None))
+        if idx is None:
+            idx = gb.const(np.asarray(op.indices, np.int64), "indices")
+        gb.node("Gather", [in_names[0], idx], out_names, axis=0)
+    elif cls == "Tile":
+        gb.node("Tile", [in_names[0],
+                         gb.const(np.asarray(op.repeats, np.int64),
+                                  "repeats")], out_names)
+    elif cls == "Squeeze":
+        ax = op.axis
+        ins = [in_names[0]]
+        if ax is not None:
+            axes = [ax] if isinstance(ax, int) else list(ax)
+            ins.append(gb.const(np.asarray(axes, np.int64), "axes"))
+        gb.node("Squeeze", ins, out_names)
+    elif cls == "Unsqueeze":
+        gb.node("Unsqueeze",
+                [in_names[0],
+                 gb.const(np.asarray(op.axis, np.int64), "axes")], out_names)
+    elif cls == "Pad":
+        ins = [in_names[0], gb.const(np.asarray(op.pads, np.int64), "pads"),
+               gb.const(np.float32(op.constant), "pad_value")]
+        gb.node("Pad", ins, out_names, mode=op.mode)
+    elif cls == "Expand":
+        gb.node("Expand", [in_names[0],
+                           gb.const(np.asarray(op.shape, np.int64),
+                                    "shape")], out_names)
+    elif cls == "DepthToSpace":
+        gb.node("DepthToSpace", in_names, out_names, blocksize=op.b,
+                mode=op.mode)
+    elif cls == "SpaceToDepth":
+        gb.node("SpaceToDepth", in_names, out_names, blocksize=op.b)
+    elif cls == "Where":
+        cond = gb.const(np.asarray(op.cond).astype(np.bool_), "cond")
+        gb.node("Where", [cond] + list(in_names), out_names)
+    elif cls == "OneHot":
+        ins = [in_names[0],
+               gb.const(np.asarray(op.depth, np.int64), "depth"),
+               gb.const(np.asarray([0.0, 1.0], np.float32), "values")]
+        gb.node("OneHot", ins, out_names, axis=op.axis)
+    elif cls in ("ReduceSum",):
+        ins = [in_names[0]]
+        if op.axes is not None:
+            ins.append(gb.const(np.asarray(op.axes, np.int64), "axes"))
+        gb.node("ReduceSum", ins, out_names, keepdims=int(op.keepdims))
+    elif cls in ("ReduceMean", "Max", "Min"):
+        onnx_op = {"ReduceMean": "ReduceMean", "Max": "ReduceMax",
+                   "Min": "ReduceMin"}[cls]
+        gb.node(onnx_op, in_names, out_names, axes=op.axes,
+                keepdims=int(op.keepdims))
+    elif cls == "Dropout":
+        gb.node("Dropout",
+                [in_names[0], gb.const(np.float32(op.ratio), "ratio")],
+                out_names)
+    elif cls == "LayerNorm":
+        gb.node("LayerNormalization", in_names, out_names, axis=-1,
+                epsilon=op.eps)
+    elif cls == "_Conv2d":
+        h = op.handle
+        ph, pw = h.padding
+        gb.node("Conv", in_names, out_names, kernel_shape=h.kernel_size,
+                strides=h.stride, pads=[ph, pw, ph, pw],
+                dilations=h.dilation, group=h.groups)
+    elif cls == "_Pooling2d":
+        h = op.handle
+        ph, pw = h.padding
+        gb.node("MaxPool" if h.is_max else "AveragePool", in_names,
+                out_names, kernel_shape=h.kernel_size, strides=h.stride,
+                pads=[ph, pw, ph, pw])
+    elif cls == "_BatchNorm2d":
+        mean = gb.const(np.asarray(op.rm), "running_mean")
+        var = gb.const(np.asarray(op.rv), "running_var")
+        gb.node("BatchNormalization",
+                list(in_names) + [mean, var], out_names,
+                epsilon=op.handle.eps, momentum=1.0 - op.handle.factor)
+    elif cls == "_ConvTranspose2d":
+        h = op.handle
+        ph, pw = h.padding
+        gb.node("ConvTranspose", in_names, out_names,
+                kernel_shape=h.kernel_size, strides=h.stride,
+                pads=[ph, pw, ph, pw],
+                output_padding=list(h.output_padding), group=h.groups)
+    elif cls == "InstanceNorm":
+        gb.node("InstanceNormalization", in_names, out_names,
+                epsilon=op.eps)
+    elif cls == "ScatterElements":
+        ins = [in_names[0],
+               gb.const(np.asarray(op.indices, np.int64), "indices"),
+               gb.const(np.asarray(op.updates), "updates")]
+        gb.node("ScatterElements", ins, out_names, axis=op.axis)
+    elif cls == "Einsum":
+        gb.node("Einsum", in_names, out_names, equation=op.equation)
+    else:
+        raise ValueError(
+            f"sonnx export: op {cls} has no ONNX mapping "
+            "(reference sonnx.py raises the same way for unsupported ops)")
+
+
+def _topo_ops(outputs: Sequence[Tensor]) -> List:
+    seen, order, stack = set(), [], []
+    for y in outputs:
+        if y.creator is not None:
+            stack.append((y.creator, False))
+    while stack:
+        op, done = stack.pop()
+        if done:
+            order.append(op)
+            continue
+        if id(op) in seen:
+            continue
+        seen.add(id(op))
+        stack.append((op, True))
+        for t in op.inputs:
+            if t.creator is not None and id(t.creator) not in seen:
+                stack.append((t.creator, False))
+    return order
+
+
+def to_onnx(model, inputs: Sequence[Tensor],
+            model_name: str = "singa_tpu") -> P.ModelProto:
+    """Export `model.forward(*inputs)` as an ONNX ModelProto.
+
+    Reference: `SingaFrontend.to_onnx` / `sonnx.to_onnx(inputs, y)` —
+    runs one eager forward to materialize the creator graph, then
+    serializes it (graph mode is temporarily ignored; the exported
+    graph is the same program).
+    """
+    was_training = getattr(model, "training", False)
+    if hasattr(model, "eval"):
+        model.eval()
+    ins = list(inputs)
+    saved_rg = [t.requires_grad for t in ins]
+    for t in ins:
+        t.requires_grad = True  # ensure creator links are recorded
+    try:
+        y = model.forward(*ins) if hasattr(model, "forward") else model(*ins)
+    finally:
+        for t, rg in zip(ins, saved_rg):
+            t.requires_grad = rg
+        if hasattr(model, "train") and was_training:
+            model.train(True)
+    outputs = list(y) if isinstance(y, (tuple, list)) else [y]
+
+    mp = P.ModelProto()
+    mp.ir_version = IR_VERSION
+    mp.producer_name = "singa_tpu"
+    op_set = mp.opset_import.add()
+    op_set.domain = ""
+    op_set.version = OPSET_VERSION
+    g = mp.graph
+    g.name = model_name
+    gb = _GraphBuilder(g)
+
+    names: Dict[int, str] = {}
+    if hasattr(model, "get_params"):
+        for pname, pt in model.get_params().items():
+            names[id(pt)] = pname
+            g.initializer.append(to_tensor_proto(pname, pt.to_numpy()))
+    for i, t in enumerate(ins):
+        names[id(t)] = f"input_{i}"
+        vi = g.input.add()
+        vi.name = f"input_{i}"
+        vi.type.tensor_type.elem_type = _elem_type(t.dtype)
+        for d in t.shape:
+            vi.type.tensor_type.shape.dim.add().dim_value = d
+
+    out_name: Dict[tuple, str] = {}
+
+    def _in_name(t: Tensor) -> str:
+        if t.creator is not None:
+            return out_name[(id(t.creator), getattr(t, "creator_index", 0))]
+        if id(t) in names:
+            return names[id(t)]
+        names[id(t)] = gb.const(t.to_numpy(), "capture")
+        return names[id(t)]
+
+    def _resolve(t) -> Optional[str]:
+        if t is None:
+            return None
+        if t.creator is not None:
+            return out_name.get(
+                (id(t.creator), getattr(t, "creator_index", 0)))
+        return names.get(id(t))
+
+    for op in _topo_ops(outputs):
+        in_names = [_in_name(t) for t in op.inputs]
+        outs = []
+        for i in range(op.num_outputs):
+            nm = f"{op.name}_out{i}".replace("#", "_")
+            out_name[(id(op), i)] = nm
+            outs.append(nm)
+        _export_node(op, in_names, outs, gb, resolve=_resolve)
+
+    for i, t in enumerate(outputs):
+        nm = (out_name[(id(t.creator), getattr(t, "creator_index", 0))]
+              if t.creator is not None else _in_name(t))
+        vo = g.output.add()
+        vo.name = nm
+        vo.type.tensor_type.elem_type = _elem_type(t.dtype)
+        for d in t.shape:
+            vo.type.tensor_type.shape.dim.add().dim_value = d
+    return mp
+
+
+# ===========================================================================
+# Import: ONNX graph → autograd ops (reference: SingaBackend / SingaRep)
+# ===========================================================================
+class _ImportCtx:
+    """Execution context: resolves node input names to live Tensors or
+    compile-time constants (initializers / Constant nodes)."""
+
+    def __init__(self, device):
+        self.device = device
+        self.values: Dict[str, Tensor] = {}
+        self.consts: Dict[str, np.ndarray] = {}
+
+    def tensor(self, name: str) -> Tensor:
+        if name in self.values:
+            return self.values[name]
+        if name in self.consts:
+            t = tensor_mod.from_numpy(
+                np.asarray(self.consts[name]), device=self.device)
+            self.values[name] = t
+            return t
+        raise KeyError(f"sonnx: undefined graph value {name!r}")
+
+    def const(self, name: str) -> Optional[np.ndarray]:
+        if name in self.consts:
+            return self.consts[name]
+        t = self.values.get(name)
+        if t is not None and t.creator is None:
+            return t.to_numpy()
+        return None
+
+
+def _sym_pads(node) -> tuple:
+    """Decode ONNX pads [hb, wb, he, we] to the symmetric (ph, pw) the
+    handles support; reject asymmetric padding / auto_pad rather than
+    silently computing the wrong thing."""
+    if _attr(node, "auto_pad", "NOTSET") not in ("NOTSET", ""):
+        raise ValueError(
+            f"sonnx: auto_pad is unsupported (node {node.op_type}); "
+            "re-export with explicit pads")
+    pads = list(_attr(node, "pads", [0, 0, 0, 0]))
+    if len(pads) == 2:
+        pads = pads * 2
+    if pads[0] != pads[2] or pads[1] != pads[3]:
+        raise ValueError(
+            f"sonnx: asymmetric pads {pads} unsupported "
+            f"(node {node.op_type})")
+    return pads[0], pads[1]
+
+
+def _pool_handle(node, is_max):
+    ks = _attr(node, "kernel_shape")
+    cip = bool(_attr(node, "count_include_pad", 0))
+    return native.PoolingHandle(tuple(ks),
+                                tuple(_attr(node, "strides", [1, 1])),
+                                _sym_pads(node), is_max=is_max,
+                                count_include_pad=cip)
+
+
+def _import_conv(ctx, node):
+    x = ctx.tensor(node.input[0])
+    w = ctx.tensor(node.input[1])
+    b = (ctx.tensor(node.input[2])
+         if len(node.input) > 2 and node.input[2] else None)
+    group = _attr(node, "group", 1)
+    o, cpg, kh, kw = w.shape
+    handle = native.ConvHandle(
+        cpg * group, o, (kh, kw),
+        stride=tuple(_attr(node, "strides", [1, 1])),
+        padding=_sym_pads(node),
+        dilation=tuple(_attr(node, "dilations", [1, 1])),
+        groups=group, bias=b is not None)
+    return autograd.conv2d(handle, x, w, b)
+
+
+def _import_convtranspose(ctx, node):
+    x = ctx.tensor(node.input[0])
+    w = ctx.tensor(node.input[1])  # IOHW: (C_in, C_out/g, kh, kw)
+    b = (ctx.tensor(node.input[2])
+         if len(node.input) > 2 and node.input[2] else None)
+    # Reject what the handle cannot represent rather than silently
+    # computing the wrong shape (the _sym_pads convention).
+    if list(_attr(node, "dilations", [1, 1])) != [1, 1]:
+        raise ValueError("sonnx: ConvTranspose dilations != 1 "
+                         "unsupported")
+    if _attr(node, "output_shape") is not None:
+        raise ValueError("sonnx: ConvTranspose output_shape is "
+                         "unsupported; re-export with explicit pads/"
+                         "output_padding")
+    group = _attr(node, "group", 1)
+    cin, cog, kh, kw = w.shape
+    opads = tuple(_attr(node, "output_padding", [0, 0]))
+    handle = native.ConvTransposeHandle(
+        cin, cog * group, (kh, kw),
+        stride=tuple(_attr(node, "strides", [1, 1])),
+        padding=_sym_pads(node),
+        output_padding=opads,
+        groups=group, bias=b is not None)
+    return autograd.conv_transpose2d(handle, x, w, b)
+
+
+def _import_instancenorm(ctx, node):
+    return autograd.InstanceNorm(_attr(node, "epsilon", 1e-5))(
+        ctx.tensor(node.input[0]), ctx.tensor(node.input[1]),
+        ctx.tensor(node.input[2]))
+
+
+def _import_scatter(ctx, node):
+    indices = ctx.const(node.input[1])
+    updates = ctx.const(node.input[2])
+    if indices is None or updates is None:
+        raise ValueError(
+            "sonnx: ScatterElements indices/updates must be "
+            "constants/initializers")
+    if _attr(node, "reduction", "none") != "none":
+        raise ValueError("sonnx: ScatterElements reduction != 'none' "
+                         "unsupported")
+    return autograd.ScatterElements(
+        indices, updates, _attr(node, "axis", 0))(
+        ctx.tensor(node.input[0]))
+
+
+def _import_einsum(ctx, node):
+    return autograd.Einsum(_attr(node, "equation"))(
+        *[ctx.tensor(i) for i in node.input])
+
+
+def _import_bn(ctx, node):
+    x = ctx.tensor(node.input[0])
+    scale = ctx.tensor(node.input[1])
+    bias = ctx.tensor(node.input[2])
+    mean = ctx.tensor(node.input[3])
+    var = ctx.tensor(node.input[4])
+    handle = native.BatchNormHandle(
+        factor=1.0 - _attr(node, "momentum", 0.9),
+        eps=_attr(node, "epsilon", 1e-5))
+    op = autograd._BatchNorm2d(handle, mean, var)
+    y = op(x, scale, bias)
+    # Training mode: rebind the updated running stats onto the live
+    # mean/var tensors (the native layer does the same, layer.py
+    # BatchNorm2d.forward) so fine-tuning moves them and graph-mode
+    # captures them as state outputs.
+    if autograd.training and op.new_running_mean is not None:
+        mean.data = op.new_running_mean
+        var.data = op.new_running_var
+    return y
+
+
+def _import_gemm(ctx, node):
+    a = ctx.tensor(node.input[0])
+    b = ctx.tensor(node.input[1])
+    cs = ([ctx.tensor(node.input[2])] if len(node.input) > 2
+          and node.input[2] else [])
+    return autograd.Gemm(_attr(node, "alpha", 1.0),
+                         _attr(node, "beta", 1.0),
+                         _attr(node, "transA", 0),
+                         _attr(node, "transB", 0))(a, b, *cs)
+
+
+def _import_reshape(ctx, node):
+    x = ctx.tensor(node.input[0])
+    shape = _attr(node, "shape")
+    if shape is None:
+        shape = ctx.const(node.input[1])
+        if shape is None:
+            raise ValueError("sonnx: dynamic Reshape shape unsupported")
+    shape = [int(s) for s in np.asarray(shape).ravel()]
+    shape = [x.shape[i] if s == 0 else s for i, s in enumerate(shape)]
+    return autograd.reshape(x, shape)
+
+
+def _req_const(ctx, node, idx, what) -> np.ndarray:
+    c = ctx.const(node.input[idx])
+    if c is None:
+        raise ValueError(
+            f"sonnx: {node.op_type} with a runtime-computed {what} is "
+            "unsupported (must be a constant/initializer)")
+    return c
+
+
+def _import_slice(ctx, node):
+    x = ctx.tensor(node.input[0])
+    if len(node.input) > 1:
+        starts = _req_const(ctx, node, 1, "starts").tolist()
+        ends = _req_const(ctx, node, 2, "ends").tolist()
+        axes = (_req_const(ctx, node, 3, "axes").tolist()
+                if len(node.input) > 3 and node.input[3] else None)
+        steps = (_req_const(ctx, node, 4, "steps").tolist()
+                 if len(node.input) > 4 and node.input[4] else None)
+    else:
+        starts = _attr(node, "starts")
+        ends = _attr(node, "ends")
+        axes = _attr(node, "axes")
+        steps = None
+    return autograd.Slice(starts, ends, axes, steps)(x)
+
+
+def _axes_arg(ctx, node, idx=1):
+    if len(node.input) > idx and node.input[idx]:
+        c = ctx.const(node.input[idx])
+        return None if c is None else [int(v) for v in c.ravel()]
+    a = _attr(node, "axes")
+    return None if a is None else list(a)
+
+
+def _import_cast(ctx, node):
+    to = _ONNX2NP[_attr(node, "to")]
+    return autograd.cast(ctx.tensor(node.input[0]), to)
+
+
+def _import_dropout(ctx, node):
+    # Inference-mode import: identity (reference backend does the same).
+    return autograd.Identity()(ctx.tensor(node.input[0]))
+
+
+def _import_layernorm(ctx, node):
+    x = ctx.tensor(node.input[0])
+    g = ctx.tensor(node.input[1])
+    b = (ctx.tensor(node.input[2]) if len(node.input) > 2 and node.input[2]
+         else tensor_mod.from_numpy(
+             np.zeros(g.shape, np.float32), device=ctx.device))
+    axis = _attr(node, "axis", -1)
+    # Positive last-axis spellings (e.g. axis=2 on rank-3) are the same
+    # computation; only genuinely non-last-axis normalization is refused.
+    if axis is not None and axis % len(x.shape) != len(x.shape) - 1:
+        raise ValueError(
+            "sonnx: LayerNormalization only supports last-axis "
+            f"normalization (got axis={axis} for rank {len(x.shape)})")
+    return autograd.layer_norm(x, g, b, eps=_attr(node, "epsilon", 1e-5))
+
+
+def _import_constant(ctx, node):
+    val = _attr(node, "value")
+    ctx.consts[node.output[0]] = np.asarray(val)
+    return None
+
+
+def _import_pad(ctx, node):
+    x = ctx.tensor(node.input[0])
+    mode = _attr(node, "mode", "constant")
+    if len(node.input) > 1:
+        pads = _req_const(ctx, node, 1, "pads").tolist()
+        cval = (float(_req_const(ctx, node, 2, "value"))
+                if len(node.input) > 2 and node.input[2] else 0.0)
+    else:
+        pads = _attr(node, "pads")
+        cval = _attr(node, "value", 0.0)
+    return autograd.Pad(mode, pads, cval)(x)
+
+
+def _import_where(ctx, node):
+    cond = ctx.const(node.input[0])
+    if cond is None:
+        raise ValueError(
+            "sonnx: Where with a runtime-computed condition is "
+            "unsupported (condition must be a constant/initializer)")
+    return autograd.Where(cond)(ctx.tensor(node.input[1]),
+                                ctx.tensor(node.input[2]))
+
+
+def _import_onehot(ctx, node):
+    depth = ctx.const(node.input[1])
+    values = ctx.const(node.input[2])
+    if depth is None or values is None:
+        raise ValueError("sonnx: OneHot depth/values must be constants")
+    if not np.allclose(np.asarray(values).ravel(), [0.0, 1.0]):
+        raise ValueError("sonnx: OneHot only supports values [0, 1]")
+    return autograd.OneHot(int(np.asarray(depth).ravel()[0]),
+                           _attr(node, "axis", -1))(
+        ctx.tensor(node.input[0]))
+
+
+def _simple(op_factory):
+    return lambda ctx, node: op_factory()(
+        *[ctx.tensor(i) for i in node.input if i])
+
+
+_IMPORTERS = {
+    "Relu": _simple(autograd.ReLU),
+    "Sigmoid": _simple(autograd.Sigmoid),
+    "Tanh": _simple(autograd.Tanh),
+    "Abs": _simple(autograd.Abs),
+    "Exp": _simple(autograd.Exp),
+    "Log": _simple(autograd.Log),
+    "Sqrt": _simple(autograd.Sqrt),
+    "Neg": _simple(autograd.Negative),
+    "Reciprocal": _simple(autograd.Reciprocal),
+    "Erf": _simple(autograd.Erf),
+    "Ceil": _simple(autograd.Ceil),
+    "Floor": _simple(autograd.Floor),
+    "Round": _simple(autograd.Round),
+    "Sign": _simple(autograd.Sign),
+    "Cos": _simple(autograd.Cos), "Sin": _simple(autograd.Sin),
+    "Tan": _simple(autograd.Tan), "Acos": _simple(autograd.Acos),
+    "Asin": _simple(autograd.Asin), "Atan": _simple(autograd.Atan),
+    "Cosh": _simple(autograd.Cosh), "Sinh": _simple(autograd.Sinh),
+    "Acosh": _simple(autograd.Acosh), "Asinh": _simple(autograd.Asinh),
+    "Atanh": _simple(autograd.Atanh),
+    "Softplus": _simple(autograd.SoftPlus),
+    "Softsign": _simple(autograd.SoftSign),
+    "Gelu": _simple(autograd.Gelu),
+    "Identity": _simple(autograd.Identity),
+    "Add": _simple(autograd.Add), "Sub": _simple(autograd.Sub),
+    "Mul": _simple(autograd.Mul), "Div": _simple(autograd.Div),
+    "Pow": _simple(autograd.Pow),
+    "Min": _simple(autograd.Minimum), "Max": _simple(autograd.Maximum),
+    "Less": _simple(autograd.Less), "Greater": _simple(autograd.Greater),
+    "Equal": _simple(autograd.Equal),
+    "MatMul": _simple(autograd.Mult),
+    "GlobalAveragePool": _simple(autograd.GlobalAveragePool),
+    "Softmax": lambda ctx, n: autograd.SoftMax(_attr(n, "axis", -1))(
+        ctx.tensor(n.input[0])),
+    "LogSoftmax": lambda ctx, n: autograd.LogSoftMax(_attr(n, "axis", -1))(
+        ctx.tensor(n.input[0])),
+    "Elu": lambda ctx, n: autograd.Elu(_attr(n, "alpha", 1.0))(
+        ctx.tensor(n.input[0])),
+    "Selu": lambda ctx, n: autograd.SeLU(
+        _attr(n, "alpha", 1.67326), _attr(n, "gamma", 1.0507))(
+        ctx.tensor(n.input[0])),
+    "LeakyRelu": lambda ctx, n: autograd.LeakyRelu(
+        _attr(n, "alpha", 0.01))(ctx.tensor(n.input[0])),
+    "HardSigmoid": lambda ctx, n: autograd.HardSigmoid(
+        _attr(n, "alpha", 0.2), _attr(n, "beta", 0.5))(
+        ctx.tensor(n.input[0])),
+    "Clip": lambda ctx, n: autograd.Clip(
+        float(_req_const(ctx, n, 1, "min")) if len(n.input) > 1
+        and n.input[1] else _attr(n, "min"),
+        float(_req_const(ctx, n, 2, "max")) if len(n.input) > 2
+        and n.input[2] else _attr(n, "max"))(ctx.tensor(n.input[0])),
+    "Cast": _import_cast,
+    "Gemm": _import_gemm,
+    "Conv": _import_conv,
+    "BatchNormalization": _import_bn,
+    "MaxPool": lambda ctx, n: autograd.pooling_2d(
+        _pool_handle(n, True), ctx.tensor(n.input[0])),
+    "AveragePool": lambda ctx, n: autograd.pooling_2d(
+        _pool_handle(n, False), ctx.tensor(n.input[0])),
+    "Reshape": _import_reshape,
+    "Flatten": lambda ctx, n: autograd.flatten(
+        ctx.tensor(n.input[0]), _attr(n, "axis", 1)),
+    "Transpose": lambda ctx, n: autograd.transpose(
+        ctx.tensor(n.input[0]), _attr(n, "perm")),
+    "Concat": lambda ctx, n: autograd.cat(
+        [ctx.tensor(i) for i in n.input], _attr(n, "axis", 0)),
+    "Slice": _import_slice,
+    "Split": lambda ctx, n: autograd.SplitOp(
+        _attr(n, "axis", 0),
+        (_req_const(ctx, n, 1, "split sizes").tolist() if len(n.input) > 1
+         else _attr(n, "split")))(ctx.tensor(n.input[0])),
+    "Gather": lambda ctx, n: autograd.Gather(
+        _attr(n, "axis", 0), ctx.tensor(n.input[1]))(ctx.tensor(n.input[0])),
+    "Tile": lambda ctx, n: autograd.Tile(
+        _req_const(ctx, n, 1, "repeats").tolist())(ctx.tensor(n.input[0])),
+    "Squeeze": lambda ctx, n: autograd.Squeeze(
+        _axes_arg(ctx, n))(ctx.tensor(n.input[0])),
+    "Unsqueeze": lambda ctx, n: autograd.Unsqueeze(
+        _axes_arg(ctx, n))(ctx.tensor(n.input[0])),
+    "Pad": _import_pad,
+    "Expand": lambda ctx, n: autograd.Expand(
+        _req_const(ctx, n, 1, "shape").tolist())(ctx.tensor(n.input[0])),
+    "DepthToSpace": lambda ctx, n: autograd.DepthToSpace(
+        _attr(n, "blocksize"), _attr(n, "mode", "DCR"))(
+        ctx.tensor(n.input[0])),
+    "SpaceToDepth": lambda ctx, n: autograd.SpaceToDepth(
+        _attr(n, "blocksize"))(ctx.tensor(n.input[0])),
+    "Where": _import_where,
+    "OneHot": _import_onehot,
+    "ReduceSum": lambda ctx, n: autograd.ReduceSum(
+        _axes_arg(ctx, n), _attr(n, "keepdims", 1))(ctx.tensor(n.input[0])),
+    "ReduceMean": lambda ctx, n: autograd.ReduceMean(
+        _attr(n, "axes"), _attr(n, "keepdims", 1))(ctx.tensor(n.input[0])),
+    "ReduceMax": lambda ctx, n: autograd.Max(
+        _attr(n, "axes"), _attr(n, "keepdims", 1))(ctx.tensor(n.input[0])),
+    "ReduceMin": lambda ctx, n: autograd.Min(
+        _attr(n, "axes"), _attr(n, "keepdims", 1))(ctx.tensor(n.input[0])),
+    "Dropout": _import_dropout,
+    "LayerNormalization": _import_layernorm,
+    "Constant": _import_constant,
+    "ConvTranspose": _import_convtranspose,
+    "InstanceNormalization": _import_instancenorm,
+    "ScatterElements": _import_scatter,
+    "Einsum": _import_einsum,
+}
+
+
+class SingaRep:
+    """Executable imported graph. Reference: `sonnx.SingaRep` —
+    `run(inputs)` returns output Tensors; execution goes through the
+    autograd ops so results are differentiable."""
+
+    def __init__(self, model_proto: P.ModelProto, device=None,
+                 init_inputs: Optional[Sequence] = None):
+        self.model_proto = model_proto
+        self.device = device or get_default_device()
+        g = model_proto.graph
+        self.params: "OrderedDict[str, Tensor]" = OrderedDict()
+        self._init_names = set()
+        for tp in g.initializer:
+            arr = to_numpy(tp)
+            self._init_names.add(tp.name)
+            t = tensor_mod.from_numpy(arr, device=self.device)
+            self.params[tp.name] = t
+        self.input_names = [vi.name for vi in g.input
+                            if vi.name not in self._init_names]
+        self.output_names = [vo.name for vo in g.output]
+        self.nodes = list(g.node)
+        unsupported = sorted({n.op_type for n in self.nodes
+                              if n.op_type not in _IMPORTERS})
+        if unsupported:
+            raise ValueError(f"sonnx: unsupported ONNX ops {unsupported}")
+
+    def run(self, inputs: Sequence) -> List[Tensor]:
+        ctx = _ImportCtx(self.device)
+        for name, t in self.params.items():
+            ctx.values[name] = t
+        if len(inputs) != len(self.input_names):
+            raise ValueError(
+                f"expected {len(self.input_names)} inputs "
+                f"({self.input_names}), got {len(inputs)}")
+        for name, x in zip(self.input_names, inputs):
+            if not isinstance(x, Tensor):
+                x = tensor_mod.from_numpy(np.asarray(x), device=self.device)
+            ctx.values[name] = x
+        for node in self.nodes:
+            out = _IMPORTERS[node.op_type](ctx, node)
+            if out is None:  # Constant: registered as const
+                continue
+            outs = out if isinstance(out, tuple) else (out,)
+            for name, t in zip(node.output, outs):
+                ctx.values[name] = t
+        return [ctx.tensor(n) for n in self.output_names]
+
+
+class SingaBackend:
+    """Reference: `sonnx.SingaBackend(onnx.backend.base.Backend)`."""
+
+    @staticmethod
+    def prepare(model_proto: P.ModelProto, device=None, **kwargs) -> SingaRep:
+        return SingaRep(model_proto, device)
+
+
+def prepare(model_proto, device=None, **kwargs) -> SingaRep:
+    """Reference: `sonnx.prepare(model, device)`."""
+    if isinstance(model_proto, (str, bytes)):
+        model_proto = load(model_proto)
+    return SingaBackend.prepare(model_proto, device, **kwargs)
+
+
+class SONNXModel(model_mod.Model):
+    """Reference: `sonnx.SONNXModel` — a `Model` over an imported ONNX
+    graph; subclass and override `forward(self, *x)` (calling
+    `super().forward`) and `train_one_batch` to fine-tune (the BERT
+    workflow, SURVEY.md §3.4). Initializers become trainable params, so
+    `compile(use_graph=True)` jits the imported graph like any native
+    model, including mesh mode.
+    """
+
+    def __init__(self, onnx_model, device=None):
+        super().__init__()
+        if isinstance(onnx_model, (str, bytes)):
+            onnx_model = load(onnx_model)
+        self.rep = SingaRep(onnx_model, device)
+        # BN running stats are state, not trainable params (the native
+        # BatchNorm2d layer registers them the same way).
+        stat_names = set()
+        for node in self.rep.nodes:
+            if node.op_type == "BatchNormalization":
+                stat_names.update(node.input[3:5])
+        self._onnx_param_names = {}
+        for name, t in self.rep.params.items():
+            if not np.issubdtype(np.dtype(t.dtype), np.floating):
+                continue
+            attr = "p_" + "".join(c if c.isalnum() else "_" for c in name)
+            self._onnx_param_names[attr] = name
+            if name in stat_names:
+                self.register_state(attr, t)
+            else:
+                self.register_param(attr, t)
+
+    def forward(self, *x, aux_output=()):
+        outs = self.rep.run(list(x))
+        aux = [self.rep.params[n] if n in self.rep.params else None
+               for n in aux_output]
+        if aux_output:
+            return tuple(outs) + tuple(aux)
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    def train_one_batch(self, x, y):
+        out = self.forward(x)
+        out0 = out[0] if isinstance(out, tuple) else out
+        loss = autograd.softmax_cross_entropy(out0, y)
+        self._optimizer.backward_and_update(loss)
+        return out, loss

@@ -1,0 +1,793 @@
+"""Tensor façade + free math functions.
+
+Reference parity: this single module covers two reference layers —
+  - `include/singa/core/tensor.h` / `src/core/tensor/tensor.cc`
+    (`singa::Tensor`: shape/stride/Block*/Device*/DataType + ~120 free
+    functions dispatched by `TYPE_LANG_SWITCH`), and
+  - `python/singa/tensor.py` (the Python wrapper with operator sugar
+    and the numpy bridge).
+
+TPU-native redesign: there is no Block/stride machinery — a Tensor
+wraps one immutable `jax.Array` (PJRT buffer) plus framework metadata
+(device, requires_grad/stores_grad, creator link for autograd). All
+math lowers to jnp/lax, i.e. per-op XLA programs cached by shape+dtype;
+the reference's `tensor_math_cuda.h` kernel catalogue (KernelAdd,
+KernelRelu, KernelRowMax, ...) maps 1:1 onto these functions. In-place
+reference methods (`Tensor::Add` on self, `Axpy`) become rebinding of
+`.data` — semantics preserved at the Python API level.
+
+The functions here are *non-differentiable* primitives, exactly like
+the reference's C++ free functions; differentiable ops live in
+`singa_tpu.autograd` (the op registry).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .device import Device, get_default_device
+
+# ---------------------------------------------------------------------------
+# DataType registry. Reference: `singa::DataType` enum (proto/core.proto:
+# kFloat32, kFloat16, kInt, kChar, kDouble) + AsType dispatch.
+# ---------------------------------------------------------------------------
+float32 = jnp.float32
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+int32 = jnp.int32
+int64 = jnp.int64
+uint8 = jnp.uint8
+bool_ = jnp.bool_
+
+# Reference enum names, kept for migration.
+kFloat32 = float32
+kFloat16 = float16
+kBFloat16 = bfloat16
+kInt = int32
+kChar = jnp.int8  # reference kChar is signed char
+kDouble = jnp.float64
+
+_DTYPES = {
+    "float32": float32,
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "int32": int32,
+    "int64": int64,
+    "uint8": uint8,
+    "bool": bool_,
+}
+
+
+def _as_dtype(dt):
+    if dt is None:
+        return float32
+    if isinstance(dt, str):
+        return _DTYPES[dt]
+    return dt
+
+
+class Tensor:
+    """N-d array on a Device.
+
+    Reference: `singa::Tensor` + python `tensor.Tensor`. Attributes
+    `requires_grad` / `stores_grad` and the `creator` link are consumed
+    by `singa_tpu.autograd` exactly as in the reference's autograd
+    (`python/singa/autograd.py`: creator-pointer DAG, no global tape).
+    """
+
+    __slots__ = (
+        "data",
+        "device",
+        "requires_grad",
+        "stores_grad",
+        "creator",
+        "creator_index",  # which output of `creator` this tensor is
+        "name",
+    )
+
+    def __init__(
+        self,
+        shape: Sequence[int] = (),
+        device: Optional[Device] = None,
+        dtype=float32,
+        data=None,
+        requires_grad: bool = True,
+        stores_grad: bool = False,
+        creator=None,
+        name: Optional[str] = None,
+    ):
+        self.device = device or get_default_device()
+        dtype = _as_dtype(dtype)
+        if data is None:
+            arr = jnp.zeros(tuple(shape), dtype=dtype)
+        elif isinstance(data, (np.ndarray, list, tuple, float, int)):
+            arr = jnp.asarray(data, dtype=dtype)
+        else:  # jax array — keep its dtype unless caller asked otherwise
+            arr = data if data.dtype == dtype else data.astype(dtype)
+        # Always commit the buffer to the requested device (no-op when
+        # already resident there).
+        self.data = self.device.put(arr)
+        self.requires_grad = requires_grad
+        self.stores_grad = stores_grad
+        self.creator = creator
+        self.creator_index = 0
+        self.name = name
+
+    # ---- metadata -------------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self.data.shape)
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    @property
+    def ndim(self):
+        return self.data.ndim
+
+    def size(self) -> int:
+        """Reference: `Tensor::Size` — element count."""
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    def memsize(self) -> int:
+        return self.size() * self.data.dtype.itemsize
+
+    def is_empty(self) -> bool:
+        return self.size() == 0
+
+    def is_transpose(self) -> bool:
+        """Reference keeps strides; XLA arrays are always dense/canonical."""
+        return False
+
+    # ---- conversion / movement -----------------------------------------
+    def to_numpy(self) -> np.ndarray:
+        return np.asarray(self.data)
+
+    def copy_from_numpy(self, np_array: np.ndarray, offset: int = 0) -> None:
+        """Reference: `Tensor::CopyDataFromHostPtr`. Rebinds the buffer."""
+        assert offset == 0, "offset copies unsupported on immutable buffers"
+        arr = np.ascontiguousarray(np_array)
+        if arr.size != self.size():
+            raise ValueError(
+                f"size mismatch: tensor {self.shape} vs array {arr.shape}"
+            )
+        self.data = self.device.put(
+            jnp.asarray(arr.reshape(self.shape), dtype=self.dtype)
+        )
+
+    def copy_data(self, t: "Tensor") -> None:
+        """Reference: `Tensor::CopyData` — copy from another tensor."""
+        self.data = jnp.asarray(t.data, dtype=self.dtype)
+
+    def to_device(self, dev: Device) -> "Tensor":
+        """Reference: `Tensor::ToDevice`. Returns self (mutating move)."""
+        self.data = dev.put(self.data)
+        self.device = dev
+        return self
+
+    def to_host(self) -> "Tensor":
+        return self.to_device(get_default_device())
+
+    def as_type(self, dtype) -> "Tensor":
+        """Reference: `Tensor::AsType` (e.g. KernelCastFloat2Half)."""
+        return _wrap(self.data.astype(_as_dtype(dtype)), self)
+
+    def clone(self) -> "Tensor":
+        """Reference: `Tensor::Clone` — deep copy (cheap: immutable buffer)."""
+        t = Tensor.__new__(Tensor)
+        t.data = self.data
+        t.device = self.device
+        t.requires_grad = self.requires_grad
+        t.stores_grad = self.stores_grad
+        t.creator = None
+        t.creator_index = 0
+        t.name = self.name
+        return t
+
+    # ---- shape ops ------------------------------------------------------
+    def reshape(self, shape) -> "Tensor":
+        return _wrap(jnp.reshape(self.data, tuple(shape)), self)
+
+    def transpose(self, axes=None) -> "Tensor":
+        """Reference: stride-based `Tensor::Transpose`; XLA materializes."""
+        return _wrap(jnp.transpose(self.data, axes), self)
+
+    @property
+    def T(self) -> "Tensor":
+        return self.transpose()
+
+    def broadcast(self, shape) -> "Tensor":
+        return _wrap(jnp.broadcast_to(self.data, tuple(shape)), self)
+
+    def repeat(self, repeats, axis=None) -> "Tensor":
+        """Reference: `Tensor::RepeatData`."""
+        return _wrap(jnp.repeat(self.data, repeats, axis=axis), self)
+
+    def squeeze(self, axis=None) -> "Tensor":
+        return _wrap(jnp.squeeze(self.data, axis=axis), self)
+
+    # ---- random fill ----------------------------------------------------
+    # Reference: curand-backed `Uniform/Gaussian/Bernoulli` free fns;
+    # here: counter-based threefry via the device key stream.
+    def gaussian(self, mean: float = 0.0, std: float = 1.0) -> None:
+        self.data = (
+            jax.random.normal(self.device.next_key(), self.shape, self.dtype)
+            * std
+            + mean
+        )
+
+    def uniform(self, low: float = 0.0, high: float = 1.0) -> None:
+        self.data = jax.random.uniform(
+            self.device.next_key(), self.shape, self.dtype, low, high
+        )
+
+    def bernoulli(self, p: float) -> None:
+        self.data = jax.random.bernoulli(
+            self.device.next_key(), p, self.shape
+        ).astype(self.dtype)
+
+    def set_value(self, x) -> None:
+        """Reference: `Tensor::SetValue` — fill with scalar."""
+        self.data = jnp.full(self.shape, x, dtype=self.dtype)
+
+    # ---- python protocol -------------------------------------------------
+    def __len__(self):
+        if not self.shape:
+            raise TypeError("len() of a 0-d tensor")
+        return self.shape[0]
+
+    def __repr__(self):
+        return (
+            f"Tensor(shape={self.shape}, dtype={self.dtype.name if hasattr(self.dtype, 'name') else self.dtype}, "
+            f"device={self.device.lang})"
+        )
+
+    def __float__(self):
+        assert self.size() == 1
+        return float(self.data)
+
+    def __int__(self):
+        assert self.size() == 1
+        return int(self.data)
+
+    def item(self):
+        return self.data.item()
+
+    def __getitem__(self, idx):
+        return _wrap(self.data[idx], self)
+
+    # ---- operator sugar (non-differentiable, like reference tensor.py) ---
+    def __add__(self, o):
+        return _wrap(self.data + _raw(o), self)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return _wrap(self.data - _raw(o), self)
+
+    def __rsub__(self, o):
+        return _wrap(_raw(o) - self.data, self)
+
+    def __mul__(self, o):
+        return _wrap(self.data * _raw(o), self)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return _wrap(self.data / _raw(o), self)
+
+    def __rtruediv__(self, o):
+        return _wrap(_raw(o) / self.data, self)
+
+    def __pow__(self, o):
+        return _wrap(self.data ** _raw(o), self)
+
+    def __neg__(self):
+        return _wrap(-self.data, self)
+
+    def __matmul__(self, o):
+        return _wrap(jnp.matmul(self.data, _raw(o)), self)
+
+    def __lt__(self, o):
+        return _wrap((self.data < _raw(o)).astype(float32), self)
+
+    def __le__(self, o):
+        return _wrap((self.data <= _raw(o)).astype(float32), self)
+
+    def __gt__(self, o):
+        return _wrap((self.data > _raw(o)).astype(float32), self)
+
+    def __ge__(self, o):
+        return _wrap((self.data >= _raw(o)).astype(float32), self)
+
+    # In-place (reference mutates Blocks; here rebinds buffer).
+    def __iadd__(self, o):
+        self.data = self.data + _raw(o)
+        return self
+
+    def __isub__(self, o):
+        self.data = self.data - _raw(o)
+        return self
+
+    def __imul__(self, o):
+        self.data = self.data * _raw(o)
+        return self
+
+    def __itruediv__(self, o):
+        self.data = self.data / _raw(o)
+        return self
+
+
+def _raw(x):
+    return x.data if isinstance(x, Tensor) else x
+
+
+def _wrap(arr, like: Tensor) -> Tensor:
+    t = Tensor.__new__(Tensor)
+    t.data = arr
+    t.device = like.device
+    t.requires_grad = False
+    t.stores_grad = False
+    t.creator = None
+    t.creator_index = 0
+    t.name = None
+    return t
+
+
+def _wrap_dev(arr, dev: Device) -> Tensor:
+    t = Tensor.__new__(Tensor)
+    t.data = arr
+    t.device = dev
+    t.requires_grad = False
+    t.stores_grad = False
+    t.creator = None
+    t.creator_index = 0
+    t.name = None
+    return t
+
+
+# ---------------------------------------------------------------------------
+# Constructors. Reference: python tensor.py `from_numpy`, `zeros_like`, ...
+# ---------------------------------------------------------------------------
+def from_numpy(np_array, device: Optional[Device] = None) -> Tensor:
+    np_array = np.asarray(np_array)
+    dev = device or get_default_device()
+    dtype = np_array.dtype
+    if dtype == np.float64:
+        dtype = np.float32
+    if dtype == np.int64:
+        dtype = np.int32
+    arr = dev.put(jnp.asarray(np_array, dtype=dtype))
+    return _wrap_dev(arr, dev)
+
+
+def from_raw(arr, device: Optional[Device] = None) -> Tensor:
+    """Wrap a raw jax array."""
+    return _wrap_dev(arr, device or get_default_device())
+
+
+def zeros(shape, device=None, dtype=float32) -> Tensor:
+    dev = device or get_default_device()
+    return _wrap_dev(dev.put(jnp.zeros(tuple(shape), _as_dtype(dtype))), dev)
+
+
+def ones(shape, device=None, dtype=float32) -> Tensor:
+    dev = device or get_default_device()
+    return _wrap_dev(dev.put(jnp.ones(tuple(shape), _as_dtype(dtype))), dev)
+
+
+def full(shape, value, device=None, dtype=float32) -> Tensor:
+    dev = device or get_default_device()
+    return _wrap_dev(dev.put(jnp.full(tuple(shape), value, _as_dtype(dtype))), dev)
+
+
+def zeros_like(t: Tensor) -> Tensor:
+    return _wrap(jnp.zeros_like(t.data), t)
+
+
+def ones_like(t: Tensor) -> Tensor:
+    return _wrap(jnp.ones_like(t.data), t)
+
+
+def arange(start, stop=None, step=1, device=None, dtype=float32) -> Tensor:
+    dev = device or get_default_device()
+    return _wrap_dev(dev.put(jnp.arange(start, stop, step, _as_dtype(dtype))), dev)
+
+
+def eye(n, device=None, dtype=float32) -> Tensor:
+    dev = device or get_default_device()
+    return _wrap_dev(dev.put(jnp.eye(n, dtype=_as_dtype(dtype))), dev)
+
+
+def random(shape, device=None) -> Tensor:
+    t = zeros(shape, device)
+    t.uniform(0.0, 1.0)
+    return t
+
+
+def gaussian(shape, mean=0.0, std=1.0, device=None) -> Tensor:
+    t = zeros(shape, device)
+    t.gaussian(mean, std)
+    return t
+
+
+def uniform(low, high, shape, device=None) -> Tensor:
+    t = zeros(shape, device)
+    t.uniform(low, high)
+    return t
+
+
+def bernoulli(p, shape, device=None) -> Tensor:
+    t = zeros(shape, device)
+    t.bernoulli(p)
+    return t
+
+
+def to_numpy(t: Tensor) -> np.ndarray:
+    return t.to_numpy()
+
+
+def copy_data_to_from(dst: Tensor, src: Tensor, size=None) -> None:
+    """Reference: `CopyDataToFrom` free fn."""
+    dst.copy_data(src)
+
+
+# ---------------------------------------------------------------------------
+# Unary elementwise. Reference: EltwiseUnaryTensorFn macro expansion —
+# Abs, Ceil, Exp, Log, ReLU, Sigmoid, Sign, Sqrt, Square, Tanh, ...
+# (src/core/tensor/tensor.cc + tensor_math_cuda.h kernels).
+# ---------------------------------------------------------------------------
+def _unary(fn):
+    def f(t: Tensor) -> Tensor:
+        return _wrap(fn(t.data), t)
+
+    return f
+
+
+abs = _unary(jnp.abs)  # noqa: A001
+ceil = _unary(jnp.ceil)
+floor = _unary(jnp.floor)
+round = _unary(jnp.round)  # noqa: A001
+exp = _unary(jnp.exp)
+log = _unary(jnp.log)
+sqrt = _unary(jnp.sqrt)
+square = _unary(jnp.square)
+sign = _unary(jnp.sign)
+tanh = _unary(jnp.tanh)
+sigmoid = _unary(jax.nn.sigmoid)
+relu = _unary(jax.nn.relu)
+sin = _unary(jnp.sin)
+cos = _unary(jnp.cos)
+tan = _unary(jnp.tan)
+asin = _unary(jnp.arcsin)
+acos = _unary(jnp.arccos)
+atan = _unary(jnp.arctan)
+sinh = _unary(jnp.sinh)
+cosh = _unary(jnp.cosh)
+asinh = _unary(jnp.arcsinh)
+acosh = _unary(jnp.arccosh)
+atanh = _unary(jnp.arctanh)
+erf = _unary(jax.scipy.special.erf)
+reciprocal = _unary(lambda x: 1.0 / x)
+
+
+def softmax(t: Tensor, axis: int = -1) -> Tensor:
+    """Reference: `SoftMax` free fn (KernelSoftmax / cudnnSoftmaxForward)."""
+    return _wrap(jax.nn.softmax(t.data, axis=axis), t)
+
+
+def clip(t: Tensor, lo, hi) -> Tensor:
+    return _wrap(jnp.clip(t.data, lo, hi), t)
+
+
+# ---------------------------------------------------------------------------
+# Binary elementwise with broadcast. Reference: Add/Sub/EltwiseMult/Div/Pow.
+# ---------------------------------------------------------------------------
+def add(a, b) -> Tensor:
+    return _wrap(_raw(a) + _raw(b), a if isinstance(a, Tensor) else b)
+
+
+def sub(a, b) -> Tensor:
+    return _wrap(_raw(a) - _raw(b), a if isinstance(a, Tensor) else b)
+
+
+def eltwise_mult(a, b) -> Tensor:
+    return _wrap(_raw(a) * _raw(b), a if isinstance(a, Tensor) else b)
+
+
+def div(a, b) -> Tensor:
+    return _wrap(_raw(a) / _raw(b), a if isinstance(a, Tensor) else b)
+
+
+def pow(a, b) -> Tensor:  # noqa: A001
+    return _wrap(_raw(a) ** _raw(b), a if isinstance(a, Tensor) else b)
+
+
+def maximum(a, b) -> Tensor:
+    return _wrap(jnp.maximum(_raw(a), _raw(b)), a if isinstance(a, Tensor) else b)
+
+
+def minimum(a, b) -> Tensor:
+    return _wrap(jnp.minimum(_raw(a), _raw(b)), a if isinstance(a, Tensor) else b)
+
+
+def axpy(alpha: float, x: Tensor, y: Tensor) -> Tensor:
+    """Reference: `Axpy` (cublasSaxpy) — y += alpha * x, in place."""
+    y.data = y.data + alpha * x.data
+    return y
+
+
+# ---------------------------------------------------------------------------
+# Linear algebra. Reference: `Mult` → cublasSgemm/Sgemv; MXU territory.
+#
+# Precision policy: TPU MXU matmuls default to bf16 passes, which is a
+# ~1% relative error vs the reference's fp32 cublasSgemm. The reference
+# keeps fp32 math by default and gates half precision behind the
+# `--precision` flag (train_cnn.py); we mirror that: "highest" (fp32,
+# 3-pass) by default, switchable to "default" (bf16, fastest) for
+# benchmark/throughput mode.
+# ---------------------------------------------------------------------------
+_matmul_precision = "highest"
+
+
+def set_matmul_precision(p: str) -> None:
+    """'highest' (fp32 parity, default) | 'high' | 'default' (bf16 fast)."""
+    global _matmul_precision
+    assert p in ("highest", "high", "default"), p
+    _matmul_precision = p
+
+
+def get_matmul_precision() -> str:
+    return _matmul_precision
+
+
+# ---------------------------------------------------------------------------
+# Mixed-precision compute policy (TPU-native AMP). The reference gates
+# half precision behind DistOpt's fp16 allreduce + `--precision`
+# (train_cnn.py); the TPU-idiomatic equivalent is bf16 *compute* with
+# fp32 master params: matmul/conv operands cast to bf16 at the op
+# boundary (fp32 MXU accumulation), activations and their gradients
+# flow bf16 (halving HBM traffic — the measured ResNet-50 bottleneck),
+# while params, BN statistics, losses, and optimizer math stay fp32.
+# ---------------------------------------------------------------------------
+_compute_dtype = None  # None = policy off (full fp32 math)
+
+
+def set_compute_dtype(dt) -> None:
+    """Enable bf16 AMP: set_compute_dtype('bfloat16'); None disables."""
+    global _compute_dtype
+    _compute_dtype = jnp.dtype(dt) if dt is not None else None
+
+
+def get_compute_dtype():
+    return _compute_dtype
+
+
+def amp_cast(*arrays):
+    """Cast fp32 arrays to the compute dtype when the AMP policy is on
+    (leaves integer / non-fp32 arrays and None untouched)."""
+    if _compute_dtype is None:
+        return arrays if len(arrays) != 1 else arrays[0]
+    out = tuple(
+        a.astype(_compute_dtype)
+        if a is not None and hasattr(a, "dtype") and a.dtype == jnp.float32
+        else a
+        for a in arrays
+    )
+    return out if len(out) != 1 else out[0]
+
+
+def mult(a: Tensor, b: Tensor) -> Tensor:
+    """GEMM/GEMV. Reference: `Mult(const Tensor&, const Tensor&)`."""
+    return _wrap(jnp.matmul(a.data, b.data, precision=_matmul_precision), a)
+
+
+matmul = mult
+
+
+def einsum(subscripts: str, *ts: Tensor) -> Tensor:
+    return _wrap(jnp.einsum(subscripts, *[t.data for t in ts]), ts[0])
+
+
+def tensordot(a: Tensor, b: Tensor, axes=2) -> Tensor:
+    return _wrap(jnp.tensordot(a.data, b.data, axes=axes), a)
+
+
+# ---------------------------------------------------------------------------
+# Reductions. Reference: Sum, SumRows/SumColumns, RowMax (KernelRowMax),
+# Average.
+# ---------------------------------------------------------------------------
+def sum(t: Tensor, axis=None, keepdims=False) -> Tensor:  # noqa: A001
+    return _wrap(jnp.sum(t.data, axis=axis, keepdims=keepdims), t)
+
+
+def average(t: Tensor, axis=None, keepdims=False) -> Tensor:
+    return _wrap(jnp.mean(t.data, axis=axis, keepdims=keepdims), t)
+
+
+mean = average
+
+
+def max(t: Tensor, axis=None, keepdims=False) -> Tensor:  # noqa: A001
+    return _wrap(jnp.max(t.data, axis=axis, keepdims=keepdims), t)
+
+
+def min(t: Tensor, axis=None, keepdims=False) -> Tensor:  # noqa: A001
+    return _wrap(jnp.min(t.data, axis=axis, keepdims=keepdims), t)
+
+
+def sum_rows(t: Tensor) -> Tensor:
+    """Reference: `SumRows` — sum over axis 0 of a matrix."""
+    return _wrap(jnp.sum(t.data, axis=0), t)
+
+
+def sum_columns(t: Tensor) -> Tensor:
+    """Reference: `SumColumns` — sum over axis 1 of a matrix."""
+    return _wrap(jnp.sum(t.data, axis=1), t)
+
+
+def row_max(t: Tensor) -> Tensor:
+    """Reference: `RowMax` (KernelRowMax)."""
+    return _wrap(jnp.max(t.data, axis=1), t)
+
+
+def argmax(t: Tensor, axis=-1) -> Tensor:
+    return _wrap(jnp.argmax(t.data, axis=axis).astype(int32), t)
+
+
+def argmin(t: Tensor, axis=-1) -> Tensor:
+    return _wrap(jnp.argmin(t.data, axis=axis).astype(int32), t)
+
+
+# ---------------------------------------------------------------------------
+# Row/column broadcast helpers. Reference: AddRow/AddColumn/MultRow/
+# MultColumn/DivRow/DivColumn (tensor.cc).
+# ---------------------------------------------------------------------------
+def add_row(v: Tensor, m: Tensor) -> Tensor:
+    """m[i,:] += v (v has shape (cols,))."""
+    return _wrap(m.data + v.data[None, :], m)
+
+
+def add_column(v: Tensor, m: Tensor) -> Tensor:
+    """m[:,j] += v (v has shape (rows,))."""
+    return _wrap(m.data + v.data[:, None], m)
+
+
+def mult_row(v: Tensor, m: Tensor) -> Tensor:
+    return _wrap(m.data * v.data[None, :], m)
+
+
+def mult_column(v: Tensor, m: Tensor) -> Tensor:
+    return _wrap(m.data * v.data[:, None], m)
+
+
+def div_row(v: Tensor, m: Tensor) -> Tensor:
+    return _wrap(m.data / v.data[None, :], m)
+
+
+def div_column(v: Tensor, m: Tensor) -> Tensor:
+    return _wrap(m.data / v.data[:, None], m)
+
+
+# ---------------------------------------------------------------------------
+# Shaping free fns. Reference: Reshape/Transpose/Concat(Rows|Columns)/
+# Slice(Rows|Columns)/Stack/CopyRows.
+# ---------------------------------------------------------------------------
+def reshape(t: Tensor, shape) -> Tensor:
+    return t.reshape(shape)
+
+
+def transpose(t: Tensor, axes=None) -> Tensor:
+    return t.transpose(axes)
+
+
+def concatenate(ts: Sequence[Tensor], axis: int = 0) -> Tensor:
+    return _wrap(jnp.concatenate([t.data for t in ts], axis=axis), ts[0])
+
+
+concat = concatenate
+
+
+def concat_rows(ts) -> Tensor:
+    return concatenate(ts, axis=0)
+
+
+def concat_columns(ts) -> Tensor:
+    return concatenate(ts, axis=1)
+
+
+def stack(ts: Sequence[Tensor], axis: int = 0) -> Tensor:
+    return _wrap(jnp.stack([t.data for t in ts], axis=axis), ts[0])
+
+
+def slice_rows(t: Tensor, start: int, end: int) -> Tensor:
+    return _wrap(t.data[start:end], t)
+
+
+def slice_columns(t: Tensor, start: int, end: int) -> Tensor:
+    return _wrap(t.data[:, start:end], t)
+
+
+def copy_rows(t: Tensor, start: int, end: int) -> Tensor:
+    return slice_rows(t, start, end)
+
+
+def split(t: Tensor, parts, axis: int = 0):
+    return [_wrap(a, t) for a in jnp.split(t.data, parts, axis=axis)]
+
+
+def tile(t: Tensor, reps) -> Tensor:
+    return _wrap(jnp.tile(t.data, reps), t)
+
+
+def gather(t: Tensor, indices, axis: int = 0) -> Tensor:
+    idx = _raw(indices) if isinstance(indices, Tensor) else jnp.asarray(indices)
+    return _wrap(jnp.take(t.data, idx.astype(jnp.int32), axis=axis), t)
+
+
+def where(cond, a, b) -> Tensor:
+    like = a if isinstance(a, Tensor) else (b if isinstance(b, Tensor) else cond)
+    return _wrap(jnp.where(_raw(cond) != 0, _raw(a), _raw(b)), like)
+
+
+def one_hot(indices, depth: int, device=None, dtype=float32) -> Tensor:
+    idx = _raw(indices) if isinstance(indices, Tensor) else jnp.asarray(indices)
+    dev = (
+        indices.device
+        if isinstance(indices, Tensor)
+        else (device or get_default_device())
+    )
+    return _wrap_dev(
+        jax.nn.one_hot(idx.astype(jnp.int32), depth, dtype=_as_dtype(dtype)), dev
+    )
+
+
+# ---------------------------------------------------------------------------
+# Comparison free fns. Reference: LT/LE/GT/GE (tensor.cc) returning masks.
+# ---------------------------------------------------------------------------
+def lt(t: Tensor, x) -> Tensor:
+    return t < x
+
+
+def le(t: Tensor, x) -> Tensor:
+    return t <= x
+
+
+def gt(t: Tensor, x) -> Tensor:
+    return t > x
+
+
+def ge(t: Tensor, x) -> Tensor:
+    return t >= x
+
+
+# ---------------------------------------------------------------------------
+# Loss helpers. Reference: ComputeCrossEntropy / SoftmaxCrossEntropyBwd
+# (fused KernelSoftmaxCrossEntropy) — the differentiable version lives in
+# autograd; these are the raw kernels.
+# ---------------------------------------------------------------------------
+def compute_cross_entropy(p: Tensor, t: Tensor) -> Tensor:
+    """-sum(t * log(p)) per row; t may be one-hot or int labels."""
+    pd = p.data
+    td = t.data
+    if td.ndim == pd.ndim - 1 or (td.ndim == pd.ndim and td.shape[-1] == 1):
+        td = jax.nn.one_hot(td.reshape(td.shape[: pd.ndim - 1]).astype(jnp.int32),
+                            pd.shape[-1], dtype=pd.dtype)
+    eps = jnp.finfo(pd.dtype).tiny
+    return _wrap(-jnp.sum(td * jnp.log(pd + eps), axis=-1), p)
+
+
+def softmax_cross_entropy_bwd(p: Tensor, t: Tensor) -> Tensor:
+    """Per-example grad of summed softmax-CE wrt logits: p - t.
+
+    Callers computing the *mean* loss must scale by 1/batch themselves
+    (the autograd SoftMaxCrossEntropy op does)."""
+    pd, td = p.data, t.data
+    if td.ndim == pd.ndim - 1 or (td.ndim == pd.ndim and td.shape[-1] == 1):
+        td = jax.nn.one_hot(td.reshape(td.shape[: pd.ndim - 1]).astype(jnp.int32),
+                            pd.shape[-1], dtype=pd.dtype)
+    return _wrap(pd - td, p)

@@ -165,3 +165,26 @@ layer { name: "c" type: "Convolution" bottom: "d" top: "c"
     out = net.forward(x)
     assert out.shape == (1, 2, 5, 9)
     assert net._catalog["c"].W.shape == (2, 3, 1, 7)
+
+
+def test_global_pooling_and_leaky_relu(tmp_path):
+    path = tmp_path / "gp.prototxt"
+    path.write_text('''
+layer { name: "c" type: "Convolution" bottom: "d" top: "c"
+  convolution_param { num_output: 4 kernel_size: 3 pad: 1 } }
+layer { name: "r" type: "ReLU" bottom: "c" top: "c"
+  relu_param { negative_slope: 0.1 } }
+layer { name: "gp" type: "Pooling" bottom: "c" top: "gp"
+  pooling_param { pool: AVE global_pooling: true } }
+''')
+    net = converter.CaffeConverter(str(path)).create_net()
+    x = tensor.from_numpy(
+        np.random.RandomState(0).randn(2, 3, 6, 6).astype(np.float32))
+    net.compile([x], is_train=False, use_graph=False)
+    net.eval()
+    out = net.forward(x)
+    assert out.shape == (2, 4, 1, 1)
+    # leaky relu really applied: negative conv outputs scaled by 0.1
+    from singa_tpu import layer as layer_mod
+    assert isinstance(net._catalog["r"], layer_mod.LeakyReLU)
+    assert net._catalog["r"].a == 0.1

@@ -158,3 +158,64 @@ class TestEdgeCases:
         gr = jax.grad(lambda x: jnp.sum(
             -jnp.sum(onehot * jax.nn.log_softmax(x, -1), -1)))(x)
         np.testing.assert_allclose(gp, gr, rtol=1e-5, atol=1e-6)
+
+
+class TestFlashAttention:
+    """Fused flash-style attention vs the XLA plain_attention path."""
+
+    def _qkv(self, b, h, s, d, seed=0):
+        import jax.numpy as jnp
+
+        rs = np.random.RandomState(seed)
+        return tuple(jnp.asarray(rs.randn(b, h, s, d).astype(np.float32))
+                     for _ in range(3))
+
+    @pytest.mark.parametrize("shape,causal", [
+        ((2, 2, 64, 32), True),
+        ((1, 3, 100, 16), False),   # non-multiple-of-tile seq (padding)
+        ((2, 1, 192, 64), True),
+    ])
+    def test_fwd_and_grad_parity(self, shape, causal):
+        import jax
+        import jax.numpy as jnp
+
+        from singa_tpu.parallel.ring_attention import plain_attention
+
+        q, k, v = self._qkv(*shape)
+        ref = plain_attention(q, k, v, causal=causal)
+        got = pk.flash_attention(q, k, v, causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5)
+        f_ref = lambda q, k, v: jnp.sum(  # noqa: E731
+            jnp.sin(plain_attention(q, k, v, causal=causal)))
+        f_got = lambda q, k, v: jnp.sum(  # noqa: E731
+            jnp.sin(pk.flash_attention(q, k, v, causal)))
+        gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+        gg = jax.grad(f_got, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gr, gg):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_attention_op_uses_kernel(self):
+        from singa_tpu import autograd, tensor
+
+        pk.enable(True)
+        try:
+            q, k, v = self._qkv(1, 2, 64, 32)
+            tq = tensor.from_raw(q, None)
+            tk = tensor.from_raw(k, None)
+            tv = tensor.from_raw(v, None)
+            for t in (tq, tk, tv):
+                t.requires_grad = True
+            out = autograd.attention(tq, tk, tv, causal=True)
+            from singa_tpu.parallel.ring_attention import plain_attention
+
+            ref = plain_attention(q, k, v, causal=True)
+            np.testing.assert_allclose(out.to_numpy(), np.asarray(ref),
+                                       rtol=1e-4, atol=1e-5)
+        finally:
+            pk.enable(False)
+
+    def test_vmem_budget_gate(self):
+        assert pk.attn_supported(1024, 64)
+        assert not pk.attn_supported(65536, 128)
