@@ -314,6 +314,70 @@ def run_stage(name, args, deadline):
     return None
 
 
+def stage_lm(batch, seq, steps, deadline_s):
+    """TransformerLM throughput (tokens/s) with the Pallas flash
+    attention + bf16 AMP — the transformer-side perf evidence
+    (secondary metric; ResNet img/s stays the headline)."""
+    import numpy as np
+
+    _setup_jax()
+    import jax
+
+    from singa_tpu import device, opt, tensor
+    from singa_tpu.models.transformer import TransformerLM
+    from singa_tpu.ops import pallas_kernels as pk
+
+    hard_stop = time.time() + deadline_s
+    dev = device.create_tpu_device()
+    dev.SetRandSeed(0)
+    tensor.set_matmul_precision("default")
+    tensor.set_compute_dtype("bfloat16")
+    pk.enable(True)
+    V, D, H, L = 32000, 512, 8, 8
+    flash = pk.attn_supported(seq, D // H)
+    m = TransformerLM(V, d_model=D, num_heads=H, num_layers=L,
+                      max_len=seq)
+    m.set_optimizer(opt.SGD(lr=0.1, momentum=0.9))
+    rs = np.random.RandomState(0)
+    tx = tensor.from_numpy(rs.randint(0, V, (batch, seq))
+                           .astype(np.int32), device=dev)
+    ty = tensor.from_numpy(rs.randint(0, V, (batch, seq))
+                           .astype(np.int32), device=dev)
+    t0 = time.time()
+    m.compile([tx], is_train=True, use_graph=True)
+    log(f"lm host setup: {time.time() - t0:.1f}s")
+    t0 = time.time()
+    out, loss = m(tx, ty)
+    loss.data.block_until_ready()
+    log(f"lm first step: {time.time() - t0:.1f}s")
+    best = None
+    done = 0
+    while done < steps and time.time() < hard_stop:
+        n = min(8, max(3, steps - done))
+        t0 = time.time()
+        for _ in range(n):
+            out, loss = m(tx, ty)
+        jax.block_until_ready(
+            [p.data for p in m.param_tensors()] + [loss.data])
+        dt = (time.time() - t0) / n
+        done += n
+        tps = batch * seq / dt
+        log(f"lm {n}-step block: {dt * 1e3:.1f} ms/step "
+            f"({tps / 1e3:.1f}k tok/s)")
+        if best is None or dt < best:
+            best = dt
+    if best is None:
+        print(json.dumps({"ok": False, "error": "no steps"}), flush=True)
+        return
+    print(json.dumps({
+        "ok": True, "metric": "transformer_lm_tokens_per_sec",
+        "config": (f"d{D}h{H}l{L} bs{batch} seq{seq} bf16"
+                   + ("+flash" if flash else "")),
+        "tokens_per_sec": round(batch * seq / best, 1),
+        "step_ms": round(best * 1e3, 2),
+        "loss": round(float(loss.to_numpy()), 3)}), flush=True)
+
+
 def stage_pallas():
     """SINGA_TPU_PALLAS=1 microbench on the chip -> PALLAS_BENCH.md."""
     os.environ["SINGA_TPU_PALLAS"] = "1"
@@ -339,6 +403,7 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--stage", help="internal: run one stage in-process")
     p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--seq", type=int, default=1024)
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--deadline", type=float, default=420.0)
     p.add_argument("--amp", action="store_true",
@@ -353,6 +418,8 @@ def main():
         return stage_smoke()
     if a.stage == "resnet":
         return stage_resnet(a.batch, a.steps, a.deadline, amp=a.amp)
+    if a.stage == "lm":
+        return stage_lm(a.batch, a.seq, a.steps, a.deadline)
     if a.stage == "pallas":
         return stage_pallas()
     if a.stage == "parity":
@@ -420,9 +487,19 @@ def main():
             else:
                 log(f"bs{batch} (amp={amp}) stage failed; "
                     "continuing with next stage")
-        # Auxiliary artifacts while the chip is up: Pallas kernel tier
-        # timings (PALLAS_BENCH.md) and the TPU loss-parity column
+        # Auxiliary artifacts while the chip is up: transformer tok/s
+        # (flash attention + AMP), Pallas kernel tier timings
+        # (PALLAS_BENCH.md), and the TPU loss-parity column
         # (PARITY_cifar10.json).
+        if remaining() > 300:
+            lm_dl = max(60, min(240, remaining() - 150))
+            lm = run_stage("lm", ["--batch", "8", "--seq", "1024",
+                                  "--steps", "16",
+                                  "--deadline", str(lm_dl)],
+                           lm_dl + 90)
+            if lm and lm.get("ok"):
+                result_extra["lm_tokens_per_sec"] = lm["tokens_per_sec"]
+                result_extra["lm_config"] = lm["config"]
         if remaining() > 180:
             run_stage("pallas", [], min(300, remaining() - 60))
         if remaining() > 240:
@@ -446,7 +523,7 @@ def _final_json(best, peak, chip, extra):
                 "batch": best["batch"], "step_ms": best["step_ms"],
                 "precision": best.get("precision", "fp32"),
                 "compile_s": best["compile_s"],
-                "mfu": round(mfu, 4), "chip": chip}
+                "mfu": round(mfu, 4), "chip": chip, **extra}
     return {"metric": "resnet50_images_per_sec_chip", "value": 0.0,
             "unit": "img/s", "vs_baseline": 0.0, "chip": chip, **extra}
 

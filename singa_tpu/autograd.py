@@ -1102,9 +1102,11 @@ class Attention(Operator):
                                   scale=self.scale,
                                   axis_name=self.axis_name)
         # Pallas tier: fused flash-style kernel (score matrix stays in
-        # VMEM) when the head's K/V fit the kernel's residency budget;
-        # longer sequences are ring attention's job.
-        if _pk.enabled() and _pk.attn_supported(q.shape[2], q.shape[3]):
+        # VMEM) for SELF-attention (the kernel assumes Sq == Sk) whose
+        # K/V fit the residency budget; cross-attention and longer
+        # sequences keep the XLA / ring paths.
+        if (_pk.enabled() and q.shape[2] == k.shape[2]
+                and _pk.attn_supported(q.shape[2], q.shape[3])):
             return _pk.flash_attention(q, k, v, self.causal, self.scale)
         return plain_attention(q, k, v, causal=self.causal,
                                scale=self.scale)

@@ -105,3 +105,52 @@ def test_amp_off_is_pure_fp32():
     m.compile([tx], is_train=True, use_graph=False)
     out, loss = m(tx, ty)
     assert out.data.dtype == np.float32
+
+
+def test_amp_mesh_dp_training(amp):
+    """AMP policy composes with mesh-mode SPMD training (policy globals
+    are read at trace time; the sharded step stays bf16-compute)."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices()[:4]).reshape(4)
+    mesh = Mesh(devs, ("data",))
+    dev = device.get_default_device()
+    dev.SetRandSeed(9)
+    m = _ConvNet()
+    m.set_optimizer(opt.SGD(lr=0.05, momentum=0.9))
+    tx, ty = _data(dev, n=8)
+    m.compile([tx], is_train=True, use_graph=True, mesh=mesh)
+    losses = []
+    for _ in range(5):
+        _, loss = m(tx, ty)
+        losses.append(float(loss.to_numpy()))
+    assert losses[-1] < losses[0]
+    for p in m.param_tensors():
+        assert p.data.dtype == np.float32
+
+
+def test_amp_flash_attention_graph_mode(amp):
+    """bf16 AMP + Pallas flash attention + whole-step jit together."""
+    from singa_tpu.models.transformer import TransformerLM
+    from singa_tpu.ops import pallas_kernels as pk
+
+    dev = device.get_default_device()
+    dev.SetRandSeed(2)
+    pk.enable(True)
+    try:
+        V, S = 64, 32
+        rs = np.random.RandomState(0)
+        m = TransformerLM(V, d_model=32, num_heads=2, num_layers=1,
+                          max_len=S)
+        m.set_optimizer(opt.SGD(lr=0.2, momentum=0.9))
+        tx = tensor.from_numpy(rs.randint(0, V, (2, S)).astype(np.int32))
+        ty = tensor.from_numpy(rs.randint(0, V, (2, S)).astype(np.int32))
+        m.compile([tx], is_train=True, use_graph=True)
+        losses = []
+        for _ in range(5):
+            _, loss = m(tx, ty)
+            losses.append(float(loss.to_numpy()))
+        assert losses[-1] < losses[0], losses
+    finally:
+        pk.enable(False)

@@ -219,3 +219,27 @@ class TestFlashAttention:
     def test_vmem_budget_gate(self):
         assert pk.attn_supported(1024, 64)
         assert not pk.attn_supported(65536, 128)
+
+    def test_cross_attention_falls_back(self):
+        """Sq != Sk must NOT take the flash path (kernel assumes
+        self-attention); the public op must still be correct."""
+        import jax.numpy as jnp
+
+        from singa_tpu import autograd, tensor
+        from singa_tpu.parallel.ring_attention import plain_attention
+
+        rs = np.random.RandomState(1)
+        q = jnp.asarray(rs.randn(1, 2, 128, 32).astype(np.float32))
+        k = jnp.asarray(rs.randn(1, 2, 64, 32).astype(np.float32))
+        v = jnp.asarray(rs.randn(1, 2, 64, 32).astype(np.float32))
+        pk.enable(True)
+        try:
+            tq, tk, tv = (tensor.from_raw(a, None) for a in (q, k, v))
+            for t in (tq, tk, tv):
+                t.requires_grad = True
+            out = autograd.attention(tq, tk, tv, causal=False)
+            ref = plain_attention(q, k, v, causal=False)
+            np.testing.assert_allclose(out.to_numpy(), np.asarray(ref),
+                                       rtol=1e-4, atol=1e-5)
+        finally:
+            pk.enable(False)
