@@ -8,6 +8,11 @@ ICI/DCN collectives. DP/TP/SP compose in one jit-ed train step
 long-context attention over the "seq" axis.
 """
 from .mesh import AXES, auto_mesh, create_mesh, default_balanced_mesh  # noqa: F401
+from .pipeline import (  # noqa: F401
+    pipeline_apply,
+    place_stacked,
+    stack_stage_params,
+)
 from .ring_attention import plain_attention, ring_attention  # noqa: F401
 from .sharding import (  # noqa: F401
     DEFAULT_RULES,

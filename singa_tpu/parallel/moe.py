@@ -1,0 +1,125 @@
+"""Expert parallelism: a GShard-style top-1 MoE FFN over an "expert"
+mesh axis.
+
+The reference has no MoE (SURVEY.md §2.4); like pipeline.py this is
+TPU-native surplus completing the dp/tp/sp/pp/ep axis set. Design is
+the canonical GSPMD recipe, NOT a hand-written all-to-all: expert
+parameters and the dispatched token tensor are sharding-annotated on
+the "expert" axis and XLA inserts the all-to-alls on the dispatch and
+combine einsums (over ICI on a real slice).
+
+  * top-1 gating with an auxiliary load-balancing loss (Shazeer
+    et al.'s mean(gates)*mean(assignments)*E^2 form);
+  * fixed expert capacity C = ceil(T/E * capacity_factor); overflow
+    tokens are dropped (their output is 0, the standard behavior) —
+    combine weights renormalize nothing, matching GShard;
+  * everything is dense einsum over one-hot dispatch/combine tensors:
+    compiler-friendly (static shapes, no gather/scatter), MXU-shaped.
+"""
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class MoEParams(NamedTuple):
+    gate_w: jnp.ndarray   # (D, E)
+    w1: jnp.ndarray       # (E, D, F)
+    b1: jnp.ndarray       # (E, F)
+    w2: jnp.ndarray       # (E, F, D)
+    b2: jnp.ndarray       # (E, D)
+
+
+def init_moe_params(key, d_model: int, d_ff: int, n_experts: int,
+                    dtype=jnp.float32) -> MoEParams:
+    kg, k1, k2 = jax.random.split(key, 3)
+    s1 = 1.0 / math.sqrt(d_model)
+    s2 = 1.0 / math.sqrt(d_ff)
+    return MoEParams(
+        gate_w=(jax.random.normal(kg, (d_model, n_experts)) * s1
+                ).astype(dtype),
+        w1=(jax.random.normal(k1, (n_experts, d_model, d_ff)) * s1
+            ).astype(dtype),
+        b1=jnp.zeros((n_experts, d_ff), dtype),
+        w2=(jax.random.normal(k2, (n_experts, d_ff, d_model)) * s2
+            ).astype(dtype),
+        b2=jnp.zeros((n_experts, d_model), dtype),
+    )
+
+
+def place_moe_params(params: MoEParams, mesh: Mesh,
+                     axis_name: str = "expert") -> MoEParams:
+    """Chip i holds experts [i*E/n, (i+1)*E/n): leading expert dim
+    sharded; the gate is replicated (every chip routes every token)."""
+    ex = NamedSharding(mesh, P(axis_name))
+    rep = NamedSharding(mesh, P())
+    return MoEParams(
+        gate_w=jax.device_put(params.gate_w, rep),
+        w1=jax.device_put(params.w1, ex),
+        b1=jax.device_put(params.b1, ex),
+        w2=jax.device_put(params.w2, ex),
+        b2=jax.device_put(params.b2, ex),
+    )
+
+
+def moe_ffn(params: MoEParams, x, *, capacity_factor: float = 1.25,
+            mesh: Optional[Mesh] = None, axis_name: str = "expert"):
+    """Top-1 MoE FFN. x: (..., D) -> (y, aux_loss).
+
+    With `mesh`, the expert dim of the dispatched tensors is
+    sharding-constrained to `axis_name` so GSPMD partitions expert
+    compute across chips (all-to-all on dispatch/combine).
+    """
+    orig_shape = x.shape
+    d = orig_shape[-1]
+    xt = x.reshape(-1, d)                       # (T, D)
+    t = xt.shape[0]
+    e = params.gate_w.shape[-1]
+    cap = max(1, math.ceil(t / e * capacity_factor))
+
+    logits = (xt @ params.gate_w).astype(jnp.float32)     # (T, E)
+    gates = jax.nn.softmax(logits, -1)
+    idx = jnp.argmax(gates, -1)                           # (T,)
+    onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)    # (T, E)
+    gate_top = jnp.sum(gates * onehot, -1)                # (T,)
+
+    # auxiliary load-balancing loss (mean gate mass x mean assignment
+    # fraction per expert, scaled by E^2 -> 1.0 at perfect balance)
+    aux = jnp.mean(gates, 0) * jnp.mean(onehot, 0)
+    aux_loss = jnp.sum(aux) * (e * e) / e
+
+    # position of each token within its expert's capacity buffer
+    # (count of same-expert tokens before it; 0 in unassigned columns)
+    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot     # (T, E)
+    pos_t = jnp.sum(pos, -1)                              # (T,)
+    keep = pos_t < cap
+    posc = jax.nn.one_hot(pos_t.astype(jnp.int32), cap,
+                          dtype=jnp.float32)              # (T, C)
+    dispatch = (onehot[:, :, None] * posc[:, None, :]
+                * keep[:, None, None])                    # (T, E, C)
+
+    # Expert FFN runs in the model compute dtype (bf16 under AMP —
+    # only the router above is pinned to f32, the GShard convention);
+    # one-hot dispatch is exact in any float dtype.
+    dt = x.dtype
+    ex_in = jnp.einsum("tec,td->ecd", dispatch.astype(dt), xt)
+    if mesh is not None:
+        ex_in = lax.with_sharding_constraint(
+            ex_in, NamedSharding(mesh, P(axis_name)))
+    h = jax.nn.gelu(
+        jnp.einsum("ecd,edf->ecf", ex_in, params.w1.astype(dt))
+        + params.b1[:, None, :].astype(dt))
+    ex_out = (jnp.einsum("ecf,efd->ecd", h, params.w2.astype(dt))
+              + params.b2[:, None, :].astype(dt))         # (E, C, D)
+    if mesh is not None:
+        ex_out = lax.with_sharding_constraint(
+            ex_out, NamedSharding(mesh, P(axis_name)))
+
+    combine = (dispatch * gate_top[:, None, None]).astype(dt)
+    y = jnp.einsum("tec,ecd->td", combine, ex_out)
+    return y.reshape(orig_shape), aux_loss

@@ -1,0 +1,133 @@
+"""GPipe-style pipeline parallelism over a mesh axis.
+
+The reference has no pipeline parallelism (SURVEY.md §2.4 — DP is its
+only strategy); this module is TPU-native surplus, completing the
+tp/pp/dp/sp axis set the mesh trainer exposes. Design is the standard
+JAX/TPU recipe (the scaling-book pipelining pattern):
+
+  * homogeneous stages (e.g. transformer blocks) with their parameters
+    STACKED on a leading `pipe` dim, sharded so chip i holds stage i;
+  * the batch splits into M microbatches; over M + P - 1 ticks each
+    chip applies its stage to the microbatch in flight and hands the
+    activation to its neighbor with `lax.ppermute` (the transfer rides
+    ICI and overlaps the next tick's compute);
+  * the whole schedule is a `lax.scan` inside `shard_map`, so
+    `jax.vjp` differentiates it — the backward pass is automatically
+    the reverse pipeline with the same bubble shape.
+
+Bubble fraction is (P-1)/(M+P-1): choose microbatches >= pipe size.
+Parameter gradients come back stage-stacked, matching the input
+layout, so the optimizer update is uniform across chips.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:
+    shard_map = jax.shard_map
+    _CHECK_KW = {"check_vma": False}
+except AttributeError:  # pragma: no cover - old-jax fallback
+    from jax.experimental.shard_map import shard_map
+
+    _CHECK_KW = {"check_rep": False}
+
+
+def _stage_params_spec(params, axis_name):
+    """Every stacked param leaf shards its leading (stage) dim."""
+    return jax.tree_util.tree_map(
+        lambda _: P(axis_name), params,
+        is_leaf=lambda x: hasattr(x, "shape"))
+
+
+def pipeline_apply(stage_fn: Callable, stacked_params, x, mesh: Mesh,
+                   *, axis_name: str = "pipe", microbatches: int = None):
+    """Run `y = stage_P-1(...stage_1(stage_0(x)))` as a GPipe pipeline.
+
+    stage_fn(params_i, h) -> h'   one stage, pure; same signature for
+                                  every stage (homogeneous pipeline).
+    stacked_params: pytree whose leaves have leading dim P (= mesh
+        size along `axis_name`); leaf i on chip i.
+    x: [B, ...] global batch. B must divide into `microbatches` equal
+        microbatches (defaults to the pipe size).
+
+    Returns y with x's shape (the last stage's outputs, re-assembled).
+    Differentiable via jax.vjp/grad like any jax function.
+    """
+    pipe = mesh.shape[axis_name]
+    m = microbatches or pipe
+    b = x.shape[0]
+    assert b % m == 0, f"batch {b} not divisible into {m} microbatches"
+    mb = b // m
+    for leaf in jax.tree_util.tree_leaves(stacked_params):
+        assert leaf.shape[0] == pipe, (
+            f"stacked param leading dim {leaf.shape[0]} != pipe size "
+            f"{pipe} (one stage per chip; fold extra stages into "
+            "stage_fn)")
+
+    def per_chip(params, xloc):
+        # params: stage-stacked leaves with leading dim 1 (this chip's
+        # stage); xloc: the full batch (replicated along pipe).
+        my = lax.axis_index(axis_name)
+        p_local = jax.tree_util.tree_map(lambda a: a[0], params)
+        xm = xloc.reshape((m, mb) + xloc.shape[1:])
+        # state: the activation each chip is currently holding.
+        h0 = jnp.zeros((mb,) + xloc.shape[1:], xloc.dtype)
+        out0 = jnp.zeros_like(xm)
+
+        def tick(carry, t):
+            h, out = carry
+            # stage 0 ingests microbatch t (when in range)
+            feed = xm[jnp.clip(t, 0, m - 1)]
+            h_in = jnp.where(my == 0, feed, h)
+            h_out = stage_fn(p_local, h_in)
+            # last stage completed microbatch (t - (pipe-1)) at tick t
+            done_idx = t - (pipe - 1)
+            is_done = (my == pipe - 1) & (done_idx >= 0) & (done_idx < m)
+            out = jnp.where(
+                is_done,
+                out.at[jnp.clip(done_idx, 0, m - 1)].set(h_out),
+                out)
+            # hand the activation to the next stage (ring; the wrap
+            # from last->first carries garbage that stage 0 ignores)
+            nxt = lax.ppermute(
+                h_out, axis_name,
+                [(i, (i + 1) % pipe) for i in range(pipe)])
+            return (nxt, out), None
+
+        (h, out), _ = lax.scan(tick, (h0, out0),
+                               jnp.arange(m + pipe - 1))
+        # only the last chip's `out` is real; broadcast it to everyone
+        # so the result is replicated along pipe.
+        out = lax.psum(
+            jnp.where(my == pipe - 1, out, jnp.zeros_like(out)),
+            axis_name)
+        return out.reshape(xloc.shape)
+
+    pspec = _stage_params_spec(stacked_params, axis_name)
+    fn = shard_map(
+        per_chip, mesh=mesh,
+        in_specs=(pspec, P()),       # params stage-sharded, x replicated
+        out_specs=P(),
+        **_CHECK_KW,
+    )
+    return fn(stacked_params, x)
+
+
+def stack_stage_params(per_stage_params):
+    """[{leaf: (shape)}, ...] x P  ->  {leaf: (P, *shape)}."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *per_stage_params)
+
+
+def place_stacked(stacked_params, mesh, axis_name: str = "pipe"):
+    """Lay the stacked params out so chip i holds stage i."""
+    return jax.tree_util.tree_map(
+        lambda a: jax.device_put(
+            a, NamedSharding(mesh, P(axis_name))),
+        stacked_params)

@@ -31,8 +31,11 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 try:  # jax >= 0.6 exposes shard_map at top level
     shard_map = jax.shard_map
-except AttributeError:  # pragma: no cover
+    _CHECK_KW = {"check_vma": False}
+except AttributeError:  # pragma: no cover - old-jax fallback
     from jax.experimental.shard_map import shard_map
+
+    _CHECK_KW = {"check_rep": False}  # the old API's kwarg name
 
 
 def _neg_big(dtype):
@@ -123,6 +126,6 @@ def ring_attention(q, k, v, mesh: Mesh, *, axis_name: str = "seq",
         partial(_ring_attention_local, axis_name=axis_name,
                 causal=causal, scale=scale),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False,
+        **_CHECK_KW,
     )
     return fn(q, k, v)

@@ -1,0 +1,122 @@
+"""Pipeline-parallel tests (singa_tpu/parallel/pipeline.py).
+
+The reference has no pipeline parallelism (SURVEY.md §2.4); these
+assert the GPipe schedule is EXACT — forward outputs and per-stage
+parameter gradients equal the plain sequential composition — on the
+8-virtual-device CPU mesh.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from singa_tpu.parallel import (
+    pipeline_apply,
+    place_stacked,
+    stack_stage_params,
+)
+
+
+def _mlp_stage(p, h):
+    return jax.nn.gelu(h @ p["W"] + p["b"]) + h
+
+
+def _stages(n, d, seed=0):
+    rs = np.random.RandomState(seed)
+    return [{"W": jnp.asarray(rs.randn(d, d).astype(np.float32) * 0.2),
+             "b": jnp.asarray(rs.randn(d).astype(np.float32) * 0.1)}
+            for _ in range(n)]
+
+
+def _ref(stages, x, fn=_mlp_stage):
+    h = x
+    for p in stages:
+        h = fn(p, h)
+    return h
+
+
+@pytest.fixture
+def mesh4():
+    return Mesh(np.array(jax.devices()[:4]).reshape(4), ("pipe",))
+
+
+@pytest.mark.parametrize("microbatches", [4, 8])
+def test_forward_matches_sequential(mesh4, microbatches):
+    per_stage = _stages(4, 16)
+    x = jnp.asarray(
+        np.random.RandomState(1).randn(8, 16).astype(np.float32))
+    stacked = place_stacked(stack_stage_params(per_stage), mesh4)
+    y = pipeline_apply(_mlp_stage, stacked, x, mesh4,
+                       microbatches=microbatches)
+    np.testing.assert_allclose(np.asarray(y),
+                               np.asarray(_ref(per_stage, x)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_grads_match_sequential(mesh4):
+    per_stage = _stages(4, 16, seed=2)
+    x = jnp.asarray(
+        np.random.RandomState(3).randn(8, 16).astype(np.float32))
+    stacked = place_stacked(stack_stage_params(per_stage), mesh4)
+
+    def loss_pp(params):
+        return jnp.sum(jnp.sin(
+            pipeline_apply(_mlp_stage, params, x, mesh4,
+                           microbatches=4)))
+
+    def loss_ref(stages):
+        return jnp.sum(jnp.sin(_ref(stages, x)))
+
+    g_pp = jax.grad(loss_pp)(stacked)
+    g_ref = stack_stage_params(jax.grad(loss_ref)(per_stage))
+    for k in ("W", "b"):
+        np.testing.assert_allclose(np.asarray(g_pp[k]),
+                                   np.asarray(g_ref[k]),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_transformer_block_pipeline(mesh4):
+    """Pipelined pre-LN attention+FFN blocks (the real workload shape:
+    [B, S, D] activations)."""
+    d, heads = 16, 2
+
+    def block(p, h):
+        # pre-LN MHSA (single fused head math, causal-free)
+        mu = h.mean(-1, keepdims=True)
+        sd = jnp.sqrt(((h - mu) ** 2).mean(-1, keepdims=True) + 1e-5)
+        hn = (h - mu) / sd
+        b_, s_, _ = h.shape
+        q = (hn @ p["Wq"]).reshape(b_, s_, heads, d // heads)
+        k = (hn @ p["Wk"]).reshape(b_, s_, heads, d // heads)
+        v = (hn @ p["Wv"]).reshape(b_, s_, heads, d // heads)
+        sc = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d // heads)
+        a = jax.nn.softmax(sc, -1)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", a, v).reshape(b_, s_, d)
+        h = h + ctx @ p["Wo"]
+        return h + jax.nn.gelu(h @ p["Wf"]) @ p["Wp"]
+
+    rs = np.random.RandomState(4)
+
+    def mk():
+        s = lambda *sh: jnp.asarray(  # noqa: E731
+            rs.randn(*sh).astype(np.float32) * 0.2)
+        return {"Wq": s(d, d), "Wk": s(d, d), "Wv": s(d, d),
+                "Wo": s(d, d), "Wf": s(d, 2 * d), "Wp": s(2 * d, d)}
+
+    per_stage = [mk() for _ in range(4)]
+    x = jnp.asarray(rs.randn(4, 8, d).astype(np.float32))
+    stacked = place_stacked(stack_stage_params(per_stage), mesh4)
+    y = jax.jit(lambda p, x: pipeline_apply(block, p, x, mesh4,
+                                            microbatches=4))(stacked, x)
+    np.testing.assert_allclose(np.asarray(y),
+                               np.asarray(_ref(per_stage, x, block)),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_batch_not_divisible_raises(mesh4):
+    per_stage = _stages(4, 8)
+    x = jnp.zeros((6, 8), jnp.float32)
+    stacked = place_stacked(stack_stage_params(per_stage), mesh4)
+    with pytest.raises(AssertionError, match="divisible"):
+        pipeline_apply(_mlp_stage, stacked, x, mesh4, microbatches=4)
