@@ -1,0 +1,111 @@
+"""MobileNetV2 (inverted residuals with linear bottlenecks).
+
+Reference parity: the reference's ONNX zoo ships a MobileNetV2
+importer example (`examples/onnx/mobilenet.py`, SURVEY.md §2.3); this
+is the native-model twin used by the same-named example here for the
+export→import round trip.
+
+TPU notes: the depthwise stage is a grouped `lax.conv_general_dilated`
+(feature_group_count == channels) — XLA lowers this to a dedicated
+depthwise convolution on the MXU/VPU, so no im2col-style expansion is
+materialized. ReLU6 is `clip(x, 0, 6)`, fused into the preceding
+conv/BN by XLA.
+"""
+from singa_tpu import autograd, layer, model
+
+from cnn import _dist_update
+
+
+class ReLU6(layer.Layer):
+    def forward(self, x):
+        return autograd.Clip(0.0, 6.0)(x)
+
+
+class ConvBNReLU(layer.Layer):
+    def __init__(self, planes, kernel_size=3, stride=1, group=1):
+        super().__init__()
+        pad = (kernel_size - 1) // 2
+        self.conv = layer.Conv2d(planes, kernel_size, stride=stride,
+                                 padding=pad, group=group, bias=False)
+        self.bn = layer.BatchNorm2d()
+        self.act = ReLU6()
+
+    def forward(self, x):
+        return self.act(self.bn(self.conv(x)))
+
+
+class InvertedResidual(layer.Layer):
+    def __init__(self, inp, oup, stride, expand_ratio):
+        super().__init__()
+        hidden = int(round(inp * expand_ratio))
+        self.use_res = stride == 1 and inp == oup
+        blocks = []
+        if expand_ratio != 1:
+            blocks.append(ConvBNReLU(hidden, kernel_size=1))  # expand
+        blocks.append(ConvBNReLU(hidden, stride=stride, group=hidden))
+        self.blocks = layer.Sequential(*blocks)
+        # linear projection (no activation)
+        self.project = layer.Conv2d(oup, 1, bias=False)
+        self.project_bn = layer.BatchNorm2d()
+
+    def forward(self, x):
+        y = self.project_bn(self.project(self.blocks(x)))
+        return autograd.add(y, x) if self.use_res else y
+
+
+def _make_divisible(v, divisor=8):
+    new_v = max(divisor, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+# (expand_ratio t, channels c, repeats n, stride s) — the V2 paper table
+_CFG = [
+    (1, 16, 1, 1),
+    (6, 24, 2, 2),
+    (6, 32, 3, 2),
+    (6, 64, 4, 2),
+    (6, 96, 3, 1),
+    (6, 160, 3, 2),
+    (6, 320, 1, 1),
+]
+
+
+class MobileNetV2(model.Model):
+    def __init__(self, num_classes=1000, width_mult=1.0, dropout=0.2):
+        super().__init__()
+        self.num_classes = num_classes
+        self.input_size = 224
+        self.dimension = 4
+        in_ch = _make_divisible(32 * width_mult)
+        feats = [ConvBNReLU(in_ch, stride=2)]
+        for t, c, n, s in _CFG:
+            out_ch = _make_divisible(c * width_mult)
+            for i in range(n):
+                feats.append(InvertedResidual(in_ch, out_ch,
+                                              s if i == 0 else 1, t))
+                in_ch = out_ch
+        last = _make_divisible(1280 * max(1.0, width_mult))
+        feats.append(ConvBNReLU(last, kernel_size=1))
+        self.features = layer.Sequential(*feats)
+        self.flatten = layer.Flatten()
+        self.drop = layer.Dropout(dropout)
+        self.fc = layer.Linear(num_classes)
+        self.dist_option = "plain"
+        self.spars = None
+
+    def forward(self, x):
+        y = self.features(x)
+        y = self.flatten(autograd.GlobalAveragePool()(y))
+        return self.fc(self.drop(y))
+
+    def train_one_batch(self, x, y):
+        out = self.forward(x)
+        loss = autograd.softmax_cross_entropy(out, y)
+        _dist_update(self, loss)
+        return out, loss
+
+
+def create_model(num_classes=1000, **kwargs):
+    return MobileNetV2(num_classes=num_classes, **kwargs)

@@ -1,0 +1,86 @@
+"""VGG-11/13/16/19 (optionally batch-normalized).
+
+Reference parity: the reference's ONNX zoo ships VGG-16/19 importer
+examples (`examples/onnx/vgg16.py`, `examples/onnx/vgg19.py`,
+SURVEY.md §2.3); this is the native-model twin used by
+`examples/onnx/vgg.py` for the export→import round trip, built the
+same way as the rest of the model zoo (`examples/cnn/model/*.py`).
+
+Architecture is the torchvision configuration table: stacked 3x3
+convs + maxpools, then a 3-layer classifier head. The head's Linear
+sizes are shape-inferred (lazy init), so 32x32 CIFAR inputs work
+unchanged alongside 224x224.
+"""
+from singa_tpu import autograd, layer, model
+
+from cnn import _dist_update
+
+_CFG = {
+    11: [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    13: [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+         512, 512, "M"],
+    16: [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512,
+         "M", 512, 512, 512, "M"],
+    19: [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M", 512, 512,
+         512, 512, "M", 512, 512, 512, 512, "M"],
+}
+
+
+class VGG(model.Model):
+    def __init__(self, depth=16, num_classes=1000, batch_norm=False,
+                 dropout=0.5):
+        super().__init__()
+        if depth not in _CFG:
+            raise ValueError(f"depth must be one of {sorted(_CFG)}")
+        self.num_classes = num_classes
+        self.input_size = 224
+        self.dimension = 4
+        feats = []
+        for v in _CFG[depth]:
+            if v == "M":
+                feats.append(layer.MaxPool2d(2, 2))
+            else:
+                feats.append(layer.Conv2d(v, 3, padding=1))
+                if batch_norm:
+                    feats.append(layer.BatchNorm2d())
+                feats.append(layer.ReLU())
+        self.features = layer.Sequential(*feats)
+        self.flatten = layer.Flatten()
+        self.fc1 = layer.Linear(4096)
+        self.relu1 = layer.ReLU()
+        self.drop1 = layer.Dropout(dropout)
+        self.fc2 = layer.Linear(4096)
+        self.relu2 = layer.ReLU()
+        self.drop2 = layer.Dropout(dropout)
+        self.fc3 = layer.Linear(num_classes)
+        self.dist_option = "plain"
+        self.spars = None
+
+    def forward(self, x):
+        if x.shape[-1] < 32 or x.shape[-2] < 32:
+            # 5 stride-2 VALID maxpools: below 32px the map collapses
+            # to size 0 (XLA accepts the empty conv silently and the
+            # classifier would train disconnected from the features)
+            raise ValueError(
+                f"VGG needs inputs >= 32x32, got {x.shape[-2:]}; "
+                "resize/tile the input first")
+        y = self.flatten(self.features(x))
+        y = self.drop1(self.relu1(self.fc1(y)))
+        y = self.drop2(self.relu2(self.fc2(y)))
+        return self.fc3(y)
+
+    def train_one_batch(self, x, y):
+        out = self.forward(x)
+        loss = autograd.softmax_cross_entropy(out, y)
+        _dist_update(self, loss)
+        return out, loss
+
+
+def create_model(depth=16, **kwargs):
+    return VGG(depth=depth, **kwargs)
+
+
+vgg11 = lambda **kw: VGG(11, **kw)  # noqa: E731
+vgg13 = lambda **kw: VGG(13, **kw)  # noqa: E731
+vgg16 = lambda **kw: VGG(16, **kw)  # noqa: E731
+vgg19 = lambda **kw: VGG(19, **kw)  # noqa: E731

@@ -48,7 +48,13 @@ def run(args):
 
     kwargs = {"num_classes": num_classes, "num_channels": tx_np.shape[1]}
     if args.model == "resnet":
-        kwargs = {"num_classes": num_classes, "depth": args.depth}
+        kwargs = {"num_classes": num_classes, "depth": args.depth or 50}
+    elif args.model == "vgg":
+        # input channels are shape-inferred at first call (lazy init);
+        # the model ctor validates depth against {11,13,16,19}
+        kwargs = {"num_classes": num_classes, "depth": args.depth or 16}
+    elif args.model == "mobilenet":
+        kwargs = {"num_classes": num_classes}
     m = create_model(args.model, **kwargs)
 
     if args.precision == "bf16":
@@ -69,7 +75,12 @@ def run(args):
     # (alexnet/xception use fixed avg-pool windows; cnn/resnet are
     # shape-agnostic)
     want = getattr(m, "input_size", tx_np.shape[-1])
-    if want != tx_np.shape[-1] and args.model in ("alexnet", "xceptionnet"):
+    if args.model == "vgg":
+        # VGG only needs its 5 stride-2 pools to survive (>=32px), not
+        # the full 224 its ImageNet input_size suggests
+        want = max(32, tx_np.shape[-1])
+    if want != tx_np.shape[-1] and args.model in ("alexnet", "xceptionnet",
+                                                  "vgg"):
         reps = max(1, want // tx_np.shape[-1] + 1)
         tx_np = np.tile(tx_np, (1, 1, reps, reps))[:, :, :want, :want]
         vx_np = np.tile(vx_np, (1, 1, reps, reps))[:, :, :want, :want]
@@ -106,13 +117,16 @@ def run(args):
 
 if __name__ == "__main__":
     p = argparse.ArgumentParser()
-    p.add_argument("model", choices=["cnn", "alexnet", "resnet", "xceptionnet"])
+    p.add_argument("model", choices=["cnn", "alexnet", "resnet",
+                                     "xceptionnet", "vgg", "mobilenet"])
     p.add_argument("data", choices=["mnist", "cifar10", "cifar100"])
     p.add_argument("--data-dir", default=None)
     p.add_argument("--epochs", type=int, default=10)
     p.add_argument("--batch-size", type=int, default=64)
     p.add_argument("--lr", type=float, default=0.005)
-    p.add_argument("--depth", type=int, default=50)
+    p.add_argument("--depth", type=int, default=None,
+                   help="resnet: 18/34/50/101/152 (default 50); "
+                        "vgg: 11/13/16/19 (default 16)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--graph", action="store_true", default=True)
     p.add_argument("--no-graph", dest="graph", action="store_false")

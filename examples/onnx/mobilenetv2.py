@@ -1,0 +1,72 @@
+"""MobileNetV2 export -> import -> eval round trip via SONNX.
+
+Reference parity: `examples/onnx/mobilenet.py` — download MobileNetV2
+from the ONNX model zoo and run it with `sonnx.prepare` (SURVEY.md
+§2.3). No network here, so the zoo download is replaced by exporting
+the in-repo native MobileNetV2 (`examples/cnn/model/mobilenet.py`) —
+which exercises the zoo model's signature ops end to end: grouped
+(depthwise) Conv, Clip (ReLU6), BatchNormalization, residual Add,
+GlobalAveragePool, MatMul — then importing it back and checking
+parity.
+
+Run:  python mobilenetv2.py [--steps N]
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.abspath(os.path.join(_HERE, "..", "..")))
+sys.path.insert(0, os.path.abspath(os.path.join(_HERE, "..", "cnn",
+                                                "model")))
+
+from singa_tpu import sonnx, tensor  # noqa: E402
+from vgg16 import finetune_imported  # noqa: E402  (shared helper)
+
+
+def export_mobilenetv2(path: str, num_classes: int = 10, img: int = 32,
+                       width_mult: float = 1.0):
+    """Build the native MobileNetV2, export to `path`; returns
+    (ref_out, x)."""
+    import mobilenet
+
+    m = mobilenet.create_model(num_classes=num_classes,
+                               width_mult=width_mult)
+    x = tensor.from_numpy(np.random.RandomState(0)
+                          .randn(2, 3, img, img).astype(np.float32))
+    m.compile([x], is_train=False, use_graph=False)
+    m.eval()
+    ref = m.forward(x).to_numpy()
+    sonnx.save(sonnx.to_onnx(m, [x]), path)
+    return ref, x
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=4)
+    ap.add_argument("--onnx", default="/tmp/mobilenetv2.onnx")
+    ap.add_argument("--img", type=int, default=32)
+    ap.add_argument("--classes", type=int, default=10)
+    ap.add_argument("--width", type=float, default=1.0)
+    a = ap.parse_args()
+
+    print(f"exporting native MobileNetV2 (width {a.width}) -> {a.onnx}")
+    ref, x = export_mobilenetv2(a.onnx, num_classes=a.classes, img=a.img,
+                                width_mult=a.width)
+    print(f"  wrote {os.path.getsize(a.onnx) / 1e6:.1f} MB")
+
+    print("importing with sonnx.prepare and checking parity")
+    rep = sonnx.prepare(sonnx.load(a.onnx))
+    out = rep.run([x])[0].to_numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+    print(f"  max |diff| = {np.abs(out - ref).max():.2e}")
+
+    print(f"fine-tuning the imported graph for {a.steps} steps")
+    finetune_imported(a.onnx, a.steps, a.classes, x)
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

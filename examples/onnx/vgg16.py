@@ -1,0 +1,84 @@
+"""VGG export -> import -> eval round trip via SONNX.
+
+Reference parity: `examples/onnx/vgg16.py` / `vgg19.py` — download VGG
+from the ONNX model zoo and run it with `sonnx.prepare` (SURVEY.md
+§2.3). No network here, so the zoo download is replaced by exporting
+the in-repo native VGG (`examples/cnn/model/vgg.py`) — producing the
+same Conv/Relu/MaxPool/MatMul op stream a zoo VGG contains — then
+importing it back and checking output parity and fine-tunability.
+
+Run:  python vgg16.py [--depth 11|13|16|19] [--steps N]
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.abspath(os.path.join(_HERE, "..", "..")))
+sys.path.insert(0, os.path.abspath(os.path.join(_HERE, "..", "cnn",
+                                                "model")))
+
+from singa_tpu import opt, sonnx, tensor  # noqa: E402
+
+
+def export_vgg(path: str, depth: int = 16, num_classes: int = 10,
+               img: int = 32, batch_norm: bool = False):
+    """Build the native VGG, export it to `path`; returns (ref_out, x)."""
+    import vgg
+
+    m = vgg.create_model(depth=depth, num_classes=num_classes,
+                         batch_norm=batch_norm)
+    x = tensor.from_numpy(np.random.RandomState(0)
+                          .randn(2, 3, img, img).astype(np.float32))
+    m.compile([x], is_train=False, use_graph=False)
+    m.eval()
+    ref = m.forward(x).to_numpy()
+    sonnx.save(sonnx.to_onnx(m, [x]), path)
+    return ref, x
+
+
+def finetune_imported(path: str, steps: int, num_classes: int, x):
+    """Fine-tune the imported graph; returns the per-step losses."""
+    ft = sonnx.SONNXModel(sonnx.load(path))
+    ft.set_optimizer(opt.SGD(lr=0.001, momentum=0.9))
+    ft.train()
+    y = tensor.from_numpy(np.random.RandomState(1)
+                          .randint(0, num_classes, x.shape[0])
+                          .astype(np.int32))
+    losses = []
+    for s in range(steps):
+        _, loss = ft.train_one_batch(x, y)
+        losses.append(float(loss.to_numpy()))
+        print(f"  step {s}: loss {losses[-1]:.4f}")
+    return losses
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--depth", type=int, default=16, choices=[11, 13, 16, 19])
+    ap.add_argument("--steps", type=int, default=4)
+    ap.add_argument("--onnx", default="/tmp/vgg.onnx")
+    ap.add_argument("--img", type=int, default=32)
+    ap.add_argument("--classes", type=int, default=10)
+    a = ap.parse_args()
+
+    print(f"exporting native VGG-{a.depth} -> {a.onnx}")
+    ref, x = export_vgg(a.onnx, depth=a.depth, num_classes=a.classes,
+                        img=a.img)
+    print(f"  wrote {os.path.getsize(a.onnx) / 1e6:.1f} MB")
+
+    print("importing with sonnx.prepare and checking parity")
+    rep = sonnx.prepare(sonnx.load(a.onnx))
+    out = rep.run([x])[0].to_numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+    print(f"  max |diff| = {np.abs(out - ref).max():.2e}")
+
+    print(f"fine-tuning the imported graph for {a.steps} steps")
+    finetune_imported(a.onnx, a.steps, a.classes, x)
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,16 @@
+"""jax version shim shared by the parallel modules.
+
+jax >= 0.6 exposes `shard_map` at top level and renamed the
+replication-check kwarg `check_rep` -> `check_vma`; older releases
+only have `jax.experimental.shard_map`. Import from here so the next
+rename is a one-file fix.
+"""
+import jax
+
+try:
+    shard_map = jax.shard_map
+    _CHECK_KW = {"check_vma": False}
+except AttributeError:  # pragma: no cover - old-jax fallback
+    from jax.experimental.shard_map import shard_map  # noqa: F401
+
+    _CHECK_KW = {"check_rep": False}  # the old API's kwarg name

@@ -8,8 +8,8 @@ parameters and the dispatched token tensor are sharding-annotated on
 the "expert" axis and XLA inserts the all-to-alls on the dispatch and
 combine einsums (over ICI on a real slice).
 
-  * top-1 gating with an auxiliary load-balancing loss (Shazeer
-    et al.'s mean(gates)*mean(assignments)*E^2 form);
+  * top-1 gating with an auxiliary load-balancing loss (the
+    Switch/GShard E*sum(mean(gates)*mean(assignments)) form);
   * fixed expert capacity C = ceil(T/E * capacity_factor); overflow
     tokens are dropped (their output is 0, the standard behavior) —
     combine weights renormalize nothing, matching GShard;
@@ -82,16 +82,21 @@ def moe_ffn(params: MoEParams, x, *, capacity_factor: float = 1.25,
     e = params.gate_w.shape[-1]
     cap = max(1, math.ceil(t / e * capacity_factor))
 
-    logits = (xt @ params.gate_w).astype(jnp.float32)     # (T, E)
+    # f32 router (GShard convention): cast OPERANDS so the gating
+    # matmul itself runs in f32 even under bf16 AMP — near-tie logits
+    # decide expert assignment and capacity drops
+    logits = (xt.astype(jnp.float32)
+              @ params.gate_w.astype(jnp.float32))        # (T, E)
     gates = jax.nn.softmax(logits, -1)
     idx = jnp.argmax(gates, -1)                           # (T,)
     onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)    # (T, E)
     gate_top = jnp.sum(gates * onehot, -1)                # (T,)
 
-    # auxiliary load-balancing loss (mean gate mass x mean assignment
-    # fraction per expert, scaled by E^2 -> 1.0 at perfect balance)
+    # auxiliary load-balancing loss, the standard Switch/GShard form
+    # E * sum_e(mean_gate_mass_e * mean_assignment_frac_e) -> 1.0 at
+    # perfect balance
     aux = jnp.mean(gates, 0) * jnp.mean(onehot, 0)
-    aux_loss = jnp.sum(aux) * (e * e) / e
+    aux_loss = jnp.sum(aux) * e
 
     # position of each token within its expert's capacity buffer
     # (count of same-expert tokens before it; 0 in unassigned columns)

@@ -21,7 +21,6 @@ layout, so the optimizer update is uniform across chips.
 """
 from __future__ import annotations
 
-from functools import partial
 from typing import Callable
 
 import jax
@@ -29,13 +28,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:
-    shard_map = jax.shard_map
-    _CHECK_KW = {"check_vma": False}
-except AttributeError:  # pragma: no cover - old-jax fallback
-    from jax.experimental.shard_map import shard_map
-
-    _CHECK_KW = {"check_rep": False}
+from ._compat import _CHECK_KW, shard_map
 
 
 def _stage_params_spec(params, axis_name):

@@ -29,13 +29,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:  # jax >= 0.6 exposes shard_map at top level
-    shard_map = jax.shard_map
-    _CHECK_KW = {"check_vma": False}
-except AttributeError:  # pragma: no cover - old-jax fallback
-    from jax.experimental.shard_map import shard_map
-
-    _CHECK_KW = {"check_rep": False}  # the old API's kwarg name
+from ._compat import _CHECK_KW, shard_map
 
 
 def _neg_big(dtype):
