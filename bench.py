@@ -394,7 +394,7 @@ def stage_parity(steps):
     rc = subprocess.call(
         [sys.executable, "-u",
          os.path.join(HERE, "tools", "parity_cifar10.py"),
-         "--steps", str(steps), "--tpu-timeout", "240"],
+         "--steps", str(steps), "--tpu-timeout", "420"],
         stdout=sys.stderr)
     print(json.dumps({"ok": rc == 0}), flush=True)
 
@@ -502,9 +502,11 @@ def main():
                 result_extra["lm_config"] = lm["config"]
         if remaining() > 180:
             run_stage("pallas", [], min(300, remaining() - 60))
-        if remaining() > 240:
+        # gate must cover the stage's internal 420s TPU wait plus the
+        # CPU columns, or run_stage SIGKILLs it mid-graceful-timeout
+        if remaining() > 540:
             run_stage("parity", ["--steps", "30"],
-                      min(420, remaining() - 30))
+                      min(600, remaining() - 30))
     else:
         result_extra["error"] = "tpu_unreachable"
 

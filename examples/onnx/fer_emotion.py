@@ -1,0 +1,100 @@
+"""FER+ emotion classifier export -> import -> infer via SONNX.
+
+Reference parity: `examples/onnx/fer_emotion.py` — download the
+Emotion-FERPlus model from the ONNX zoo, run `sonnx.prepare`, and
+report the softmax emotion distribution for a face crop (SURVEY.md
+§2.3). No network here, so the zoo download is replaced by building
+the same VGG-ish topology natively (conv/BN/ReLU stacks with
+maxpools over a 1x64x64 grayscale input, a 8-way linear head for the
+FER+ emotion classes), exporting, importing back, and checking
+parity + the softmax postprocessing the reference example ships.
+
+Run:  python fer_emotion.py
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.abspath(os.path.join(_HERE, "..", "..")))
+
+from singa_tpu import layer, model, sonnx, tensor  # noqa: E402
+
+EMOTIONS = ["neutral", "happiness", "surprise", "sadness", "anger",
+            "disgust", "fear", "contempt"]
+
+
+class _Block(layer.Layer):
+    def __init__(self, planes, convs=2):
+        super().__init__()
+        seq = []
+        for _ in range(convs):
+            seq += [layer.Conv2d(planes, 3, padding=1), layer.ReLU()]
+        seq.append(layer.MaxPool2d(2, 2))
+        self.seq = layer.Sequential(*seq)
+
+    def forward(self, x):
+        return self.seq(x)
+
+
+class FerPlus(model.Model):
+    """Emotion-FERPlus shape: 1x64x64 in, 8 emotion logits out."""
+
+    def __init__(self):
+        super().__init__()
+        self.features = layer.Sequential(
+            _Block(64), _Block(128), _Block(256), _Block(256))
+        self.flatten = layer.Flatten()
+        self.fc1 = layer.Linear(1024)
+        self.relu = layer.ReLU()
+        self.drop = layer.Dropout(0.4)
+        self.fc2 = layer.Linear(len(EMOTIONS))
+
+    def forward(self, x):
+        y = self.flatten(self.features(x))
+        return self.fc2(self.drop(self.relu(self.fc1(y))))
+
+
+def softmax_np(z):
+    e = np.exp(z - z.max(-1, keepdims=True))
+    return e / e.sum(-1, keepdims=True)
+
+
+def export_fer(path: str):
+    m = FerPlus()
+    x = tensor.from_numpy(np.random.RandomState(0)
+                          .randn(1, 1, 64, 64).astype(np.float32))
+    m.compile([x], is_train=False, use_graph=False)
+    m.eval()
+    ref = m.forward(x).to_numpy()
+    sonnx.save(sonnx.to_onnx(m, [x]), path)
+    return ref, x
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--onnx", default="/tmp/fer_emotion.onnx")
+    a = ap.parse_args()
+
+    print(f"exporting native FER+ classifier -> {a.onnx}")
+    ref, x = export_fer(a.onnx)
+    print(f"  wrote {os.path.getsize(a.onnx) / 1e6:.1f} MB")
+
+    print("importing with sonnx.prepare and checking parity")
+    rep = sonnx.prepare(sonnx.load(a.onnx))
+    out = rep.run([x])[0].to_numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+    print(f"  max |diff| = {np.abs(out - ref).max():.2e}")
+
+    probs = softmax_np(out)[0]
+    order = np.argsort(probs)[::-1]
+    print("emotion distribution (random weights; pipeline demo):")
+    for i in order[:3]:
+        print(f"  {EMOTIONS[i]:<10} {probs[i]:.3f}")
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

@@ -23,7 +23,7 @@ sys.path.insert(0, os.path.abspath(os.path.join(_HERE, "..", "cnn",
                                                 "model")))
 
 from singa_tpu import sonnx, tensor  # noqa: E402
-from vgg16 import finetune_imported  # noqa: E402  (shared helper)
+from zoo_util import finetune_imported  # noqa: E402
 
 
 def export_mobilenetv2(path: str, num_classes: int = 10, img: int = 32,

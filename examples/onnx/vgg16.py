@@ -20,7 +20,8 @@ sys.path.insert(0, os.path.abspath(os.path.join(_HERE, "..", "..")))
 sys.path.insert(0, os.path.abspath(os.path.join(_HERE, "..", "cnn",
                                                 "model")))
 
-from singa_tpu import opt, sonnx, tensor  # noqa: E402
+from singa_tpu import sonnx, tensor  # noqa: E402
+from zoo_util import finetune_imported  # noqa: E402
 
 
 def export_vgg(path: str, depth: int = 16, num_classes: int = 10,
@@ -37,22 +38,6 @@ def export_vgg(path: str, depth: int = 16, num_classes: int = 10,
     ref = m.forward(x).to_numpy()
     sonnx.save(sonnx.to_onnx(m, [x]), path)
     return ref, x
-
-
-def finetune_imported(path: str, steps: int, num_classes: int, x):
-    """Fine-tune the imported graph; returns the per-step losses."""
-    ft = sonnx.SONNXModel(sonnx.load(path))
-    ft.set_optimizer(opt.SGD(lr=0.001, momentum=0.9))
-    ft.train()
-    y = tensor.from_numpy(np.random.RandomState(1)
-                          .randint(0, num_classes, x.shape[0])
-                          .astype(np.int32))
-    losses = []
-    for s in range(steps):
-        _, loss = ft.train_one_batch(x, y)
-        losses.append(float(loss.to_numpy()))
-        print(f"  step {s}: loss {losses[-1]:.4f}")
-    return losses
 
 
 def main():

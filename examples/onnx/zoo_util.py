@@ -1,0 +1,28 @@
+"""Shared helpers for the ONNX zoo-style examples.
+
+Reference context: the reference's `examples/onnx/*.py` scripts share
+download/preprocess utilities; here the shared piece is the
+import-and-fine-tune step every classification round trip
+demonstrates (SURVEY.md §2.3)."""
+import numpy as np
+
+from singa_tpu import opt, sonnx, tensor
+
+
+def finetune_imported(path: str, steps: int, num_classes: int, x,
+                      lr: float = 0.001):
+    """Load the ONNX file at `path` as a trainable `SONNXModel` and
+    fine-tune it for `steps` on random labels; returns per-step
+    losses."""
+    ft = sonnx.SONNXModel(sonnx.load(path))
+    ft.set_optimizer(opt.SGD(lr=lr, momentum=0.9))
+    ft.train()
+    y = tensor.from_numpy(np.random.RandomState(1)
+                          .randint(0, num_classes, x.shape[0])
+                          .astype(np.int32))
+    losses = []
+    for s in range(steps):
+        _, loss = ft.train_one_batch(x, y)
+        losses.append(float(loss.to_numpy()))
+        print(f"  step {s}: loss {losses[-1]:.4f}")
+    return losses

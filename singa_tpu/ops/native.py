@@ -75,8 +75,8 @@ class ConvHandle:
         return oh, ow
 
 
-@partial(jax.jit, static_argnums=(0,), inline=True)
-def _conv2d_nobias(handle: ConvHandle, x, w):
+@partial(jax.jit, static_argnums=(0, 3), inline=True)
+def _conv2d_nobias(handle: ConvHandle, x, w, precision):
     ph, pw = handle.padding
     # fp32 operands: force fp32 accumulation explicitly. bf16 (AMP):
     # omit preferred_element_type — the MXU still accumulates fp32
@@ -92,6 +92,7 @@ def _conv2d_nobias(handle: ConvHandle, x, w):
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
         feature_group_count=handle.groups,
         preferred_element_type=pref,
+        precision=precision,
     ).astype(x.dtype)
 
 
@@ -106,7 +107,11 @@ def conv2d(handle: ConvHandle, x, w, b=None):
     from .. import tensor as tensor_mod
 
     x, w, b = tensor_mod.amp_cast(x, w, b)
-    y = _conv2d_nobias(handle, x, w)
+    # Without an explicit precision, TPU lowers fp32 convs to bf16
+    # passes (~1e-4 rel error) and the CPU-vs-TPU loss-parity gate
+    # fails; thread the same policy the matmul ops use. Static jit arg
+    # so a policy change retraces.
+    y = _conv2d_nobias(handle, x, w, tensor_mod.get_matmul_precision())
     if b is not None:
         y = y + b.reshape(1, -1, 1, 1)
     return y
@@ -131,8 +136,9 @@ class ConvTransposeHandle:
         self.bias = bias
 
 
-@partial(jax.jit, static_argnums=(0,), inline=True)
-def _conv_transpose2d_nobias(handle: ConvTransposeHandle, x, w):
+@partial(jax.jit, static_argnums=(0, 3), inline=True)
+def _conv_transpose2d_nobias(handle: ConvTransposeHandle, x, w,
+                             precision):
     """Transposed conv as an input-dilated conv with the flipped,
     IO-swapped kernel — the same lowering XLA uses for conv input
     gradients, so it rides the MXU like a forward conv."""
@@ -155,6 +161,7 @@ def _conv_transpose2d_nobias(handle: ConvTransposeHandle, x, w):
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
         feature_group_count=g,
         preferred_element_type=pref,
+        precision=precision,
     ).astype(x.dtype)
 
 
@@ -163,7 +170,8 @@ def conv_transpose2d(handle: ConvTransposeHandle, x, w, b=None):
     from .. import tensor as tensor_mod
 
     x, w, b = tensor_mod.amp_cast(x, w, b)
-    y = _conv_transpose2d_nobias(handle, x, w)
+    y = _conv_transpose2d_nobias(handle, x, w,
+                                 tensor_mod.get_matmul_precision())
     if b is not None:
         y = y + b.reshape(1, -1, 1, 1)
     return y

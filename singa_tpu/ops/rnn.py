@@ -114,8 +114,17 @@ class RNNHandle:
 # ---------------------------------------------------------------------------
 # Cell steps (h·W_hhᵀ inside scan; x projections precomputed outside)
 # ---------------------------------------------------------------------------
+def _mm(a, b):
+    """Matmul under the framework precision policy (fp32 'highest' by
+    default — TPU would otherwise run these in bf16 passes and the
+    Char-RNN cross-backend loss parity drifts)."""
+    from .. import tensor as tensor_mod
+
+    return jnp.matmul(a, b, precision=tensor_mod.get_matmul_precision())
+
+
 def _lstm_step(xw, h, c, W_hh, b_hh):
-    g = xw + h @ W_hh.T + b_hh
+    g = xw + _mm(h, W_hh.T) + b_hh
     i, f, gg, o = jnp.split(g, 4, axis=-1)
     i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
     gg = jnp.tanh(gg)
@@ -125,7 +134,7 @@ def _lstm_step(xw, h, c, W_hh, b_hh):
 
 
 def _gru_step(xw, h, W_hh, b_hh):
-    hw = h @ W_hh.T + b_hh  # linear BEFORE reset (cuDNN convention)
+    hw = _mm(h, W_hh.T) + b_hh  # linear BEFORE reset (cuDNN convention)
     xr, xz, xn = jnp.split(xw, 3, axis=-1)
     hr, hz, hn = jnp.split(hw, 3, axis=-1)
     r = jax.nn.sigmoid(xr + hr)
@@ -135,7 +144,7 @@ def _gru_step(xw, h, W_hh, b_hh):
 
 
 def _plain_step(xw, h, W_hh, b_hh, act):
-    return act(xw + h @ W_hh.T + b_hh)
+    return act(xw + _mm(h, W_hh.T) + b_hh)
 
 
 def _scan_direction(handle: RNNHandle, mode, xs_proj, h0, c0, W_hh, b_hh,
@@ -188,7 +197,7 @@ def rnn_forward(handle: RNNHandle, x, hx, cx, w, training: bool = False,
             b_ih = seg.get(("b_ih", layer, d), zeros_b)
             b_hh = seg.get(("b_hh", layer, d), zeros_b)
             # Hoisted input projection: one (T*B, in)×(in, G*H) matmul.
-            xs_proj = inp @ W_ih.T + b_ih
+            xs_proj = _mm(inp, W_ih.T) + b_ih
             idx = layer * D + d
             h0 = hx[idx]
             c0 = cx[idx] if handle.mode == "lstm" else None

@@ -82,11 +82,13 @@ def moe_ffn(params: MoEParams, x, *, capacity_factor: float = 1.25,
     e = params.gate_w.shape[-1]
     cap = max(1, math.ceil(t / e * capacity_factor))
 
-    # f32 router (GShard convention): cast OPERANDS so the gating
-    # matmul itself runs in f32 even under bf16 AMP — near-tie logits
-    # decide expert assignment and capacity drops
-    logits = (xt.astype(jnp.float32)
-              @ params.gate_w.astype(jnp.float32))        # (T, E)
+    # f32 router (GShard convention): cast OPERANDS and pin HIGHEST
+    # precision so the gating matmul truly runs in f32 even on TPU
+    # (default precision would lower f32 operands to bf16 passes) —
+    # near-tie logits decide expert assignment and capacity drops
+    logits = jnp.matmul(xt.astype(jnp.float32),
+                        params.gate_w.astype(jnp.float32),
+                        precision=jax.lax.Precision.HIGHEST)  # (T, E)
     gates = jax.nn.softmax(logits, -1)
     idx = jnp.argmax(gates, -1)                           # (T,)
     onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)    # (T, E)

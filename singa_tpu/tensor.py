@@ -290,7 +290,8 @@ class Tensor:
         return _wrap(-self.data, self)
 
     def __matmul__(self, o):
-        return _wrap(jnp.matmul(self.data, _raw(o)), self)
+        return _wrap(jnp.matmul(self.data, _raw(o),
+                                precision=get_matmul_precision()), self)
 
     def __lt__(self, o):
         return _wrap((self.data < _raw(o)).astype(float32), self)
@@ -590,11 +591,13 @@ matmul = mult
 
 
 def einsum(subscripts: str, *ts: Tensor) -> Tensor:
-    return _wrap(jnp.einsum(subscripts, *[t.data for t in ts]), ts[0])
+    return _wrap(jnp.einsum(subscripts, *[t.data for t in ts],
+                            precision=get_matmul_precision()), ts[0])
 
 
 def tensordot(a: Tensor, b: Tensor, axes=2) -> Tensor:
-    return _wrap(jnp.tensordot(a.data, b.data, axes=axes), a)
+    return _wrap(jnp.tensordot(a.data, b.data, axes=axes,
+                               precision=get_matmul_precision()), a)
 
 
 # ---------------------------------------------------------------------------

@@ -42,7 +42,7 @@ def test_vgg_export_import_eval_roundtrip(tmp_path):
 
 def test_mobilenetv2_roundtrip_depthwise_and_clip(tmp_path):
     from mobilenetv2 import export_mobilenetv2
-    from vgg16 import finetune_imported
+    from zoo_util import finetune_imported
 
     path = str(tmp_path / "mbv2.onnx")
     ref, x = export_mobilenetv2(path, num_classes=10, img=32,
@@ -63,6 +63,37 @@ def test_mobilenetv2_roundtrip_depthwise_and_clip(tmp_path):
     # imported graph fine-tunes
     losses = finetune_imported(path, 4, 10, x)
     assert losses[-1] < losses[0]
+
+
+def test_tiny_yolov2_roundtrip_and_decode(tmp_path):
+    from tiny_yolov2 import decode_grid, export_tiny_yolov2
+
+    path = str(tmp_path / "tyv2.onnx")
+    ref, x = export_tiny_yolov2(path, img=96)  # 96 -> 3x3 grid
+    mp = sonnx.load(path)
+    rep = sonnx.prepare(mp)
+    out = rep.run([x])[0].to_numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+    assert out.shape[1] == 125  # 5 anchors x (5 + 20 classes)
+    ops = {n.op_type for n in mp.graph.node}
+    assert {"Conv", "BatchNormalization", "LeakyRelu", "MaxPool"} <= ops
+    # decode runs and produces well-formed candidates at a low threshold
+    boxes = decode_grid(out[0], conf_threshold=0.0)
+    assert len(boxes) == 5 * 3 * 3  # every anchor x cell above conf 0
+    assert all(0.0 <= b[4] <= 1.0 and 0 <= b[5] < 20 for b in boxes)
+
+
+def test_fer_emotion_roundtrip_softmax(tmp_path):
+    from fer_emotion import EMOTIONS, export_fer, softmax_np
+
+    path = str(tmp_path / "fer.onnx")
+    ref, x = export_fer(path)
+    rep = sonnx.prepare(sonnx.load(path))
+    out = rep.run([x])[0].to_numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+    assert out.shape == (1, len(EMOTIONS))
+    p = softmax_np(out)[0]
+    assert abs(p.sum() - 1.0) < 1e-5 and (p >= 0).all()
 
 
 def test_gpt2_causality_and_finetune(tmp_path):
