@@ -16,6 +16,7 @@ Layer map (mirrors SURVEY.md §1, re-designed TPU-first):
 __version__ = "0.1.0"
 
 from . import autograd  # noqa: F401
+from . import checkpoint  # noqa: F401
 from . import data  # noqa: F401
 from . import device  # noqa: F401
 from . import initializer  # noqa: F401

@@ -1,0 +1,186 @@
+"""Asynchronous checkpointing + rotation (TPU-native upgrade).
+
+Reference context: the reference's only checkpoint path is the
+synchronous `Model.save_states` zip write (`python/singa/model.py`,
+SURVEY.md §5 checkpoint row) — training blocks for the full
+device→host transfer + serialization. The TPU-native design exploits
+functional immutability: `Model.state_snapshot` captures the current
+device buffers BY REFERENCE (zero copies — a subsequent train step
+builds new buffers, it cannot mutate the captured ones), and a
+background thread performs the device→host transfer and zip write
+while the chip keeps training. This is the orbax-style async save
+SURVEY §5 planned ("same zip format first; orbax-style async later").
+
+Backpressure: each pending save pins one full historical set of
+model+optimizer buffers (the snapshot holds references, so XLA cannot
+free them). `save()` therefore blocks the caller until the number of
+in-flight writes drops below `max_pending` (default 1) — the same
+wait-before-save discipline orbax uses — bounding extra HBM to
+`max_pending` state sets.
+
+    ckpt = AsyncCheckpointer()
+    h = ckpt.save(model, "step_100.zip", aux_states={"epoch": 3})
+    ...training continues...
+    h.wait()            # or ckpt.wait_all() before exit
+
+`CheckpointManager` adds step-numbered rotation on top:
+
+    mgr = CheckpointManager("ckpts/", keep=3)
+    mgr.save(model, step=100)            # async; prunes old steps
+    step = mgr.restore_latest(model)     # -> 100 (or None if empty)
+"""
+from __future__ import annotations
+
+import os
+import re
+import threading
+from typing import Callable, Dict, Optional
+
+from .model import Model
+
+__all__ = ["AsyncCheckpointer", "CheckpointManager"]
+
+
+class SaveHandle:
+    """Future for one in-flight save."""
+
+    def __init__(self):
+        self._done = threading.Event()
+        self.error: Optional[BaseException] = None
+        self.path: Optional[str] = None
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until the save is durable; re-raises a writer error."""
+        ok = self._done.wait(timeout)
+        if ok and self.error is not None:
+            raise self.error
+        return ok
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+
+class AsyncCheckpointer:
+    """Background-thread checkpoint writer with bounded in-flight
+    writes (writers are serialized, so publishes land in save order)."""
+
+    def __init__(self, max_pending: int = 1):
+        assert max_pending >= 1
+        self.max_pending = max_pending
+        self._write_lock = threading.Lock()  # serializes writers
+        self._handles = []  # completed-or-pending, for wait_all
+
+    def _drain_to(self, n: int):
+        """Block until at most `n` saves are in flight; drop completed
+        handles (errors still surface via the caller-held handle)."""
+        pending = [h for h in self._handles if not h.done]
+        while len(pending) > n:
+            pending[0]._done.wait()
+            pending = [h for h in pending if not h.done]
+        self._handles = pending
+
+    def save(self, model: Model, fpath: str,
+             aux_states: Optional[Dict] = None,
+             _after_publish: Optional[Callable[[], None]] = None
+             ) -> SaveHandle:
+        """Snapshot NOW (cheap, by reference), write in the background.
+        Blocks first if `max_pending` saves are already in flight.
+        Returns a `SaveHandle`; the file is complete when `wait()`
+        returns / `done` is True. `_after_publish` runs in the writer
+        thread after the atomic rename (rotation hook)."""
+        self._drain_to(self.max_pending - 1)
+        states, meta = model.state_snapshot(aux_states)
+        handle = SaveHandle()
+        handle.path = fpath
+
+        def _write():
+            with self._write_lock:
+                try:
+                    tmp = fpath + ".tmp"
+                    Model.write_states_zip(tmp, states, meta)
+                    os.replace(tmp, fpath)  # atomic publish
+                    if _after_publish is not None:
+                        _after_publish()
+                except BaseException as e:  # surfaced via wait()
+                    handle.error = e
+                    try:
+                        os.remove(fpath + ".tmp")
+                    except OSError:
+                        pass
+                finally:
+                    handle._done.set()
+
+        t = threading.Thread(target=_write, name="singa-tpu-ckpt",
+                             daemon=True)
+        t.start()
+        self._handles.append(handle)
+        return handle
+
+    def wait_all(self, timeout: Optional[float] = None):
+        """Block until every issued save is durable (call before
+        process exit — writers are daemon threads)."""
+        for h in list(self._handles):
+            h.wait(timeout)
+        self._handles = [h for h in self._handles if not h.done]
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.wait_all()
+        return False
+
+
+class CheckpointManager:
+    """Step-numbered async checkpoints with keep-N rotation. Pruning
+    runs in the writer thread after each atomic publish, so rotation
+    only ever counts fully-written checkpoints and cannot race an
+    in-flight save."""
+
+    _PAT = re.compile(r"^step_(\d+)\.zip$")
+
+    def __init__(self, directory: str, keep: int = 3,
+                 max_pending: int = 1):
+        self.directory = directory
+        self.keep = keep
+        os.makedirs(directory, exist_ok=True)
+        self._ckpt = AsyncCheckpointer(max_pending=max_pending)
+
+    def _path(self, step: int) -> str:
+        return os.path.join(self.directory, f"step_{step}.zip")
+
+    def steps(self):
+        """Completed checkpoint steps, ascending."""
+        out = []
+        for name in os.listdir(self.directory):
+            m = self._PAT.match(name)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def save(self, model: Model, step: int,
+             aux_states: Optional[Dict] = None) -> SaveHandle:
+        def prune():  # runs in the writer thread, post-publish
+            done = self.steps()
+            for s in done[:max(0, len(done) - self.keep)]:
+                try:
+                    os.remove(self._path(s))
+                except OSError:
+                    pass
+
+        return self._ckpt.save(model, self._path(step), aux_states,
+                               _after_publish=prune)
+
+    def restore_latest(self, model: Model):
+        """Load the newest completed checkpoint; returns (step, aux)
+        or (None, {}) when the directory is empty."""
+        self._ckpt.wait_all()
+        steps = self.steps()
+        if not steps:
+            return None, {}
+        aux = model.load_states(self._path(steps[-1]))
+        return steps[-1], aux
+
+    def wait_all(self):
+        self._ckpt.wait_all()

@@ -291,12 +291,15 @@ class Model(Layer):
         return self._jit_fwd(*xs)
 
     # -- checkpoint --------------------------------------------------------
-    def save_states(self, fpath: str, aux_states: Optional[Dict] = None):
-        """Reference: `Model.save_states` — zipfile of per-tensor npz
-        plus a json meta blob with aux states."""
+    def state_snapshot(self, aux_states: Optional[Dict] = None):
+        """Capture a consistent (states, meta) snapshot of the model +
+        optimizer. The returned arrays are the CURRENT device buffers
+        by reference — jax arrays are immutable, so a training step
+        after this call produces new buffers and cannot mutate the
+        snapshot (what makes `checkpoint.AsyncCheckpointer` safe
+        without copies)."""
         model_states = self.get_states()
-        states = {k: v.to_numpy() for k, v in model_states.items()}
-        aux = aux_states or {}
+        states = {k: v.data for k, v in model_states.items()}
         opt_meta = {}
         if self._optimizer is not None:
             opt_meta["step_counter"] = int(self._optimizer.step_counter)
@@ -308,17 +311,30 @@ class Model(Layer):
                 if pname is None:
                     continue
                 for slot, arr in slots.items():
-                    states[f"__opt__/{pname}/{slot}"] = np.asarray(arr)
+                    states[f"__opt__/{pname}/{slot}"] = arr
+        meta = {"aux": _jsonable(aux_states or {}), "opt": opt_meta,
+                "names": list(states.keys())}
+        return states, meta
+
+    @staticmethod
+    def write_states_zip(fpath: str, states: Dict, meta: Dict):
+        """Serialize a `state_snapshot` to the checkpoint zip format
+        (device→host transfer happens here, per array)."""
         with zipfile.ZipFile(fpath, "w") as zf:
             for name, arr in states.items():
                 buf = io.BytesIO()
-                np.save(buf, arr)
-                zf.writestr(name.replace("/", "__SLASH__") + ".npy", buf.getvalue())
-            zf.writestr(
-                "__meta__.json",
-                json.dumps({"aux": _jsonable(aux), "opt": opt_meta,
-                            "names": list(states.keys())}),
-            )
+                np.save(buf, np.asarray(arr))
+                zf.writestr(name.replace("/", "__SLASH__") + ".npy",
+                            buf.getvalue())
+            zf.writestr("__meta__.json", json.dumps(meta))
+
+    def save_states(self, fpath: str, aux_states: Optional[Dict] = None):
+        """Reference: `Model.save_states` — zipfile of per-tensor npz
+        plus a json meta blob with aux states. Synchronous; see
+        `singa_tpu.checkpoint.AsyncCheckpointer` for the non-blocking
+        variant."""
+        states, meta = self.state_snapshot(aux_states)
+        self.write_states_zip(fpath, states, meta)
 
     def load_states(self, fpath: str) -> Dict:
         """Reference: `Model.load_states`. Returns aux states dict."""

@@ -1,0 +1,193 @@
+"""Async checkpointing tests (`singa_tpu/checkpoint.py`).
+
+Reference context: the reference only has the synchronous
+`Model.save_states` (SURVEY.md §5 checkpoint row); the async writer is
+the TPU-native upgrade — these tests pin its safety property (the
+snapshot is immune to training steps issued after `save()`), the
+sync/async format equivalence, rotation, and error surfacing.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from singa_tpu import autograd, checkpoint, device, layer, model, opt, tensor
+
+
+class MLP(model.Model):
+    def __init__(self, hidden=8, classes=3):
+        super().__init__(name="mlp_ckpt")
+        self.fc1 = layer.Linear(hidden)
+        self.relu = layer.ReLU()
+        self.fc2 = layer.Linear(classes)
+
+    def forward(self, x):
+        return self.fc2(self.relu(self.fc1(x)))
+
+    def train_one_batch(self, x, y):
+        out = self.forward(x)
+        loss = autograd.softmax_cross_entropy(out, y)
+        self._optimizer.backward_and_update(loss)
+        return out, loss
+
+
+def _build(seed=7):
+    dev = device.get_default_device()
+    dev.SetRandSeed(seed)
+    rng = np.random.RandomState(0)
+    tx = tensor.from_numpy(rng.randn(16, 6).astype(np.float32), device=dev)
+    ty = tensor.from_numpy(rng.randint(0, 3, 16).astype(np.int32),
+                           device=dev)
+    m = MLP()
+    m.set_optimizer(opt.SGD(lr=0.1, momentum=0.9))
+    m.compile([tx], is_train=True, use_graph=False)
+    return m, tx, ty
+
+
+def _states_np(m):
+    return {k: np.asarray(v.to_numpy()) for k, v in m.get_states().items()}
+
+
+def test_async_save_matches_sync(tmp_path):
+    m, tx, ty = _build()
+    m.train_one_batch(tx, ty)
+    sync_path = str(tmp_path / "sync.zip")
+    async_path = str(tmp_path / "async.zip")
+    m.save_states(sync_path, aux_states={"epoch": 2})
+    with checkpoint.AsyncCheckpointer() as ckpt:
+        h = ckpt.save(m, async_path, aux_states={"epoch": 2})
+    assert h.done and h.error is None
+
+    m2, _, _ = _build(seed=9)
+    aux_s = m2.load_states(sync_path)
+    s_sync = _states_np(m2)
+    m3, _, _ = _build(seed=11)
+    aux_a = m3.load_states(async_path)
+    s_async = _states_np(m3)
+    assert aux_s == aux_a == {"epoch": 2}
+    assert s_sync.keys() == s_async.keys()
+    for k in s_sync:
+        np.testing.assert_array_equal(s_sync[k], s_async[k])
+
+
+def test_snapshot_immune_to_later_steps(tmp_path):
+    """The core async-safety property: train steps issued AFTER save()
+    must not leak into the checkpoint (jax immutability makes the
+    by-reference snapshot consistent without copies)."""
+    m, tx, ty = _build()
+    m.train_one_batch(tx, ty)
+    at_save = _states_np(m)
+    path = str(tmp_path / "snap.zip")
+    ckpt = checkpoint.AsyncCheckpointer()
+    h = ckpt.save(m, path)
+    for _ in range(5):  # keep training while the writer runs
+        m.train_one_batch(tx, ty)
+    h.wait()
+    after = _states_np(m)
+    # training moved the weights...
+    assert any(np.abs(after[k] - at_save[k]).max() > 1e-6
+               for k in at_save)
+    # ...but the checkpoint holds the values from save() time
+    m2, _, _ = _build(seed=13)
+    m2.load_states(path)
+    loaded = _states_np(m2)
+    for k in at_save:
+        np.testing.assert_array_equal(loaded[k], at_save[k])
+
+
+def test_manager_rotation_and_restore(tmp_path):
+    d = str(tmp_path / "ckpts")
+    mgr = checkpoint.CheckpointManager(d, keep=2)
+    m, tx, ty = _build()
+    for step in (1, 2, 3, 4):
+        m.train_one_batch(tx, ty)
+        mgr.save(m, step=step, aux_states={"step": step})
+    mgr.wait_all()
+    final = _states_np(m)
+    assert mgr.steps() == [3, 4]  # keep=2 rotation
+
+    m2, _, _ = _build(seed=21)
+    step, aux = mgr.restore_latest(m2)
+    assert step == 4 and aux == {"step": 4}
+    for k, v in _states_np(m2).items():
+        np.testing.assert_array_equal(v, final[k])
+
+
+def test_restore_latest_empty_dir(tmp_path):
+    mgr = checkpoint.CheckpointManager(str(tmp_path / "empty"))
+    m, _, _ = _build()
+    step, aux = mgr.restore_latest(m)
+    assert step is None and aux == {}
+
+
+def test_rotation_correct_with_slow_writer(tmp_path, monkeypatch):
+    """Writers slower than the save loop: rotation still lands on the
+    last `keep` steps because pruning runs post-publish in the writer
+    thread, and backpressure bounds in-flight saves."""
+    import time
+
+    real_write = model.Model.write_states_zip
+
+    def slow_write(fpath, states, meta):
+        time.sleep(0.15)
+        real_write(fpath, states, meta)
+
+    monkeypatch.setattr(model.Model, "write_states_zip",
+                        staticmethod(slow_write))
+    d = str(tmp_path / "slow")
+    mgr = checkpoint.CheckpointManager(d, keep=2, max_pending=2)
+    m, tx, ty = _build()
+    for step in (1, 2, 3, 4):
+        mgr.save(m, step=step)
+    mgr.wait_all()
+    assert mgr.steps() == [3, 4]
+
+
+def test_backpressure_blocks_caller(tmp_path, monkeypatch):
+    """With max_pending=1, a second save() waits for the first write
+    to finish before snapshotting (bounds pinned buffers to one set)."""
+    import time
+
+    real_write = model.Model.write_states_zip
+
+    def slow_write(fpath, states, meta):
+        time.sleep(0.2)
+        real_write(fpath, states, meta)
+
+    monkeypatch.setattr(model.Model, "write_states_zip",
+                        staticmethod(slow_write))
+    m, tx, ty = _build()
+    ckpt = checkpoint.AsyncCheckpointer(max_pending=1)
+    h1 = ckpt.save(m, str(tmp_path / "a.zip"))
+    assert not h1.done  # first save really is asynchronous
+    h2 = ckpt.save(m, str(tmp_path / "b.zip"))
+    assert h1.done  # save() blocked until the first write drained
+    h2.wait()
+
+
+def test_save_error_surfaces_on_wait(tmp_path):
+    m, tx, ty = _build()
+    ckpt = checkpoint.AsyncCheckpointer()
+    h = ckpt.save(m, str(tmp_path / "no_such_dir" / "x.zip"))
+    with pytest.raises(OSError):
+        h.wait()
+
+
+def test_optimizer_slots_roundtrip_async(tmp_path):
+    """Momentum slots travel through the async path by param name."""
+    m, tx, ty = _build()
+    for _ in range(3):
+        m.train_one_batch(tx, ty)
+    path = str(tmp_path / "opt.zip")
+    with checkpoint.AsyncCheckpointer() as ckpt:
+        ckpt.save(m, path)
+
+    m2, tx2, ty2 = _build(seed=31)
+    m2.train_one_batch(tx2, ty2)  # materialize slots, then overwrite
+    m2.load_states(path)
+    assert m2._optimizer.step_counter == m._optimizer.step_counter
+    # continuing from the checkpoint reproduces the source run exactly
+    _, l1 = m.train_one_batch(tx, ty)
+    _, l2 = m2.train_one_batch(tx, ty)
+    np.testing.assert_allclose(float(l1.to_numpy()),
+                               float(l2.to_numpy()), rtol=1e-6)
