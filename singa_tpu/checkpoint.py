@@ -3,12 +3,16 @@
 Reference context: the reference's only checkpoint path is the
 synchronous `Model.save_states` zip write (`python/singa/model.py`,
 SURVEY.md §5 checkpoint row) — training blocks for the full
-device→host transfer + serialization. The TPU-native design exploits
-functional immutability: `Model.state_snapshot` captures the current
-device buffers BY REFERENCE (zero copies — a subsequent train step
-builds new buffers, it cannot mutate the captured ones), and a
-background thread performs the device→host transfer and zip write
-while the chip keeps training. This is the orbax-style async save
+device→host transfer + serialization. The TPU-native design:
+`Model.state_snapshot` captures the current device buffers and
+`save()` immediately forks them with DEVICE-SIDE copies (HBM→HBM,
+asynchronously dispatched — no host sync), then a background thread
+performs the device→host transfer and zip write while the chip keeps
+training. The copy is required, not just caution: the graph-mode
+train step donates the param/slot buffers to XLA
+(`model._JitStep`, donate_argnums), which marks them deleted after
+the next step regardless of Python references — a by-reference
+snapshot would die with them. This is the orbax-style async save
 SURVEY §5 planned ("same zip format first; orbax-style async later").
 
 Backpressure: each pending save pins one full historical set of
@@ -72,13 +76,19 @@ class AsyncCheckpointer:
         self._handles = []  # completed-or-pending, for wait_all
 
     def _drain_to(self, n: int):
-        """Block until at most `n` saves are in flight; drop completed
-        handles (errors still surface via the caller-held handle)."""
+        """Block until at most `n` saves are in flight. Completed OK
+        handles are dropped; FAILED ones are retained so `wait_all()`
+        (and the context manager) still surface the error even when
+        the caller discarded its handle."""
+        failed = [h for h in self._handles
+                  if h.done and h.error is not None]
         pending = [h for h in self._handles if not h.done]
         while len(pending) > n:
             pending[0]._done.wait()
+            failed += [h for h in pending
+                       if h.done and h.error is not None]
             pending = [h for h in pending if not h.done]
-        self._handles = pending
+        self._handles = failed + pending
 
     def save(self, model: Model, fpath: str,
              aux_states: Optional[Dict] = None,
@@ -89,8 +99,15 @@ class AsyncCheckpointer:
         Returns a `SaveHandle`; the file is complete when `wait()`
         returns / `done` is True. `_after_publish` runs in the writer
         thread after the atomic rename (rotation hook)."""
+        import jax.numpy as jnp
+
         self._drain_to(self.max_pending - 1)
         states, meta = model.state_snapshot(aux_states)
+        # Fork the buffers on device (async dispatch, HBM bandwidth
+        # only): the graph-mode step DONATES the originals to XLA, so
+        # holding them by reference is not enough (see module doc).
+        states = {k: (jnp.copy(v) if hasattr(v, "devices") else v)
+                  for k, v in states.items()}
         handle = SaveHandle()
         handle.path = fpath
 

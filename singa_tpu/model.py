@@ -294,10 +294,11 @@ class Model(Layer):
     def state_snapshot(self, aux_states: Optional[Dict] = None):
         """Capture a consistent (states, meta) snapshot of the model +
         optimizer. The returned arrays are the CURRENT device buffers
-        by reference — jax arrays are immutable, so a training step
-        after this call produces new buffers and cannot mutate the
-        snapshot (what makes `checkpoint.AsyncCheckpointer` safe
-        without copies)."""
+        by reference. NOTE: a graph-mode train step DONATES these
+        buffers to XLA (`_JitStep`, donate_argnums) — deferred readers
+        must fork them first (`checkpoint.AsyncCheckpointer` makes
+        device-side copies); immediate serialization (`save_states`)
+        is safe as-is."""
         model_states = self.get_states()
         states = {k: v.data for k, v in model_states.items()}
         opt_meta = {}

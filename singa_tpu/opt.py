@@ -53,6 +53,46 @@ class ExponentialDecay(DecayScheduler):
         return self.init_value * (self.decay_rate ** p)
 
 
+class CosineDecay(DecayScheduler):
+    """Cosine annealing from `init_value` to `final_value` over
+    `decay_steps`, flat afterwards. No reference equivalent (the
+    reference ships Constant/ExponentialDecay only); standard for the
+    transformer workloads this framework adds. jit-safe: works with
+    traced step values."""
+
+    def __init__(self, init_value, decay_steps, final_value=0.0):
+        super().__init__(init_value)
+        self.decay_steps = decay_steps
+        self.final_value = final_value
+
+    def __call__(self, step):
+        p = jnp.clip(step / self.decay_steps, 0.0, 1.0)
+        cos = 0.5 * (1.0 + jnp.cos(jnp.pi * p))
+        return self.final_value + (self.init_value
+                                   - self.final_value) * cos
+
+
+class WarmupWrapper(DecayScheduler):
+    """Linear warmup from 0 to the inner scheduler's value over
+    `warmup_steps`, then defers to `inner(step - warmup_steps)`.
+    Composes with any `DecayScheduler`."""
+
+    def __init__(self, inner: "DecayScheduler", warmup_steps: int):
+        super().__init__(inner.init_value)
+        self.inner = inner
+        self.warmup_steps = warmup_steps
+
+    def __call__(self, step):
+        w = self.warmup_steps
+        warm = self.init_value * (step + 1) / max(1, w)
+        after = self.inner(jnp.maximum(0, step - w)
+                           if not isinstance(step, int)
+                           else max(0, step - w))
+        if isinstance(step, int):
+            return warm if step < w else after
+        return jnp.where(step < w, warm, after)
+
+
 class Optimizer:
     """Reference: `opt.Optimizer`. Holds step counter + per-param state.
 

@@ -95,6 +95,43 @@ def test_snapshot_immune_to_later_steps(tmp_path):
         np.testing.assert_array_equal(loaded[k], at_save[k])
 
 
+def test_snapshot_survives_graph_mode_donation(tmp_path):
+    """Graph mode donates param buffers to XLA each step; the async
+    save must fork them on device or the writer reads deleted arrays."""
+    dev = device.get_default_device()
+    dev.SetRandSeed(17)
+    rng = np.random.RandomState(2)
+    tx = tensor.from_numpy(rng.randn(16, 6).astype(np.float32))
+    ty = tensor.from_numpy(rng.randint(0, 3, 16).astype(np.int32))
+    m = MLP()
+    m.set_optimizer(opt.SGD(lr=0.1, momentum=0.9))
+    m.compile([tx], is_train=True, use_graph=True)  # donating path
+    m.train_one_batch(tx, ty)
+    at_save = _states_np(m)
+    path = str(tmp_path / "donated.zip")
+    ckpt = checkpoint.AsyncCheckpointer()
+    h = ckpt.save(m, path)
+    for _ in range(3):  # donates the pre-save buffers
+        m.train_one_batch(tx, ty)
+    h.wait()  # must not raise "Array has been deleted"
+    m2, _, _ = _build(seed=19)
+    m2.load_states(path)
+    for k, v in _states_np(m2).items():
+        np.testing.assert_array_equal(v, at_save[k])
+
+
+def test_wait_all_surfaces_discarded_handle_error(tmp_path):
+    """CheckpointManager users never hold handles; wait_all must still
+    re-raise a writer failure that happened earlier."""
+    m, tx, ty = _build()
+    ckpt = checkpoint.AsyncCheckpointer()
+    h = ckpt.save(m, str(tmp_path / "nodir" / "x.zip"))
+    h._done.wait()  # writer failed; caller discards the handle
+    ckpt.save(m, str(tmp_path / "ok.zip"))  # drain must keep the error
+    with pytest.raises(OSError):
+        ckpt.wait_all()
+
+
 def test_manager_rotation_and_restore(tmp_path):
     d = str(tmp_path / "ckpts")
     mgr = checkpoint.CheckpointManager(d, keep=2)

@@ -96,6 +96,22 @@ def test_fer_emotion_roundtrip_softmax(tmp_path):
     assert abs(p.sum() - 1.0) < 1e-5 and (p >= 0).all()
 
 
+def test_arcface_roundtrip_normalized_embeddings(tmp_path):
+    from arcface import cosine, export_arcface
+
+    path = str(tmp_path / "arc.onnx")
+    ref, x = export_arcface(path, dim=32, img=32)
+    rep = sonnx.prepare(sonnx.load(path))
+    out = rep.run([x])[0].to_numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+    # embeddings are unit-norm (the L2-normalize head exported intact)
+    np.testing.assert_allclose(np.linalg.norm(out, axis=-1), 1.0,
+                               atol=1e-5)
+    assert abs(cosine(out[0], ref[0]) - 1.0) < 1e-5
+    ops = {n.op_type for n in sonnx.load(path).graph.node}
+    assert {"ReduceSum", "Sqrt", "Div", "Mul"} <= ops
+
+
 def test_gpt2_causality_and_finetune(tmp_path):
     from gpt2 import GPT2, build_gpt2_onnx
 
