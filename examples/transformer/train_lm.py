@@ -47,6 +47,13 @@ def main():
     ap.add_argument("--sample", type=int, default=120)
     ap.add_argument("--temperature", type=float, default=0.8)
     a = ap.parse_args()
+    if a.steps < 1:
+        ap.error("--steps must be >= 1 (the first batch compiles the "
+                 "model)")
+    max_len = max(256, a.seq)
+    if len("the ") + a.sample > max_len:
+        ap.error(f"--sample {a.sample} exceeds the model context "
+                 f"({max_len} incl. the 4-char prompt)")
 
     chars = sorted(set(CORPUS))
     ids_of = {c: i for i, c in enumerate(chars)}
@@ -56,7 +63,7 @@ def main():
     dev = device.create_tpu_device()
     dev.SetRandSeed(1)
     m = TransformerLM(vocab, d_model=128, num_heads=4, num_layers=3,
-                      max_len=max(256, a.seq))
+                      max_len=max_len)
     m.set_optimizer(opt.SGD(
         lr=opt.WarmupWrapper(opt.CosineDecay(0.3, a.steps), 20),
         momentum=0.9))

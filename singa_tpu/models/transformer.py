@@ -98,28 +98,31 @@ class TransformerLM(model.Model):
         def lin(l):
             return (l.W.data, l.b.data if l.bias else None)
 
+        def ln(l):  # thread each layer's configured eps through
+            return (l.gamma.data, l.beta.data, l.eps)
+
         blocks = []
         for blk in self.blocks._seq:
             a = blk.attn
             blocks.append({
-                "ln1": (blk.ln1.gamma.data, blk.ln1.beta.data),
+                "ln1": ln(blk.ln1),
                 "q": lin(a.q_proj), "k": lin(a.k_proj),
                 "v": lin(a.v_proj), "o": lin(a.o_proj),
-                "ln2": (blk.ln2.gamma.data, blk.ln2.beta.data),
+                "ln2": ln(blk.ln2),
                 "fc1": lin(blk.fc1), "fc2": lin(blk.fc2),
             })
         return {
             "embed": self.embed.W.data, "pos": self.pos_embed.W.data,
             "blocks": blocks,
-            "ln_f": (self.ln_f.gamma.data, self.ln_f.beta.data),
+            "ln_f": ln(self.ln_f),
             "head": jnp.asarray(self.head.W.data),
         }
 
     @staticmethod
-    def _ln(x, gb, eps=1e-5):
+    def _ln(x, gbe):
         import jax.numpy as jnp
 
-        g, b = gb
+        g, b, eps = gbe
         mu = jnp.mean(x, axis=-1, keepdims=True)
         var = jnp.var(x, axis=-1, keepdims=True)
         return (x - mu) / jnp.sqrt(var + eps) * g + b
@@ -190,7 +193,8 @@ class TransformerLM(model.Model):
         import jax.numpy as jnp
         from jax import lax
 
-        key_ = (B, P, max_new, float(temperature), int(top_k))
+        key_ = (B, P, max_new, float(temperature), int(top_k),
+                autograd._policy_key())  # policy baked in at trace time
         cache_dict = getattr(self, "_gen_cache", None)
         if cache_dict is None:
             cache_dict = self._gen_cache = {}
@@ -256,7 +260,12 @@ class TransformerLM(model.Model):
         L = len(params["blocks"])
         H = self.blocks._seq[0].attn.num_heads
         D = params["embed"].shape[-1] // H
-        cache = jnp.zeros((L, 2, B, H, T, D), params["embed"].dtype)
+        # cache padded to max_len (the documented [L,2,B,H,max_len,D]
+        # shape): generation length then only affects the scan length,
+        # not the traced cache shape, so varying max_new_tokens does
+        # not multiply distinct cache layouts
+        cache = jnp.zeros((L, 2, B, H, self.max_len, D),
+                          params["embed"].dtype)
         run = self._compiled_decode(B, P, max_new_tokens, temperature,
                                     top_k)
         new = np.asarray(run(params, jnp.asarray(prompt_ids), cache,

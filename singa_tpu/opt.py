@@ -105,6 +105,19 @@ class Optimizer:
         self.step_counter = 0
         # id(param) -> {"slot_name": array}; insertion-ordered.
         self.states: Dict[int, Dict[str, jnp.ndarray]] = {}
+        # Optional global-norm gradient clipping (no reference
+        # equivalent; standard for the transformer workloads). Applies
+        # in `backward_and_update` — including inside the mesh-mode
+        # jitted step, where grads are already psum-reduced, so the
+        # clip is by TRUE global norm. The eager DistOpt streaming
+        # paths (fusedSynch et al.) bypass it: they see one grad at a
+        # time by design.
+        self.clip_norm: Optional[float] = None
+
+    def set_clip_norm(self, value: Optional[float]):
+        """Clip gradients to `value` by global L2 norm (None = off)."""
+        self.clip_norm = value
+        return self
 
     @property
     def lr_value(self):
@@ -130,9 +143,22 @@ class Optimizer:
 
     def backward_and_update(self, loss: Tensor):
         """Reference: `opt.SGD.backward_and_update` — run autograd and
-        apply updates per (param, grad) pair in emission order."""
-        for p, g in autograd.iter_backward(loss):
-            self.update(p, g)
+        apply updates per (param, grad) pair in emission order (with
+        optional global-norm clipping, which buffers the pairs first
+        but preserves the deterministic update order)."""
+        if self.clip_norm is None:
+            for p, g in autograd.iter_backward(loss):
+                self.update(p, g)
+            self.step()
+            return loss
+        pairs = [(p, g.data if isinstance(g, Tensor) else g)
+                 for p, g in autograd.iter_backward(loss)]
+        sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                 for _, g in pairs)
+        scale = jnp.minimum(1.0, self.clip_norm
+                            / (jnp.sqrt(sq) + 1e-12))
+        for p, g in pairs:
+            self.update(p, (g.astype(jnp.float32) * scale).astype(g.dtype))
         self.step()
         return loss
 

@@ -113,6 +113,60 @@ def test_sgd_with_cosine_scheduler_trains():
     assert np.isfinite(vals[-1]).all()
 
 
+def test_clip_norm_scales_update():
+    """Global-norm clip: with clip_norm >= true norm the update is
+    untouched; with a small clip_norm every grad is scaled by
+    clip/norm."""
+    from singa_tpu import autograd, device, layer, model
+
+    class M(model.Model):
+        def __init__(self):
+            super().__init__()
+            self.fc = layer.Linear(4)
+
+        def forward(self, x):
+            return self.fc(x)
+
+        def train_one_batch(self, x, y):
+            out = self.forward(x)
+            loss = autograd.mse_loss(out, y)
+            self._optimizer.backward_and_update(loss)
+            return out, loss
+
+    def run(clip, graph=False):
+        device.get_default_device().SetRandSeed(3)
+        rng = np.random.RandomState(0)
+        x = tensor.from_numpy(rng.randn(8, 5).astype(np.float32))
+        y = tensor.from_numpy(rng.randn(8, 4).astype(np.float32))
+        m = M()
+        sgd = opt.SGD(lr=1.0)  # lr 1: delta == (clipped) grad
+        sgd.clip_norm = clip
+        m.set_optimizer(sgd)
+        m.compile([x], is_train=True, use_graph=graph)
+        before = {k: v.to_numpy().copy() for k, v in m.get_states().items()}
+        if graph:
+            m(x, y)
+        else:
+            m.train_one_batch(x, y)
+        after = {k: v.to_numpy() for k, v in m.get_states().items()}
+        return {k: before[k] - after[k] for k in before}
+
+    raw = run(None)
+    gnorm = np.sqrt(sum((d ** 2).sum() for d in raw.values()))
+    unclipped = run(clip=float(gnorm * 10))
+    for k in raw:
+        np.testing.assert_allclose(unclipped[k], raw[k], rtol=1e-6)
+    clipped = run(clip=float(gnorm / 2))
+    for k in raw:
+        np.testing.assert_allclose(clipped[k], raw[k] * 0.5,
+                                   rtol=1e-5, atol=1e-7)
+    # identical inside the jitted graph-mode step
+    clipped_g = run(clip=float(gnorm / 2), graph=True)
+    for k in raw:
+        np.testing.assert_allclose(clipped_g[k], clipped[k],
+                                   rtol=1e-5, atol=1e-7)
+
+
 def test_half_precision_grad_applies_to_fp32_param():
     p = make_param([1.0])
     g16 = tensor.from_numpy(np.array([0.5], np.float32)).as_type(tensor.bfloat16)
