@@ -87,7 +87,7 @@ class TransformerLM(model.Model):
     # -- jitted KV-cache generation (inference path) --------------------
     #
     # TPU-native incremental decoding: a static-shape KV cache
-    # [L, 2, B, H, max_len, D] plus a lax.scan decode loop, compiled
+    # [L, 2, B, H, P+max_new, D] plus a lax.scan decode loop, compiled
     # once. The math mirrors the training stack exactly (pre-norm
     # blocks, exact-erf gelu, 1/sqrt(D) attention scale); the parity
     # test pins greedy decode against full-context forward argmax.
@@ -260,12 +260,11 @@ class TransformerLM(model.Model):
         L = len(params["blocks"])
         H = self.blocks._seq[0].attn.num_heads
         D = params["embed"].shape[-1] // H
-        # cache padded to max_len (the documented [L,2,B,H,max_len,D]
-        # shape): generation length then only affects the scan length,
-        # not the traced cache shape, so varying max_new_tokens does
-        # not multiply distinct cache layouts
-        cache = jnp.zeros((L, 2, B, H, self.max_len, D),
-                          params["embed"].dtype)
+        # cache sized to the actual T = P + max_new (each (P, max_new)
+        # pair is its own compiled program via key_ anyway — the scan
+        # length is static — so padding to max_len would only make
+        # every decode step attend over unused slots)
+        cache = jnp.zeros((L, 2, B, H, T, D), params["embed"].dtype)
         run = self._compiled_decode(B, P, max_new_tokens, temperature,
                                     top_k)
         new = np.asarray(run(params, jnp.asarray(prompt_ids), cache,

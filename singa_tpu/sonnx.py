@@ -132,6 +132,9 @@ def _make_attr(name: str, value) -> P.AttributeProto:
         if value and isinstance(value[0], (float, np.floating)):
             a.type = P.AttributeProto.FLOATS
             a.floats.extend(float(v) for v in value)
+        elif value and isinstance(value[0], str):
+            a.type = P.AttributeProto.STRINGS
+            a.strings.extend(v.encode() for v in value)
         else:
             a.type = P.AttributeProto.INTS
             a.ints.extend(int(v) for v in value)
@@ -357,10 +360,103 @@ def _export_node(op, in_names: List[str], out_names: List[str],
         gb.node("ScatterElements", ins, out_names, axis=op.axis)
     elif cls == "Einsum":
         gb.node("Einsum", in_names, out_names, equation=op.equation)
+    elif cls == "_RNN":
+        _export_rnn(op, in_names, out_names, gb)
     else:
         raise ValueError(
             f"sonnx export: op {cls} has no ONNX mapping "
             "(reference sonnx.py raises the same way for unsupported ops)")
+
+
+def _export_rnn(op, in_names, out_names, gb):
+    """Export the packed-blob `_RNN` op (ops/rnn.py) as a chain of
+    ONNX LSTM/GRU/RNN nodes, one per layer — ONNX recurrent nodes are
+    single-layer. The packed cuDNN-order blob is unpacked into the
+    ONNX W/R/B initializers (inverse gate reorder); each layer's
+    3-axis ONNX Y is transposed+reshaped back to our (S, B, nd*H)
+    activation layout for the next layer / downstream consumers."""
+    h = op.handle
+    mode = h.mode
+    onnx_op = {"lstm": "LSTM", "gru": "GRU",
+               "tanh": "RNN", "relu": "RNN"}[mode]
+    nd = h.num_directions
+    L = h.num_layers
+    hidden = h.hidden_size
+    gh = h.num_gates * hidden
+    perm = _RNN_GATE_PERM_INV[mode]
+    seg = {k: np.asarray(v)
+           for k, v in h.unpack(op.inputs[3].to_numpy()).items()}
+    seq, batch, _ = op.inputs[0].shape
+    zeros_b = np.zeros((gh,), np.float32)
+
+    def init_state(name, li):
+        """Per-layer [nd, B, H] slice of the (L*nd, B, H) state. Always
+        a Slice NODE on the graph value — slicing a captured VALUE at
+        export time would disconnect a declared h0/c0 graph input."""
+        if L == 1:
+            return name
+        sl = f"{name}_l{li}_slice"
+        gb.node("Slice",
+                [name, gb.const(np.asarray([li * nd], np.int64), "st"),
+                 gb.const(np.asarray([(li + 1) * nd], np.int64), "en"),
+                 gb.const(np.asarray([0], np.int64), "ax")], [sl])
+        return sl
+
+    cur = in_names[0]
+    hys, cys = [], []
+    for li in range(L):
+        W = np.stack([_gate_reord(seg[("W_ih", li, d)], hidden, perm)
+                      for d in range(nd)])
+        R = np.stack([_gate_reord(seg[("W_hh", li, d)], hidden, perm)
+                      for d in range(nd)])
+        B = np.stack([np.concatenate([
+            _gate_reord(seg.get(("b_ih", li, d), zeros_b), hidden, perm),
+            _gate_reord(seg.get(("b_hh", li, d), zeros_b), hidden, perm)])
+            for d in range(nd)])
+        ins = [cur, gb.const(W, f"rnn_W_l{li}"),
+               gb.const(R, f"rnn_R_l{li}"), gb.const(B, f"rnn_B_l{li}"),
+               "", init_state(in_names[1], li)]
+        if mode == "lstm":
+            ins.append(init_state(in_names[2], li))
+        y4 = f"{out_names[0]}_l{li}_y4"
+        hy = f"{out_names[0]}_l{li}_hy"
+        cy = f"{out_names[0]}_l{li}_cy"
+        attrs = {"hidden_size": hidden,
+                 "direction": "bidirectional" if nd == 2 else "forward"}
+        if mode == "gru":
+            attrs["linear_before_reset"] = 1
+        if onnx_op == "RNN":
+            attrs["activations"] = [mode.capitalize()] * nd
+        gb.node(onnx_op, ins,
+                [y4, hy] + ([cy] if mode == "lstm" else []), **attrs)
+        hys.append(hy)
+        if mode == "lstm":
+            cys.append(cy)
+        # ONNX Y (S, nd, B, H) -> our layer activation (S, B, nd*H)
+        tr = f"{out_names[0]}_l{li}_tr"
+        gb.node("Transpose", [y4], [tr], perm=[0, 2, 1, 3])
+        nxt = (out_names[0] if li == L - 1
+               else f"{out_names[0]}_l{li}_flat")
+        gb.node("Reshape",
+                [tr, gb.const(np.asarray([seq, batch, nd * hidden],
+                                         np.int64), "yshape")], [nxt])
+        cur = nxt
+
+    def join(parts, out):
+        if len(parts) == 1:
+            gb.node("Identity", parts, [out])
+        else:
+            gb.node("Concat", parts, [out], axis=0)
+
+    join(hys, out_names[1])
+    if mode == "lstm":
+        join(cys, out_names[2])
+    else:
+        # non-LSTM cy output is all-zero in our op; emit a matching
+        # constant so the graph stays well-formed
+        gb.node("Identity",
+                [gb.const(np.zeros((L * nd, batch, hidden), np.float32),
+                          "rnn_cy_zero")], [out_names[2]])
 
 
 def _topo_ops(outputs: Sequence[Tensor]) -> List:
@@ -418,9 +514,24 @@ def to_onnx(model, inputs: Sequence[Tensor],
     g.name = model_name
     gb = _GraphBuilder(g)
 
+    topo = _topo_ops(outputs)
+    # Packed RNN blobs are re-emitted by _export_rnn as the unpacked
+    # ONNX W/R/B initializers; skip the blob param unless something
+    # else also consumes it, or the weights ship twice.
+    use_count: Dict[int, int] = {}
+    rnn_w_ids = set()
+    for op_ in topo:
+        for i_, t_ in enumerate(op_.inputs):
+            use_count[id(t_)] = use_count.get(id(t_), 0) + 1
+            if type(op_).__name__ == "_RNN" and i_ == 3:
+                rnn_w_ids.add(id(t_))
+    rnn_w_only = {i_ for i_ in rnn_w_ids if use_count[i_] == 1}
+
     names: Dict[int, str] = {}
     if hasattr(model, "get_params"):
         for pname, pt in model.get_params().items():
+            if id(pt) in rnn_w_only:
+                continue
             names[id(pt)] = pname
             g.initializer.append(to_tensor_proto(pname, pt.to_numpy()))
     for i, t in enumerate(ins):
@@ -449,8 +560,11 @@ def to_onnx(model, inputs: Sequence[Tensor],
                 (id(t.creator), getattr(t, "creator_index", 0)))
         return names.get(id(t))
 
-    for op in _topo_ops(outputs):
-        in_names = [_in_name(t) for t in op.inputs]
+    for op in topo:
+        skip_w = type(op).__name__ == "_RNN"
+        in_names = [("" if skip_w and i == 3 and id(t) in rnn_w_only
+                     else _in_name(t))
+                    for i, t in enumerate(op.inputs)]
         outs = []
         for i in range(op.num_outputs):
             nm = f"{op.name}_out{i}".replace("#", "_")
@@ -718,6 +832,101 @@ def _import_pad(ctx, node):
     return autograd.Pad(mode, pads, cval)(x)
 
 
+# ONNX <-> cuDNN recurrent gate orders. ONNX LSTM weights are iofc;
+# our packed blob (ops/rnn.py) uses cuDNN ifgo. ONNX GRU is zrh; ours
+# is rzn (linear_before_reset). Vanilla RNN has one gate (no reorder).
+_RNN_GATE_PERM = {"lstm": [0, 2, 3, 1], "gru": [1, 0, 2],
+                  "tanh": [0], "relu": [0]}
+_RNN_GATE_PERM_INV = {"lstm": [0, 3, 1, 2], "gru": [1, 0, 2],
+                      "tanh": [0], "relu": [0]}
+
+
+def _gate_reord(a, hidden, perm):
+    """Reorder the gate blocks of a (G*H, X) weight / (G*H,) bias."""
+    g = len(perm)
+    return a.reshape(g, hidden, -1)[perm].reshape(g * hidden, *a.shape[1:])
+
+
+def _import_rnn_common(ctx, node, mode):
+    from .ops.rnn import RNNHandle
+
+    if _attr(node, "layout", 0) != 0:
+        raise ValueError("sonnx: LSTM/GRU/RNN layout=1 is unsupported "
+                         "(re-export seq-major)")
+    if len(node.input) > 4 and node.input[4]:
+        raise ValueError("sonnx: sequence_lens is unsupported")
+    direction = _attr(node, "direction", "forward")
+    if direction not in ("forward", "bidirectional"):
+        raise ValueError(f"sonnx: direction {direction!r} unsupported")
+    if mode == "gru" and _attr(node, "linear_before_reset", 0) != 1:
+        raise ValueError("sonnx: GRU linear_before_reset=0 is "
+                         "unsupported (this framework implements the "
+                         "cuDNN/=1 semantics)")
+    acts = _attr(node, "activations")
+    if mode in ("tanh", "relu"):
+        if acts:
+            low = [a.lower() for a in acts]
+            if any(a not in ("tanh", "relu") for a in low):
+                raise ValueError(f"sonnx: RNN activations {acts!r} "
+                                 "unsupported")
+            if len(set(low)) > 1:
+                raise ValueError(
+                    "sonnx: per-direction RNN activations "
+                    f"{acts!r} unsupported (one cell mode per node)")
+            mode = low[0]
+    elif acts:
+        nd_acts = {"lstm": ["sigmoid", "tanh", "tanh"],
+                   "gru": ["sigmoid", "tanh"]}[mode]
+        want = nd_acts * (2 if direction == "bidirectional" else 1)
+        if [a.lower() for a in acts] != want:
+            raise ValueError("sonnx: non-default LSTM/GRU activations "
+                             "unsupported")
+    W = ctx.const(node.input[1])
+    R = ctx.const(node.input[2])
+    if W is None or R is None:
+        raise ValueError("sonnx: LSTM/GRU/RNN W/R must be "
+                         "initializers/constants")
+    W = np.asarray(W, np.float32)
+    R = np.asarray(R, np.float32)
+    nd, gh, in_dim = W.shape
+    hidden = int(_attr(node, "hidden_size", R.shape[-1]))
+    B = (ctx.const(node.input[3])
+         if len(node.input) > 3 and node.input[3] else None)
+    B = (np.asarray(B, np.float32) if B is not None
+         else np.zeros((nd, 2 * gh), np.float32))
+    perm = _RNN_GATE_PERM[mode]
+
+    handle = RNNHandle(in_dim, hidden, 1, mode=mode, bias=True,
+                       bidirectional=(nd == 2))
+    seg = {}
+    for d in range(nd):
+        seg[("W_ih", 0, d)] = _gate_reord(W[d], hidden, perm)
+        seg[("W_hh", 0, d)] = _gate_reord(R[d], hidden, perm)
+        seg[("b_ih", 0, d)] = _gate_reord(B[d][:gh], hidden, perm)
+        seg[("b_hh", 0, d)] = _gate_reord(B[d][gh:], hidden, perm)
+    blob = tensor_mod.from_numpy(np.asarray(handle.pack(seg)),
+                                 device=ctx.device)
+
+    x = ctx.tensor(node.input[0])
+    seq, batch, _ = x.shape
+
+    def state(idx):
+        if len(node.input) > idx and node.input[idx]:
+            return ctx.tensor(node.input[idx])
+        return tensor_mod.from_numpy(
+            np.zeros((nd, batch, hidden), np.float32), device=ctx.device)
+
+    hx = state(5)
+    cx = state(6)  # ignored by non-LSTM modes
+    y, hy, cy = autograd.rnn_op(handle, x, hx, cx, blob)
+    # ours: (S, B, nd*H) with [fwd|bwd] blocks -> ONNX Y (S, nd, B, H)
+    y4 = autograd.transpose(
+        autograd.reshape(y, (seq, batch, nd, hidden)), (0, 2, 1, 3))
+    if mode == "lstm":
+        return (y4, hy, cy)
+    return (y4, hy)
+
+
 def _import_where(ctx, node):
     cond = ctx.const(node.input[0])
     if cond is None:
@@ -826,6 +1035,9 @@ _IMPORTERS = {
     "Unsqueeze": lambda ctx, n: autograd.Unsqueeze(
         _axes_arg(ctx, n))(ctx.tensor(n.input[0])),
     "Pad": _import_pad,
+    "LSTM": lambda ctx, n: _import_rnn_common(ctx, n, "lstm"),
+    "GRU": lambda ctx, n: _import_rnn_common(ctx, n, "gru"),
+    "RNN": lambda ctx, n: _import_rnn_common(ctx, n, "tanh"),
     "Expand": lambda ctx, n: autograd.Expand(
         _req_const(ctx, n, 1, "shape").tolist())(ctx.tensor(n.input[0])),
     "DepthToSpace": lambda ctx, n: autograd.DepthToSpace(

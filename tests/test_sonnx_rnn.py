@@ -1,0 +1,117 @@
+"""ONNX LSTM/GRU/RNN interchange tests.
+
+The reference's sonnx has no recurrent-op support; this extends the
+export/import surface to the ONNX recurrent trio with the cuDNN<->ONNX
+gate reorder (iofc<->ifgo for LSTM, zrh<->rzn for GRU). Round trips
+pin the full path: packed-blob layer -> ONNX LSTM/GRU/RNN node chain
+(one per layer, Y-layout adapters between) -> re-import through the
+packing code -> identical outputs.
+"""
+import numpy as np
+import pytest
+
+from singa_tpu import device, model, rnn, sonnx, tensor
+
+
+class _Wrap(model.Model):
+    def __init__(self, layer_):
+        super().__init__()
+        self.rnn = layer_
+
+    def forward(self, x):
+        y, _ = self.rnn(x)
+        return y
+
+
+def _roundtrip(layer_, seq=5, batch=3, feat=4, tmp_path=None, name="m"):
+    dev = device.get_default_device()
+    dev.SetRandSeed(9)
+    m = _Wrap(layer_)
+    x = tensor.from_numpy(np.random.RandomState(0)
+                          .randn(seq, batch, feat).astype(np.float32))
+    m.compile([x], is_train=False, use_graph=False)
+    m.eval()
+    ref = m.forward(x).to_numpy()
+    path = str(tmp_path / f"{name}.onnx")
+    sonnx.save(sonnx.to_onnx(m, [x]), path)
+    mp = sonnx.load(path)
+    rep = sonnx.prepare(mp)
+    out = rep.run([x])[0].to_numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+    return mp, ref
+
+
+def test_lstm_roundtrip_single_layer(tmp_path):
+    mp, ref = _roundtrip(rnn.LSTM(6), tmp_path=tmp_path, name="lstm1")
+    ops = [n.op_type for n in mp.graph.node]
+    assert ops.count("LSTM") == 1
+    assert ref.shape == (5, 3, 6)
+
+
+def test_lstm_roundtrip_bidirectional_two_layers(tmp_path):
+    mp, ref = _roundtrip(rnn.LSTM(6, num_layers=2, bidirectional=True),
+                         tmp_path=tmp_path, name="lstm2b")
+    ops = [n.op_type for n in mp.graph.node]
+    assert ops.count("LSTM") == 2  # one ONNX node per layer
+    assert ref.shape == (5, 3, 12)  # nd*H
+    # the exported node carries the bidirectional direction attr
+    lstm = [n for n in mp.graph.node if n.op_type == "LSTM"][0]
+    attrs = {a.name: a for a in lstm.attribute}
+    assert attrs["direction"].s == b"bidirectional"
+
+
+def test_gru_roundtrip_sets_linear_before_reset(tmp_path):
+    mp, _ = _roundtrip(rnn.GRU(5), tmp_path=tmp_path, name="gru")
+    g = [n for n in mp.graph.node if n.op_type == "GRU"][0]
+    attrs = {a.name: a.i for a in g.attribute if a.name ==
+             "linear_before_reset"}
+    assert attrs["linear_before_reset"] == 1
+
+
+def test_vanilla_rnn_roundtrip_relu(tmp_path):
+    mp, _ = _roundtrip(rnn.RNN(4, nonlinearity="relu"),
+                       tmp_path=tmp_path, name="rnn_relu")
+    n = [n for n in mp.graph.node if n.op_type == "RNN"][0]
+    acts = [a for a in n.attribute if a.name == "activations"][0]
+    assert [s.decode().lower() for s in acts.strings] == ["relu"]
+
+
+def test_import_rejects_unsupported_gru_semantics(tmp_path):
+    mp, _ = _roundtrip(rnn.GRU(5), tmp_path=tmp_path, name="gru2")
+    g = [n for n in mp.graph.node if n.op_type == "GRU"][0]
+    for a in g.attribute:
+        if a.name == "linear_before_reset":
+            a.i = 0  # the ONNX-default (non-cuDNN) math
+    with pytest.raises(ValueError, match="linear_before_reset"):
+        sonnx.prepare(mp).run(
+            [tensor.from_numpy(np.zeros((5, 3, 4), np.float32))])
+
+
+def test_import_matches_torch_lstm(tmp_path):
+    """External cross-check: our exported-then-imported LSTM equals
+    torch.nn.LSTM fed the same (unpacked) weights."""
+    torch = pytest.importorskip("torch")
+
+    dev = device.get_default_device()
+    dev.SetRandSeed(23)
+    layer_ = rnn.LSTM(6)
+    m = _Wrap(layer_)
+    x_np = np.random.RandomState(1).randn(5, 3, 4).astype(np.float32)
+    x = tensor.from_numpy(x_np)
+    m.compile([x], is_train=False, use_graph=False)
+    m.eval()
+    path = str(tmp_path / "lstm_t.onnx")
+    sonnx.save(sonnx.to_onnx(m, [x]), path)
+    out = sonnx.prepare(sonnx.load(path)).run([x])[0].to_numpy()
+
+    h = layer_.handle
+    seg = {k: np.asarray(v) for k, v in
+           h.unpack(layer_.W.to_numpy()).items()}
+    tl = torch.nn.LSTM(4, 6)
+    with torch.no_grad():
+        tl.weight_ih_l0.copy_(torch.from_numpy(seg[("W_ih", 0, 0)]))
+        tl.weight_hh_l0.copy_(torch.from_numpy(seg[("W_hh", 0, 0)]))
+        tl.bias_ih_l0.copy_(torch.from_numpy(seg[("b_ih", 0, 0)]))
+        tl.bias_hh_l0.copy_(torch.from_numpy(seg[("b_hh", 0, 0)]))
+        ty, _ = tl(torch.from_numpy(x_np))
+    np.testing.assert_allclose(out, ty.numpy(), rtol=1e-4, atol=1e-5)

@@ -413,6 +413,88 @@ def build_cases():
     add("einsum", _model("Einsum", 2,
                          attrs={"equation": "bij,bjk->bik"}),
         [e1, e2], [np.einsum("bij,bjk->bik", e1, e2)], rtol=1e-4)
+
+    # -- recurrent trio (independent numpy loops per the ONNX spec) ----
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    S, Bb, In, Hh = 4, 2, 3, 5
+    rx = _f((S, Bb, In), 29)
+
+    def lstm_np(x, W, R, B):
+        """ONNX LSTM, forward dir, default activations, iofc order."""
+        nd = W.shape[0]
+        Y = np.zeros((S, nd, Bb, Hh), np.float32)
+        Yh = np.zeros((nd, Bb, Hh), np.float32)
+        Yc = np.zeros((nd, Bb, Hh), np.float32)
+        for d in range(nd):
+            h = np.zeros((Bb, Hh), np.float32)
+            c = np.zeros((Bb, Hh), np.float32)
+            order = range(S) if d == 0 else range(S - 1, -1, -1)
+            for t in order:
+                g = x[t] @ W[d].T + h @ R[d].T + B[d][:4 * Hh] \
+                    + B[d][4 * Hh:]
+                i, o, f, cc = (g[:, k * Hh:(k + 1) * Hh]
+                               for k in range(4))
+                i, o, f = sig(i), sig(o), sig(f)
+                c = f * c + i * np.tanh(cc)
+                h = o * np.tanh(c)
+                Y[t, d] = h
+            Yh[d], Yc[d] = h, c
+        return Y, Yh, Yc
+
+    for nd, nm in ((1, "lstm"), (2, "lstm_bidir")):
+        W = _f((nd, 4 * Hh, In), 30 + nd, lo=-0.5, hi=0.5)
+        R = _f((nd, 4 * Hh, Hh), 32 + nd, lo=-0.5, hi=0.5)
+        B = _f((nd, 8 * Hh), 34 + nd, lo=-0.5, hi=0.5)
+        Y, Yh, Yc = lstm_np(rx, W, R, B)
+        add(nm, _model("LSTM", 1, consts=[W, R, B],
+                       attrs={"hidden_size": Hh,
+                              "direction": ("bidirectional" if nd == 2
+                                            else "forward")},
+                       n_out=3),
+            [rx], [Y, Yh, Yc], rtol=1e-4, atol=1e-5)
+
+    def gru_np(x, W, R, B):
+        """ONNX GRU, linear_before_reset=1, zrh order."""
+        h = np.zeros((Bb, Hh), np.float32)
+        Y = np.zeros((S, 1, Bb, Hh), np.float32)
+        Wb, Rb = B[0][:3 * Hh], B[0][3 * Hh:]
+        for t in range(S):
+            gx = x[t] @ W[0].T + Wb
+            gh = h @ R[0].T + Rb
+            z = sig(gx[:, :Hh] + gh[:, :Hh])
+            r = sig(gx[:, Hh:2 * Hh] + gh[:, Hh:2 * Hh])
+            n = np.tanh(gx[:, 2 * Hh:] + r * gh[:, 2 * Hh:])
+            h = (1 - z) * n + z * h
+            Y[t, 0] = h
+        return Y, h[None]
+
+    W = _f((1, 3 * Hh, In), 36, lo=-0.5, hi=0.5)
+    R = _f((1, 3 * Hh, Hh), 37, lo=-0.5, hi=0.5)
+    B = _f((1, 6 * Hh), 38, lo=-0.5, hi=0.5)
+    Y, Yh = gru_np(rx, W, R, B)
+    add("gru", _model("GRU", 1, consts=[W, R, B],
+                      attrs={"hidden_size": Hh,
+                             "linear_before_reset": 1}, n_out=2),
+        [rx], [Y, Yh], rtol=1e-4, atol=1e-5)
+
+    def rnn_np(x, W, R, B):
+        h = np.zeros((Bb, Hh), np.float32)
+        Y = np.zeros((S, 1, Bb, Hh), np.float32)
+        for t in range(S):
+            h = np.tanh(x[t] @ W[0].T + h @ R[0].T + B[0][:Hh]
+                        + B[0][Hh:])
+            Y[t, 0] = h
+        return Y, h[None]
+
+    W = _f((1, Hh, In), 39, lo=-0.5, hi=0.5)
+    R = _f((1, Hh, Hh), 40, lo=-0.5, hi=0.5)
+    B = _f((1, 2 * Hh), 41, lo=-0.5, hi=0.5)
+    Y, Yh = rnn_np(rx, W, R, B)
+    add("rnn_tanh", _model("RNN", 1, consts=[W, R, B],
+                           attrs={"hidden_size": Hh}, n_out=2),
+        [rx], [Y, Yh], rtol=1e-4, atol=1e-5)
     return cases
 
 
