@@ -110,6 +110,21 @@ class RNNHandle:
     def state_shape(self, batch: int) -> Tuple[int, int, int]:
         return (self.num_layers * self.num_directions, batch, self.hidden_size)
 
+    # Value equality over the static config: handles are jit static
+    # arguments (`rnn_forward` static_argnums), so identity hashing
+    # would force a full XLA retrace for every freshly-built handle —
+    # e.g. the sonnx importer builds one per SingaRep.run().
+    def _config(self):
+        return (self.input_size, self.hidden_size, self.num_layers,
+                self.mode, self.bias, self.dropout, self.bidirectional)
+
+    def __eq__(self, other):
+        return (type(other) is type(self)
+                and self._config() == other._config())
+
+    def __hash__(self):
+        return hash(self._config())
+
 
 # ---------------------------------------------------------------------------
 # Cell steps (h·W_hhᵀ inside scan; x projections precomputed outside)

@@ -393,6 +393,8 @@ def _export_rnn(op, in_names, out_names, gb):
         """Per-layer [nd, B, H] slice of the (L*nd, B, H) state. Always
         a Slice NODE on the graph value — slicing a captured VALUE at
         export time would disconnect a declared h0/c0 graph input."""
+        if not name:  # omitted (all-zero) state: ONNX default
+            return ""
         if L == 1:
             return name
         sl = f"{name}_l{li}_slice"
@@ -560,10 +562,23 @@ def to_onnx(model, inputs: Sequence[Tensor],
                 (id(t.creator), getattr(t, "creator_index", 0)))
         return names.get(id(t))
 
+    def _rnn_omit(op, i, t):
+        """Inputs of an `_RNN` op that must NOT materialize as graph
+        values: the packed blob (re-emitted unpacked by _export_rnn)
+        and all-zero captured initial states (ONNX's omitted-input
+        default — emitting them as float initializers would let
+        SONNXModel fine-tuning train what the native layer fixes at
+        zero)."""
+        if type(op).__name__ != "_RNN":
+            return False
+        if i == 3 and id(t) in rnn_w_only:
+            return True
+        if i in (1, 2) and t.creator is None and id(t) not in names:
+            return not t.to_numpy().any()
+        return False
+
     for op in topo:
-        skip_w = type(op).__name__ == "_RNN"
-        in_names = [("" if skip_w and i == 3 and id(t) in rnn_w_only
-                     else _in_name(t))
+        in_names = [("" if _rnn_omit(op, i, t) else _in_name(t))
                     for i, t in enumerate(op.inputs)]
         outs = []
         for i in range(op.num_outputs):
@@ -862,6 +877,11 @@ def _import_rnn_common(ctx, node, mode):
         raise ValueError("sonnx: GRU linear_before_reset=0 is "
                          "unsupported (this framework implements the "
                          "cuDNN/=1 semantics)")
+    if _attr(node, "clip") is not None:
+        raise ValueError("sonnx: recurrent `clip` attribute is "
+                         "unsupported")
+    if _attr(node, "input_forget", 0):
+        raise ValueError("sonnx: LSTM input_forget=1 is unsupported")
     acts = _attr(node, "activations")
     if mode in ("tanh", "relu"):
         if acts:
@@ -881,31 +901,49 @@ def _import_rnn_common(ctx, node, mode):
         if [a.lower() for a in acts] != want:
             raise ValueError("sonnx: non-default LSTM/GRU activations "
                              "unsupported")
-    W = ctx.const(node.input[1])
-    R = ctx.const(node.input[2])
-    if W is None or R is None:
-        raise ValueError("sonnx: LSTM/GRU/RNN W/R must be "
-                         "initializers/constants")
-    W = np.asarray(W, np.float32)
-    R = np.asarray(R, np.float32)
-    nd, gh, in_dim = W.shape
-    hidden = int(_attr(node, "hidden_size", R.shape[-1]))
-    B = (ctx.const(node.input[3])
-         if len(node.input) > 3 and node.input[3] else None)
-    B = (np.asarray(B, np.float32) if B is not None
-         else np.zeros((nd, 2 * gh), np.float32))
+    Wt = ctx.tensor(node.input[1])  # (nd, G*H, In)
+    Rt = ctx.tensor(node.input[2])  # (nd, G*H, H)
+    nd, gh, in_dim = Wt.shape
+    hidden = int(_attr(node, "hidden_size", Rt.shape[-1]))
+    Bt = (ctx.tensor(node.input[3])
+          if len(node.input) > 3 and node.input[3] else None)
     perm = _RNN_GATE_PERM[mode]
+    # Row indices realizing the gate-block permutation.
+    rows = np.concatenate([np.arange(p * hidden, (p + 1) * hidden)
+                           for p in perm]).astype(np.int32)
 
     handle = RNNHandle(in_dim, hidden, 1, mode=mode, bias=True,
                        bidirectional=(nd == 2))
-    seg = {}
+
+    # The packed blob is BUILT THROUGH AUTOGRAD OPS (gather/slice/
+    # reshape/concat) from the W/R/B tensors, so when those are
+    # SONNXModel-registered params, fine-tuning gradients flow back
+    # into them — a numpy repack would silently freeze the weights.
+    # Piece order must equal RNNHandle._segments: per direction,
+    # W_ih | W_hh | b_ih | b_hh.
+    def take_dir(t, d, cols):
+        td = autograd.reshape(autograd.Gather(0, np.asarray([d]))(t),
+                              (gh, cols))
+        return autograd.reshape(autograd.Gather(0, rows)(td),
+                                (gh * cols,))
+
+    pieces = []
+    zeros_bias = None
     for d in range(nd):
-        seg[("W_ih", 0, d)] = _gate_reord(W[d], hidden, perm)
-        seg[("W_hh", 0, d)] = _gate_reord(R[d], hidden, perm)
-        seg[("b_ih", 0, d)] = _gate_reord(B[d][:gh], hidden, perm)
-        seg[("b_hh", 0, d)] = _gate_reord(B[d][gh:], hidden, perm)
-    blob = tensor_mod.from_numpy(np.asarray(handle.pack(seg)),
-                                 device=ctx.device)
+        pieces.append(take_dir(Wt, d, in_dim))
+        pieces.append(take_dir(Rt, d, hidden))
+        if Bt is not None:
+            bd = autograd.reshape(
+                autograd.Gather(0, np.asarray([d]))(Bt), (2 * gh,))
+            for lo, hi in ((0, gh), (gh, 2 * gh)):
+                half = autograd.Slice([lo], [hi])(bd)
+                pieces.append(autograd.Gather(0, rows)(half))
+        else:
+            if zeros_bias is None:
+                zeros_bias = tensor_mod.from_numpy(
+                    np.zeros((gh,), np.float32), device=ctx.device)
+            pieces += [zeros_bias, zeros_bias]
+    blob = autograd.Concat(0)(*pieces)
 
     x = ctx.tensor(node.input[0])
     seq, batch, _ = x.shape

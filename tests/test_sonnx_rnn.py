@@ -87,6 +87,35 @@ def test_import_rejects_unsupported_gru_semantics(tmp_path):
             [tensor.from_numpy(np.zeros((5, 3, 4), np.float32))])
 
 
+def test_imported_lstm_is_finetunable(tmp_path):
+    """The packed blob is rebuilt through autograd ops each run, so
+    gradients reach the SONNXModel-registered W/R/B params — the
+    recurrent weights must MOVE under fine-tuning, not just the head."""
+    from singa_tpu import autograd, opt
+
+    mp, _ = _roundtrip(rnn.LSTM(6), tmp_path=tmp_path, name="lstm_ft")
+    m = sonnx.SONNXModel(mp)
+    m.set_optimizer(opt.SGD(lr=0.05, momentum=0.9))
+    m.train()
+    w_names = [a for a, n in m._onnx_param_names.items()
+               if "rnn_W" in n or "rnn_R" in n]
+    assert w_names, "exported W/R initializers should be params"
+    before = {a: getattr(m, a).to_numpy().copy() for a in w_names}
+    rs = np.random.RandomState(3)
+    x = tensor.from_numpy(rs.randn(5, 3, 4).astype(np.float32))
+    y = tensor.from_numpy(rs.randn(5, 3, 6).astype(np.float32))
+    losses = []
+    for _ in range(5):
+        out = m.forward(x)
+        loss = autograd.mse_loss(out, y)
+        m._optimizer.backward_and_update(loss)
+        losses.append(float(loss.to_numpy()))
+    assert losses[-1] < losses[0]
+    moved = {a: np.abs(getattr(m, a).to_numpy() - before[a]).max()
+             for a in w_names}
+    assert all(v > 1e-7 for v in moved.values()), moved
+
+
 def test_import_matches_torch_lstm(tmp_path):
     """External cross-check: our exported-then-imported LSTM equals
     torch.nn.LSTM fed the same (unpacked) weights."""
