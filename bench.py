@@ -378,6 +378,50 @@ def stage_lm(batch, seq, steps, deadline_s):
         "loss": round(float(loss.to_numpy()), 3)}), flush=True)
 
 
+def stage_decode(batch, prompt, new, deadline_s):
+    """TransformerLM incremental-decode throughput (tokens/s): the
+    KV-cache generate() path, compiled prefill + lax.scan loop —
+    inference-side perf evidence to pair with the training tok/s."""
+    import numpy as np
+
+    _setup_jax()
+    from singa_tpu import device, tensor
+    from singa_tpu.models.transformer import TransformerLM
+
+    hard_stop = time.time() + deadline_s
+    dev = device.create_tpu_device()
+    dev.SetRandSeed(0)
+    tensor.set_matmul_precision("default")
+    V, D, H, L = 32000, 512, 8, 8
+    m = TransformerLM(V, d_model=D, num_heads=H, num_layers=L,
+                      max_len=prompt + new)
+    x = tensor.from_numpy(np.zeros((batch, 8), np.int32), device=dev)
+    m.compile([x], is_train=False, use_graph=False)
+    m.eval()
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, V, (batch, prompt)).astype(np.int32)
+    t0 = time.time()
+    m.generate(ids, new)  # compile (prefill + scan)
+    log(f"decode compile+first run: {time.time() - t0:.1f}s")
+    times = []
+    while len(times) < 3 and time.time() < hard_stop:
+        t0 = time.time()
+        m.generate(ids, new)  # greedy: identical compiled program
+        times.append(time.time() - t0)
+        log(f"decode {new} tokens (bs{batch}): {times[-1] * 1e3:.0f} ms "
+            f"({batch * new / times[-1]:.0f} tok/s)")
+    if not times:
+        print(json.dumps({"ok": False, "error": "no decode runs"}),
+              flush=True)
+        return
+    best = min(times)
+    print(json.dumps({
+        "ok": True, "metric": "decode_tokens_per_sec",
+        "config": f"d{D}h{H}l{L} bs{batch} prompt{prompt} new{new}",
+        "tokens_per_sec": round(batch * new / best, 1),
+        "ms_per_token": round(best * 1e3 / new, 3)}), flush=True)
+
+
 def stage_pallas():
     """SINGA_TPU_PALLAS=1 microbench on the chip -> PALLAS_BENCH.md."""
     os.environ["SINGA_TPU_PALLAS"] = "1"
@@ -422,6 +466,8 @@ def main():
         return stage_lm(a.batch, a.seq, a.steps, a.deadline)
     if a.stage == "pallas":
         return stage_pallas()
+    if a.stage == "decode":
+        return stage_decode(a.batch, 64, 192, a.deadline)
     if a.stage == "parity":
         return stage_parity(a.steps)
 
@@ -500,6 +546,13 @@ def main():
             if lm and lm.get("ok"):
                 result_extra["lm_tokens_per_sec"] = lm["tokens_per_sec"]
                 result_extra["lm_config"] = lm["config"]
+        if remaining() > 360:
+            dec = run_stage("decode", ["--batch", "8",
+                                       "--deadline", "240"], 300)
+            if dec and dec.get("ok"):
+                result_extra["decode_tokens_per_sec"] = (
+                    dec["tokens_per_sec"])
+                result_extra["decode_config"] = dec["config"]
         if remaining() > 180:
             run_stage("pallas", [], min(300, remaining() - 60))
         # gate must cover the stage's internal 420s TPU wait plus the
