@@ -112,6 +112,25 @@ def test_arcface_roundtrip_normalized_embeddings(tmp_path):
     assert {"ReduceSum", "Sqrt", "Div", "Mul"} <= ops
 
 
+def test_bidaf_roundtrip_attention_flow(tmp_path):
+    from bidaf import export_bidaf
+
+    path = str(tmp_path / "bidaf.onnx")
+    (ref_s, ref_e), (c, q) = export_bidaf(path, vocab=50, d=8,
+                                          ctx_len=12, query_len=5)
+    mp = sonnx.load(path)
+    rep = sonnx.prepare(mp)
+    out_s, out_e = (t.to_numpy() for t in rep.run([c, q]))
+    np.testing.assert_allclose(out_s, ref_s, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(out_e, ref_e, rtol=1e-4, atol=1e-5)
+    assert out_s.shape == out_e.shape == (2, 12)
+    ops = {n.op_type for n in mp.graph.node}
+    # the zoo-BiDAF signature stream: recurrent encoders + attention
+    # flow (softmax over the similarity matrix, ReduceMax for Q2C)
+    assert {"LSTM", "Gather", "MatMul", "Softmax", "ReduceMax",
+            "Concat"} <= ops
+
+
 def test_gpt2_causality_and_finetune(tmp_path):
     from gpt2 import GPT2, build_gpt2_onnx
 
