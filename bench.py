@@ -18,9 +18,11 @@ init, where Python signal handlers never run). Therefore:
     not the whole bench;
   * per-step timings stream to stderr immediately (the driver captures
     the tail, so even a timeout leaves a diagnosis trail);
-  * stages ramp up: devices probe -> ResNet-50 bs16 -> bs64 -> bs128,
-    each flushing its result; the final JSON reports the best measured
-    throughput no matter which stage died;
+  * stages ramp up: devices probe -> ResNet-50 fp32 bs64/bs128 ->
+    bf16-AMP bs128/bs256 -> transformer lm tok/s -> decode tok/s ->
+    pallas microbench -> TPU loss parity, each flushing its result;
+    the final JSON reports the best measured throughput no matter
+    which stage died;
   * compile time and steady-state step time are reported separately;
   * MFU is computed from an analytic ResNet-50 flop model vs the
     chip's peak (v5e: 197 TFLOP/s bf16) — the honest single-chip

@@ -235,14 +235,60 @@ class TransformerLM(model.Model):
         cache_dict[key_] = run
         return run
 
+    def _shard_decode_params(self, params, mesh):
+        """Lay the decode params out for tensor-parallel inference on
+        `mesh` ("model" axis): q/k/v and fc1 column-parallel, o and
+        fc2 row-parallel, head column-parallel over vocab —
+        Megatron's split (parallel/sharding.py). GSPMD then partitions
+        the whole prefill+scan program, inserting the collectives."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.sharding import _validate
+
+        def put(x, spec):
+            # _validate degrades to replicated when the mesh lacks the
+            # axis or the axis size doesn't divide the dim — same
+            # fallback the training-path ShardingRules applies
+            spec = _validate(mesh, spec, x.shape)
+            return jax.device_put(x, NamedSharding(mesh, spec))
+
+        col, row, rep = P(None, "model"), P("model", None), P()
+
+        def lin(wb, spec):
+            w, b = wb
+            bspec = (P("model") if spec is col else P())
+            return (put(w, spec), None if b is None else put(b, bspec))
+
+        out = {"embed": put(params["embed"], rep),
+               "pos": put(params["pos"], rep),
+               "ln_f": tuple(put(v, rep) for v in params["ln_f"][:2])
+               + (params["ln_f"][2],),
+               "head": put(params["head"], col), "blocks": []}
+        for blk in params["blocks"]:
+            out["blocks"].append({
+                "ln1": tuple(put(v, rep) for v in blk["ln1"][:2])
+                + (blk["ln1"][2],),
+                "q": lin(blk["q"], col), "k": lin(blk["k"], col),
+                "v": lin(blk["v"], col), "o": lin(blk["o"], row),
+                "ln2": tuple(put(v, rep) for v in blk["ln2"][:2])
+                + (blk["ln2"][2],),
+                "fc1": lin(blk["fc1"], col), "fc2": lin(blk["fc2"], row),
+            })
+        return out
+
     def generate(self, prompt_ids, max_new_tokens: int,
-                 temperature: float = 0.0, top_k: int = 0, seed: int = 0):
+                 temperature: float = 0.0, top_k: int = 0, seed: int = 0,
+                 mesh=None):
         """Autoregressively extend `prompt_ids` [B, P] (numpy int) by
         `max_new_tokens`. temperature=0 → greedy; otherwise softmax
         sampling, optionally truncated to the `top_k` highest logits
         (clamped to the vocab size). The prefill + lax.scan decode
         loop is compiled once per (shape, sampling config) and cached
-        on the model. Returns numpy [B, P + max_new_tokens]."""
+        on the model. With `mesh` (a jax Mesh with a "model" axis) the
+        params are laid out Megatron-style and GSPMD partitions the
+        decode across the chips (tensor-parallel inference). Returns
+        numpy [B, P + max_new_tokens]."""
         import jax
         import jax.numpy as jnp
 
@@ -257,6 +303,21 @@ class TransformerLM(model.Model):
         if T > self.max_len:
             raise ValueError(f"P+new = {T} exceeds max_len {self.max_len}")
         params = self._decode_params()
+        if mesh is not None:
+            # memoized per mesh: re-putting the whole tree per call
+            # would pay a full-model reshard each generate(). Keyed on
+            # the live leaf identities so a training step between
+            # decodes invalidates the copy (stale weights otherwise).
+            shard_cache = getattr(self, "_gen_shard_cache", None)
+            if shard_cache is None:
+                shard_cache = self._gen_shard_cache = {}
+            leaf_ids = tuple(id(l) for l in
+                             jax.tree_util.tree_leaves(params))
+            hit = shard_cache.get(id(mesh))
+            if hit is None or hit[0] != leaf_ids:
+                shard_cache[id(mesh)] = (
+                    leaf_ids, self._shard_decode_params(params, mesh))
+            params = shard_cache[id(mesh)][1]
         L = len(params["blocks"])
         H = self.blocks._seq[0].attn.num_heads
         D = params["embed"].shape[-1] // H
