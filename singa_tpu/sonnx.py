@@ -362,10 +362,53 @@ def _export_node(op, in_names: List[str], out_names: List[str],
         gb.node("Einsum", in_names, out_names, equation=op.equation)
     elif cls == "_RNN":
         _export_rnn(op, in_names, out_names, gb)
+    elif cls == "Attention":
+        _export_attention(op, in_names, out_names, gb)
     else:
         raise ValueError(
             f"sonnx export: op {cls} has no ONNX mapping "
             "(reference sonnx.py raises the same way for unsupported ops)")
+
+
+def _export_attention(op, in_names, out_names, gb):
+    """Decompose the fused Attention op (autograd.Attention over
+    [B, H, S, D]) into the standard ONNX stream —
+    Transpose/MatMul/Mul(scale)/Add(causal mask)/Softmax/MatMul —
+    which is exactly how zoo transformers encode it, so the export
+    re-imports through existing mappings with no custom op."""
+    import math as _math
+
+    q_t, k_t = op.inputs[0], op.inputs[1]
+    sq, d = q_t.shape[2], q_t.shape[3]
+    sk = k_t.shape[2]
+    scale = op.scale if op.scale is not None else 1.0 / _math.sqrt(d)
+    base = out_names[0]
+    kt = f"{base}_kT"
+    gb.node("Transpose", [in_names[1]], [kt], perm=[0, 1, 3, 2])
+    s = f"{base}_scores"
+    gb.node("MatMul", [in_names[0], kt], [s])
+    ss = f"{base}_scaled"
+    gb.node("Mul", [s, gb.const(np.asarray(scale, np.float32),
+                                "attn_scale")], [ss])
+    if op.causal:
+        # query i attends keys j <= i (start-aligned, rectangular OK —
+        # same mask plain_attention builds); exp(-1e9) underflows to
+        # exactly 0, matching the fused kernel's masked softmax. One
+        # shared initializer per (Sq, Sk): a per-layer copy would grow
+        # the file by layers * Sq * Sk floats.
+        memo = getattr(gb, "_attn_masks", None)
+        if memo is None:
+            memo = gb._attn_masks = {}
+        if (sq, sk) not in memo:
+            mask = np.where(np.tril(np.ones((sq, sk), bool)),
+                            0.0, -1e9).astype(np.float32)
+            memo[(sq, sk)] = gb.const(mask, "causal_mask")
+        sm = f"{base}_masked"
+        gb.node("Add", [ss, memo[(sq, sk)]], [sm])
+        ss = sm
+    p = f"{base}_probs"
+    gb.node("Softmax", [ss], [p], axis=-1)
+    gb.node("MatMul", [p, in_names[2]], out_names)
 
 
 def _export_rnn(op, in_names, out_names, gb):

@@ -112,6 +112,33 @@ def test_arcface_roundtrip_normalized_embeddings(tmp_path):
     assert {"ReduceSum", "Sqrt", "Div", "Mul"} <= ops
 
 
+def test_transformer_lm_export_import_roundtrip(tmp_path):
+    """The native flagship exports to plain ONNX: the fused Attention
+    op decomposes into the Transpose/MatMul/Mul/Add(mask)/Softmax
+    stream zoo transformers use, so the file re-imports through
+    existing mappings with exact logits parity."""
+    from singa_tpu import device
+    from singa_tpu.models.transformer import TransformerLM
+
+    device.get_default_device().SetRandSeed(4)
+    m = TransformerLM(50, d_model=32, num_heads=2, num_layers=2,
+                      max_len=16)
+    x = tensor.from_numpy(np.random.RandomState(0)
+                          .randint(0, 50, (2, 10)).astype(np.int32))
+    m.compile([x], is_train=False, use_graph=False)
+    m.eval()
+    ref = m.forward(x).to_numpy()
+    path = str(tmp_path / "tlm.onnx")
+    sonnx.save(sonnx.to_onnx(m, [x]), path)
+    mp = sonnx.load(path)
+    out = sonnx.prepare(mp).run([x])[0].to_numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+    ops = {n.op_type for n in mp.graph.node}
+    assert {"MatMul", "Softmax", "LayerNormalization", "Gelu",
+            "Gather"} <= ops
+    assert not any(n.op_type == "Attention" for n in mp.graph.node)
+
+
 def test_bidaf_roundtrip_attention_flow(tmp_path):
     from bidaf import export_bidaf
 
