@@ -274,6 +274,25 @@ class Adam(Optimizer):
         return value - self.lr_value * mhat / (jnp.sqrt(vhat) + self.epsilon)
 
 
+class AdamW(Adam):
+    """Adam with DECOUPLED weight decay (Loshchilov & Hutter): the
+    decay is applied directly to the parameter, scaled by the lr, not
+    folded into the gradient/moments like `Adam(weight_decay=...)`.
+    No reference equivalent; standard for the transformer workloads
+    this framework adds."""
+
+    def apply(self, param, value, grad):
+        wd = self.weight_decay
+        self.weight_decay = 0.0  # keep decay out of the moments
+        try:
+            new = super().apply(param, value, grad)
+        finally:
+            self.weight_decay = wd
+        if wd:
+            new = new - self.lr_value * wd * value
+        return new
+
+
 class DistOpt(Optimizer):
     """Distributed data-parallel optimizer wrapper.
 

@@ -162,6 +162,7 @@ class _GraphBuilder:
     def __init__(self, graph: P.GraphProto):
         self.g = graph
         self._const_count = 0
+        self._attn_masks = {}  # (Sq, Sk) -> shared causal-mask const
 
     def node(self, op_type: str, ins: Sequence[str], outs: Sequence[str],
              **attrs) -> P.NodeProto:
@@ -396,9 +397,7 @@ def _export_attention(op, in_names, out_names, gb):
         # exactly 0, matching the fused kernel's masked softmax. One
         # shared initializer per (Sq, Sk): a per-layer copy would grow
         # the file by layers * Sq * Sk floats.
-        memo = getattr(gb, "_attn_masks", None)
-        if memo is None:
-            memo = gb._attn_masks = {}
+        memo = gb._attn_masks
         if (sq, sk) not in memo:
             mask = np.where(np.tril(np.ones((sq, sk), bool)),
                             0.0, -1e9).astype(np.float32)

@@ -113,6 +113,29 @@ def test_sgd_with_cosine_scheduler_trains():
     assert np.isfinite(vals[-1]).all()
 
 
+def test_adamw_decoupled_decay():
+    """AdamW: without decay it IS Adam; with decay the param shrinks
+    by lr*wd*value on top of the Adam step (decay outside moments)."""
+    g = tensor.from_numpy(np.array([0.3, -0.2], np.float32))
+
+    def one_step(cls, **kw):
+        p = make_param([1.0, -2.0])
+        o = cls(lr=0.1, **kw)
+        o.update(p, g)
+        return p.to_numpy()
+
+    np.testing.assert_allclose(one_step(opt.AdamW),
+                               one_step(opt.Adam), rtol=1e-7)
+    plain = one_step(opt.Adam)
+    decayed = one_step(opt.AdamW, weight_decay=0.1)
+    # decoupled term: -lr * wd * value
+    np.testing.assert_allclose(
+        decayed, plain - 0.1 * 0.1 * np.array([1.0, -2.0]), rtol=1e-6)
+    # and it differs from Adam's coupled L2 (decay through moments)
+    coupled = one_step(opt.Adam, weight_decay=0.1)
+    assert np.abs(decayed - coupled).max() > 1e-6
+
+
 def test_clip_norm_scales_update():
     """Global-norm clip: with clip_norm >= true norm the update is
     untouched; with a small clip_norm every grad is scaled by
