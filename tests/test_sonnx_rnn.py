@@ -116,6 +116,36 @@ def test_imported_lstm_is_finetunable(tmp_path):
     assert all(v > 1e-7 for v in moved.values()), moved
 
 
+def test_imported_lstm_graph_mode_parity(tmp_path):
+    """SONNXModel(use_graph=True) jits the imported LSTM — including
+    the autograd-built blob packing — and must match eager."""
+    from singa_tpu import autograd, opt
+
+    mp, _ = _roundtrip(rnn.LSTM(6), tmp_path=tmp_path, name="lstm_g")
+    rs = np.random.RandomState(4)
+    x = tensor.from_numpy(rs.randn(5, 3, 4).astype(np.float32))
+    y = tensor.from_numpy(rs.randn(5, 3, 6).astype(np.float32))
+
+    def losses(graph):
+        m = sonnx.SONNXModel(mp)
+        m.set_optimizer(opt.SGD(lr=0.05, momentum=0.9))
+
+        def tob(self, xx, yy):
+            out = self.forward(xx)
+            loss = autograd.mse_loss(out, yy)
+            self._optimizer.backward_and_update(loss)
+            return out, loss
+
+        m.train_one_batch = tob.__get__(m)
+        m.compile([x], is_train=True, use_graph=graph)
+        m.train()
+        return [float(m(x, y)[1].to_numpy()) for _ in range(4)]
+
+    eager = losses(False)
+    graph = losses(True)
+    np.testing.assert_allclose(graph, eager, rtol=2e-5, atol=1e-6)
+
+
 def test_import_matches_torch_lstm(tmp_path):
     """External cross-check: our exported-then-imported LSTM equals
     torch.nn.LSTM fed the same (unpacked) weights."""
