@@ -50,11 +50,13 @@ class TransformerLM(model.Model):
     def __init__(self, vocab_size: int, d_model: int = 256,
                  num_heads: int = 8, num_layers: int = 4,
                  d_ff: int | None = None, max_len: int = 1024,
-                 mesh=None, dropout: float = 0.0):
+                 mesh=None, dropout: float = 0.0,
+                 tie_embeddings: bool = False):
         super().__init__()
         d_ff = d_ff or 4 * d_model
         self.vocab_size = vocab_size
         self.max_len = max_len
+        self.tie_embeddings = tie_embeddings
         self.embed = layer.Embedding(vocab_size, d_model)
         self.pos_embed = layer.Embedding(max_len, d_model)
         self.blocks = layer.Sequential(*[
@@ -63,7 +65,10 @@ class TransformerLM(model.Model):
             for _ in range(num_layers)
         ])
         self.ln_f = layer.LayerNorm()
-        self.head = layer.Linear(vocab_size, bias=False)
+        # tied: logits = h @ W_embed^T (gradients flow into the
+        # embedding from both uses); untied: separate projection
+        self.head = (None if tie_embeddings
+                     else layer.Linear(vocab_size, bias=False))
 
     def forward(self, x):
         B, S = x.shape
@@ -73,6 +78,9 @@ class TransformerLM(model.Model):
         h = autograd.add(self.embed(x), self.pos_embed(pos))
         h = self.blocks(h)
         h = self.ln_f(h)
+        if self.tie_embeddings:
+            return autograd.matmul(
+                h, autograd.transpose(self.embed.W, (1, 0)))
         return self.head(h)
 
     def train_one_batch(self, x, y):
@@ -111,11 +119,22 @@ class TransformerLM(model.Model):
                 "ln2": ln(blk.ln2),
                 "fc1": lin(blk.fc1), "fc2": lin(blk.fc2),
             })
+        if self.tie_embeddings:
+            # memoize the transposed view per embedding buffer: a
+            # fresh .T array every call would defeat the TP
+            # shard-cache's leaf-identity check in generate()
+            src = self.embed.W.data
+            cached = getattr(self, "_tied_head", None)
+            if cached is None or cached[0] is not src:
+                self._tied_head = (src, jnp.asarray(src).T)
+            head = self._tied_head[1]
+        else:
+            head = jnp.asarray(self.head.W.data)
         return {
             "embed": self.embed.W.data, "pos": self.pos_embed.W.data,
             "blocks": blocks,
             "ln_f": ln(self.ln_f),
-            "head": jnp.asarray(self.head.W.data),
+            "head": head,
         }
 
     @staticmethod

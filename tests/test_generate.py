@@ -59,6 +59,55 @@ def test_sampling_reproducible_and_in_range():
     assert ((a >= 0) & (a < 50)).all()
 
 
+def test_tied_embeddings_greedy_parity_and_no_head_param():
+    from singa_tpu import device
+
+    dev = device.get_default_device()
+    dev.SetRandSeed(6)
+    m = TransformerLM(50, d_model=32, num_heads=2, num_layers=2,
+                      max_len=32, tie_embeddings=True)
+    x = tensor.from_numpy(np.zeros((1, 4), np.int32))
+    m.compile([x], is_train=False, use_graph=False)
+    m.eval()
+    # no separate head param; logits still (B, S, V)
+    assert not any("head" in k for k in m.get_params())
+    out = m.forward(tensor.from_numpy(
+        np.array([[1, 2, 3]], np.int32))).to_numpy()
+    assert out.shape == (1, 3, 50)
+    # KV-cache decode parity holds through the tied head
+    prompt = np.random.RandomState(1).randint(0, 50, (2, 5)).astype(
+        np.int32)
+    want = _naive_greedy(m, prompt, 5)
+    got = m.generate(prompt, 5)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_tied_embeddings_gradient_reaches_embedding_from_both_uses():
+    from singa_tpu import autograd, device, opt
+
+    device.get_default_device().SetRandSeed(8)
+    m = TransformerLM(30, d_model=16, num_heads=2, num_layers=1,
+                      max_len=16, tie_embeddings=True)
+    m.set_optimizer(opt.SGD(lr=0.1))
+    rs = np.random.RandomState(0)
+    x = tensor.from_numpy(rs.randint(0, 30, (2, 6)).astype(np.int32))
+    y = tensor.from_numpy(rs.randint(0, 30, (2, 6)).astype(np.int32))
+    m.compile([x], is_train=True, use_graph=False)
+    before = m.embed.W.to_numpy().copy()
+    losses = []
+    for _ in range(5):
+        _, loss = m(x, y)
+        losses.append(float(loss.to_numpy()))
+    assert losses[-1] < losses[0]
+    # rows of tokens never seen as INPUTS must still move: only the
+    # softmax-head use of the tied matrix can reach them, so this
+    # fails if the transpose/matmul gradient path were dropped
+    unseen = np.setdiff1d(np.arange(30), np.asarray(x.to_numpy()))
+    assert unseen.size > 0
+    delta = np.abs(m.embed.W.to_numpy() - before)[unseen]
+    assert delta.max() > 1e-6
+
+
 def test_mesh_tensor_parallel_decode_matches_single_device():
     """TP inference: Megatron-sharded decode over a 2-device "model"
     mesh must produce the exact greedy tokens of the unsharded path
