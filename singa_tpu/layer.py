@@ -416,6 +416,33 @@ class LayerNorm(Layer):
         return autograd.layer_norm(x, self.gamma, self.beta, self.eps)
 
 
+class RMSNorm(Layer):
+    """Root-mean-square norm (no reference equivalent; the modern-LM
+    alternative to LayerNorm). Composed from primitive autograd ops so
+    backward and ONNX export (Mul/ReduceMean/Add/Sqrt/Div) come from
+    the existing mappings — XLA fuses the chain in graph mode."""
+
+    def __init__(self, eps: float = 1e-6, name=None):
+        super().__init__(name)
+        self.eps = eps
+
+    def initialize(self, x: Tensor):
+        d = x.shape[-1]
+        g = Tensor((d,), device=x.device)
+        initializer.constant(g, 1.0)
+        self.register_param("gamma", g)
+
+    def forward(self, x: Tensor):
+        ms = autograd.ReduceMean(axes=[-1], keepdims=True)(
+            autograd.mul(x, x))
+        # eps passed as a python scalar per call (ops coerce it);
+        # caching a constant TENSOR here is a trap — initialize/forward
+        # may run inside a jit trace (Model.compile's init forward) and
+        # a cached tracer-backed value would leak out of the trace
+        rms = autograd.Sqrt()(autograd.add(ms, np.float32(self.eps)))
+        return autograd.mul(autograd.div(x, rms), self.gamma)
+
+
 class MultiHeadAttention(Layer):
     """Multi-head self-attention (no reference equivalent — SINGA's
     attention models arrive only via ONNX import). TPU-first: per-head

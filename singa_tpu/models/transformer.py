@@ -18,16 +18,30 @@ import numpy as np
 from .. import autograd, layer, model, tensor
 
 
+_NORM_CLS = {"layer": layer.LayerNorm, "rms": layer.RMSNorm}
+
+
+def _norm_cls(norm: str):
+    try:
+        return _NORM_CLS[norm]
+    except KeyError:
+        raise ValueError(
+            f"norm must be one of {sorted(_NORM_CLS)}, got {norm!r}"
+        ) from None
+
+
 class TransformerBlock(layer.Layer):
     """Pre-norm block: x + MHA(LN(x)); x + MLP(LN(x))."""
 
     def __init__(self, num_heads: int, d_ff: int, causal: bool = True,
-                 mesh=None, dropout: float = 0.0, name=None):
+                 mesh=None, dropout: float = 0.0, norm: str = "layer",
+                 name=None):
         super().__init__(name)
-        self.ln1 = layer.LayerNorm()
+        norm_cls = _norm_cls(norm)
+        self.ln1 = norm_cls()
         self.attn = layer.MultiHeadAttention(num_heads, causal=causal,
                                              mesh=mesh, dropout=dropout)
-        self.ln2 = layer.LayerNorm()
+        self.ln2 = norm_cls()
         self.fc1 = layer.Linear(d_ff)
         self.act = layer.Gelu()
         self.fc2 = layer.Linear(0)  # lazily sized to d_model
@@ -51,20 +65,22 @@ class TransformerLM(model.Model):
                  num_heads: int = 8, num_layers: int = 4,
                  d_ff: int | None = None, max_len: int = 1024,
                  mesh=None, dropout: float = 0.0,
-                 tie_embeddings: bool = False):
+                 tie_embeddings: bool = False, norm: str = "layer"):
         super().__init__()
+        _norm_cls(norm)  # validate early, shared message
         d_ff = d_ff or 4 * d_model
         self.vocab_size = vocab_size
         self.max_len = max_len
         self.tie_embeddings = tie_embeddings
+        self.norm = norm
         self.embed = layer.Embedding(vocab_size, d_model)
         self.pos_embed = layer.Embedding(max_len, d_model)
         self.blocks = layer.Sequential(*[
             TransformerBlock(num_heads, d_ff, causal=True, mesh=mesh,
-                             dropout=dropout)
+                             dropout=dropout, norm=norm)
             for _ in range(num_layers)
         ])
-        self.ln_f = layer.LayerNorm()
+        self.ln_f = _norm_cls(norm)()
         # tied: logits = h @ W_embed^T (gradients flow into the
         # embedding from both uses); untied: separate projection
         self.head = (None if tie_embeddings
@@ -106,7 +122,12 @@ class TransformerLM(model.Model):
         def lin(l):
             return (l.W.data, l.b.data if l.bias else None)
 
-        def ln(l):  # thread each layer's configured eps through
+        def ln(l):
+            # (g, eps) = RMSNorm, (g, b, eps) = LayerNorm — tuple
+            # LENGTH is the dispatch (strings can't be jit pytree
+            # leaves; eps floats can)
+            if isinstance(l, layer.RMSNorm):
+                return (l.gamma.data, l.eps)
             return (l.gamma.data, l.beta.data, l.eps)
 
         blocks = []
@@ -138,10 +159,14 @@ class TransformerLM(model.Model):
         }
 
     @staticmethod
-    def _ln(x, gbe):
+    def _ln(x, spec):
         import jax.numpy as jnp
 
-        g, b, eps = gbe
+        if len(spec) == 2:  # RMSNorm: (gamma, eps)
+            g, eps = spec
+            return x / jnp.sqrt(
+                jnp.mean(jnp.square(x), -1, keepdims=True) + eps) * g
+        g, b, eps = spec
         mu = jnp.mean(x, axis=-1, keepdims=True)
         var = jnp.var(x, axis=-1, keepdims=True)
         return (x - mu) / jnp.sqrt(var + eps) * g + b
@@ -274,6 +299,10 @@ class TransformerLM(model.Model):
 
         col, row, rep = P(None, "model"), P("model", None), P()
 
+        def norm_put(t):  # replicate array leaves, pass tags/eps through
+            return tuple(put(v, rep) if hasattr(v, "shape") else v
+                         for v in t)
+
         def lin(wb, spec):
             w, b = wb
             bspec = (P("model") if spec is col else P())
@@ -281,17 +310,14 @@ class TransformerLM(model.Model):
 
         out = {"embed": put(params["embed"], rep),
                "pos": put(params["pos"], rep),
-               "ln_f": tuple(put(v, rep) for v in params["ln_f"][:2])
-               + (params["ln_f"][2],),
+               "ln_f": norm_put(params["ln_f"]),
                "head": put(params["head"], col), "blocks": []}
         for blk in params["blocks"]:
             out["blocks"].append({
-                "ln1": tuple(put(v, rep) for v in blk["ln1"][:2])
-                + (blk["ln1"][2],),
+                "ln1": norm_put(blk["ln1"]),
                 "q": lin(blk["q"], col), "k": lin(blk["k"], col),
                 "v": lin(blk["v"], col), "o": lin(blk["o"], row),
-                "ln2": tuple(put(v, rep) for v in blk["ln2"][:2])
-                + (blk["ln2"][2],),
+                "ln2": norm_put(blk["ln2"]),
                 "fc1": lin(blk["fc1"], col), "fc2": lin(blk["fc2"], row),
             })
         return out

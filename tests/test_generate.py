@@ -59,6 +59,32 @@ def test_sampling_reproducible_and_in_range():
     assert ((a >= 0) & (a < 50)).all()
 
 
+def test_rmsnorm_variant_greedy_parity_and_roundtrip():
+    """norm="rms": training forward, KV-cache decode, and ONNX export
+    (RMSNorm composes from primitive ops) all agree."""
+    from singa_tpu import device, sonnx
+
+    device.get_default_device().SetRandSeed(12)
+    m = TransformerLM(40, d_model=32, num_heads=2, num_layers=2,
+                      max_len=24, norm="rms")
+    x = tensor.from_numpy(np.zeros((1, 4), np.int32))
+    m.compile([x], is_train=False, use_graph=False)
+    m.eval()
+    prompt = np.random.RandomState(2).randint(0, 40, (2, 5)).astype(
+        np.int32)
+    want = _naive_greedy(m, prompt, 5)
+    got = m.generate(prompt, 5)
+    np.testing.assert_array_equal(got, want)
+    # export round trip: RMSNorm lowers to primitive ONNX ops
+    xt = tensor.from_numpy(prompt)
+    ref = m.forward(xt).to_numpy()
+    mp = sonnx.to_onnx(m, [xt])
+    out = sonnx.prepare(mp).run([xt])[0].to_numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+    assert not any(n.op_type == "LayerNormalization"
+                   for n in mp.graph.node)
+
+
 def test_tied_embeddings_greedy_parity_and_no_head_param():
     from singa_tpu import device
 

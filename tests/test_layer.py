@@ -103,3 +103,20 @@ def test_sequential():
     y = seq(x2d())
     assert y.shape == (4, 2)
     assert len(seq.get_params()) == 4
+
+
+def test_rmsnorm_matches_formula_and_grads():
+    from singa_tpu import autograd, opt
+
+    rs = np.random.RandomState(7)
+    x_np = rs.randn(4, 10).astype(np.float32)
+    ln = layer.RMSNorm(eps=1e-6)
+    x = tensor.from_numpy(x_np)
+    y = ln(x).to_numpy()
+    want = (x_np / np.sqrt((x_np ** 2).mean(-1, keepdims=True) + 1e-6))
+    np.testing.assert_allclose(y, want, rtol=1e-5, atol=1e-6)
+    # gamma participates in backward
+    loss = autograd.mse_loss(ln(x), tensor.from_numpy(
+        np.zeros_like(x_np)))
+    grads = {id(p): g for p, g in autograd.iter_backward(loss)}
+    assert id(ln.gamma) in grads
