@@ -93,6 +93,12 @@ class WarmupWrapper(DecayScheduler):
         return jnp.where(step < w, warm, after)
 
 
+def _global_clip_scale(clip_norm, grads):
+    """min(1, clip/||g||) over raw grad arrays, norm in fp32."""
+    sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads)
+    return jnp.minimum(1.0, clip_norm / (jnp.sqrt(sq) + 1e-12))
+
+
 class Optimizer:
     """Reference: `opt.Optimizer`. Holds step counter + per-param state.
 
@@ -109,9 +115,9 @@ class Optimizer:
         # equivalent; standard for the transformer workloads). Applies
         # in `backward_and_update` — including inside the mesh-mode
         # jitted step, where grads are already psum-reduced, so the
-        # clip is by TRUE global norm. The eager DistOpt streaming
-        # paths (fusedSynch et al.) bypass it: they see one grad at a
-        # time by design.
+        # clip is by TRUE global norm. DistOpt's plain/half paths clip
+        # after the allreduce (`DistOpt._clip_pairs`); the partial/
+        # sparse variants bypass it (per-grad streaming by design).
         self.clip_norm: Optional[float] = None
 
     def set_clip_norm(self, value: Optional[float]):
@@ -153,10 +159,8 @@ class Optimizer:
             return loss
         pairs = [(p, g.data if isinstance(g, Tensor) else g)
                  for p, g in autograd.iter_backward(loss)]
-        sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
-                 for _, g in pairs)
-        scale = jnp.minimum(1.0, self.clip_norm
-                            / (jnp.sqrt(sq) + 1e-12))
+        scale = _global_clip_scale(self.clip_norm,
+                                   [g for _, g in pairs])
         for p, g in pairs:
             self.update(p, (g.astype(jnp.float32) * scale).astype(g.dtype))
         self.step()
@@ -385,9 +389,24 @@ class DistOpt(Optimizer):
         inv = self.communicator.grad_scale
         for p, g in pairs:
             g.data = g.data * inv
+        self._clip_pairs(pairs)
+        for p, g in pairs:
             self.opt.update(p, g)
         self.opt.step()
         return loss
+
+    def _clip_pairs(self, pairs):
+        """Global-norm clip AFTER the allreduce (reduced grads are
+        identical on every rank, so the clip factor is consistent);
+        honors the wrapped optimizer's clip_norm."""
+        cn = (self.opt.clip_norm if self.opt.clip_norm is not None
+              else self.clip_norm)  # honor the wrapper's public API too
+        if cn is None or not pairs:
+            return
+        scale = _global_clip_scale(cn, [g.data for _, g in pairs])
+        for _, g in pairs:
+            g.data = (g.data.astype(jnp.float32)
+                      * scale).astype(g.data.dtype)
 
     def backward_and_update_half(self, loss: Tensor, threshold: int = 2097152):
         """Reference: `backward_and_update_half` — fp16 compression
@@ -399,6 +418,8 @@ class DistOpt(Optimizer):
         inv = self.communicator.grad_scale
         for (p, g), r in zip(pairs, reduced):
             g.data = r.astype(p.data.dtype) * inv
+        self._clip_pairs(pairs)
+        for p, g in pairs:
             self.opt.update(p, g)
         self.opt.step()
         return loss
