@@ -9,7 +9,10 @@ Layer map (mirrors SURVEY.md §1, re-designed TPU-first):
     autograd             Operator registry + tape-free backward()
     tensor / device      Tensor over jax.Array; TpuDevice over PJRT
     ops/                 op catalogue as XLA HLO + Pallas kernels
-    parallel/            mesh, DP/TP/SP shardings, ring attention
+    parallel/            mesh, DP/TP/SP/PP/EP shardings, ring attention
+    models/              native flagship models (TransformerLM + decode)
+    checkpoint           async checkpoint writer + keep-N rotation
+    converter            Caffe prototxt importer
     io/ + native/        record IO, snapshot, C++ runtime pieces
 """
 
