@@ -45,6 +45,56 @@ from .tensor import Tensor
 # Module-level training flag. Reference: `autograd.training`.
 training = False
 
+# Rematerialization policy (SURVEY §7: "jax.checkpoint to trade FLOPs
+# for memory"). False = off; True = every vjp-derived op; or op class
+# names (e.g. {"Attention", "Gelu"}) for selective remat. Only affects
+# ops traced into a graph-mode step whose backward comes from jax.vjp:
+# their vjp is built from jax.checkpoint(fn), so XLA recomputes the
+# forward during backward instead of storing residuals — the standard
+# activation-memory trade for big models. Eager mode and ops with
+# hand-written forward/backward (Dropout, BatchNorm, the fused CE)
+# ignore it.
+_remat = False
+
+
+def set_remat(policy) -> None:
+    """False | True | op class name(s) to rematerialize. Names are
+    validated against the Operator registry — a typo raising here
+    beats remat silently not engaging."""
+    global _remat
+    if isinstance(policy, bool):
+        _remat = policy
+        return
+    names = frozenset([policy] if isinstance(policy, str) else policy)
+
+    def subs(c):
+        out = set(c.__subclasses__())
+        for s in list(out):
+            out |= subs(s)
+        return out
+
+    # only vjp-derived ops can remat; ops with a hand-written backward
+    # (Dropout, BatchNorm, fused CE) never reach the checkpointed
+    # path, so naming them would be a silent no-op -> reject. An
+    # overridden *forward* alone is fine (e.g. Attention defers to
+    # super().forward for its vjp).
+    eligible = {c.__name__ for c in subs(Operator)
+                if c.backward is Operator.backward}
+    bad = names - eligible
+    if bad:
+        raise ValueError(
+            f"set_remat: {sorted(bad)} are not vjp-path op classes "
+            "(unknown, or ops with hand-written backwards that cannot "
+            "rematerialize); examples of eligible ops: Attention, "
+            "Gelu, Mult")
+    _remat = names
+
+
+def _remat_this(op) -> bool:
+    if _remat is False:
+        return False
+    return _remat is True or type(op).__name__ in _remat
+
 
 def _to_tensor(x) -> Tensor:
     if isinstance(x, Tensor):
@@ -124,15 +174,16 @@ class Operator:
             # mode) keep the plain vjp path: the whole step is traced
             # once anyway, and the cached bwd's forward recompute would
             # double traced FLOPs.
-            key = None
-            if not any(isinstance(x, jax.core.Tracer) for x in xs):
-                key = self.cache_key()
+            traced = any(isinstance(x, jax.core.Tracer) for x in xs)
+            key = None if traced else self.cache_key()
             if key is not None:
                 fwd, bwd = _op_executables(type(self), key, self)
                 self._cached_bwd = bwd
                 self._bwd_xs = xs
                 return fwd(*xs)
-            ys, self._vjp = jax.vjp(self.fn, *xs)
+            fn = (jax.checkpoint(self.fn)
+                  if traced and _remat_this(self) else self.fn)
+            ys, self._vjp = jax.vjp(fn, *xs)
             return ys
         return self.fn(*xs)
 
