@@ -332,8 +332,15 @@ class TransformerLM(model.Model):
         loop is compiled once per (shape, sampling config) and cached
         on the model. With `mesh` (a jax Mesh with a "model" axis) the
         params are laid out Megatron-style and GSPMD partitions the
-        decode across the chips (tensor-parallel inference). Returns
-        numpy [B, P + max_new_tokens]."""
+        decode across the chips (tensor-parallel inference).
+
+        Precision: decode computes in the PARAM dtype under the
+        matmul-precision policy (`tensor.set_matmul_precision` — use
+        "default" for bf16 MXU passes, the main inference speed
+        lever); the AMP compute-dtype policy is a training-path
+        activation policy and is deliberately not applied here, so
+        greedy decode stays exactly consistent with the fp32 eval
+        forward. Returns numpy [B, P + max_new_tokens]."""
         import jax
         import jax.numpy as jnp
 
