@@ -31,7 +31,7 @@ wait-before-save discipline orbax uses — bounding extra HBM to
 
     mgr = CheckpointManager("ckpts/", keep=3)
     mgr.save(model, step=100)            # async; prunes old steps
-    step = mgr.restore_latest(model)     # -> 100 (or None if empty)
+    step, aux = mgr.restore_latest(model)  # -> (100, aux) or (None, {})
 """
 from __future__ import annotations
 

@@ -1,0 +1,13 @@
+#!/bin/bash
+# Background tunnel watcher: probe the TPU every ~4 min; append status to
+# /tmp/tpu_watch.log and write /tmp/tpu_up when a probe succeeds.
+while true; do
+  if timeout 120 python -c "import jax; d=jax.devices(); assert d[0].platform!='cpu'; import jax.numpy as jnp; (jnp.ones((8,8))@jnp.ones((8,8))).block_until_ready(); print(d[0].device_kind)" >/tmp/tpu_probe_out 2>/dev/null; then
+    echo "$(date +%H:%M:%S) UP $(cat /tmp/tpu_probe_out)" >> /tmp/tpu_watch.log
+    touch /tmp/tpu_up
+  else
+    echo "$(date +%H:%M:%S) down" >> /tmp/tpu_watch.log
+    rm -f /tmp/tpu_up
+  fi
+  sleep 240
+done
