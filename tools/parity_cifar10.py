@@ -26,6 +26,7 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 import numpy as np
 
@@ -104,23 +105,56 @@ def main():
     ap.add_argument("--steps", type=int, default=30)
     ap.add_argument("--skip-tpu", action="store_true")
     ap.add_argument("--tpu-timeout", type=float, default=600.0)
+    ap.add_argument("--tpu-only", action="store_true",
+                    help="reuse the CPU curves already recorded in "
+                    "PARITY_cifar10.json (they are deterministic: fixed "
+                    "seeds, synthetic data) and run ONLY the tpu_graph "
+                    "column — the fast path the staged bench uses so the "
+                    "north-star gate runs FIRST in the window "
+                    "(VERDICT r4 next #1)")
+    ap.add_argument("--budget", type=float, default=1e9,
+                    help="hard wall-clock budget (s): every subprocess "
+                    "timeout is clipped so the artifact + result line "
+                    "always get written before a parent gate kills us")
     a = ap.parse_args()
+    t_start = time.time()
+
+    def rem():
+        return max(5.0, a.budget - (time.time() - t_start))
 
     curves = {}
     errors = {}
-    for name, backend, graph, to in [
-        ("cpu_eager", "cpu", False, 1200),
-        ("cpu_graph", "cpu", True, 1200),
-    ]:
-        print(f"running {name}...", file=sys.stderr, flush=True)
-        curves[name], err = _curve_in_subprocess(backend, graph,
-                                                 a.steps, to)
-        if err:
-            errors[name] = err
+    reused = None
+    if a.tpu_only:
+        path = os.path.join(_ROOT, "PARITY_cifar10.json")
+        try:
+            with open(path) as f:
+                prev = json.load(f)
+            if (prev.get("config", {}).get("steps") == a.steps
+                    and prev.get("curves", {}).get("cpu_eager")
+                    and prev.get("curves", {}).get("cpu_graph")):
+                reused = {k: prev["curves"][k]
+                          for k in ("cpu_eager", "cpu_graph")}
+                print("reusing recorded CPU curves (deterministic)",
+                      file=sys.stderr, flush=True)
+        except (OSError, ValueError):
+            pass
+    if reused:
+        curves.update(reused)
+    else:
+        for name, backend, graph, to in [
+            ("cpu_eager", "cpu", False, 1200),
+            ("cpu_graph", "cpu", True, 1200),
+        ]:
+            print(f"running {name}...", file=sys.stderr, flush=True)
+            curves[name], err = _curve_in_subprocess(
+                backend, graph, a.steps, min(to, rem()))
+            if err:
+                errors[name] = err
     if not a.skip_tpu:
         print("running tpu_graph...", file=sys.stderr, flush=True)
         curves["tpu_graph"], err = _curve_in_subprocess(
-            "tpu", True, a.steps, a.tpu_timeout)
+            "tpu", True, a.steps, min(a.tpu_timeout, rem()))
         if err:
             errors["tpu_graph"] = err
     else:
@@ -142,9 +176,16 @@ def main():
         "curves": curves, "max_rel_diffs": diffs, "errors": errors,
     }
     path = os.path.join(_ROOT, "PARITY_cifar10.json")
-    with open(path, "w") as f:
-        json.dump(artifact, f, indent=1)
-    print(f"wrote {path}")
+    if (a.tpu_only and not (curves.get("cpu_eager")
+                            and curves.get("cpu_graph"))):
+        # Never overwrite a recorded artifact with an all-null one
+        # (e.g. budget ran out before the CPU fallback finished).
+        print(f"keeping existing {path} (no CPU curves this run)",
+              file=sys.stderr)
+    else:
+        with open(path, "w") as f:
+            json.dump(artifact, f, indent=1)
+        print(f"wrote {path}")
     print(json.dumps({"max_rel_diffs": diffs, "errors": errors}))
 
     bad = {k: v for k, v in diffs.items() if v > TOL_REL}
