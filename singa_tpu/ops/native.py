@@ -177,12 +177,20 @@ def conv_transpose2d(handle: ConvTransposeHandle, x, w, b=None):
     return y
 
 
+
+def _at_least_f32(x):
+    """Upcast low-precision inputs so normalization statistics are
+    computed in at-least-fp32 (bf16 AMP stats must not drift), while
+    f64 passes through (the numerical gradient audit's path)."""
+    return x.astype(jnp.promote_types(x.dtype, jnp.float32))
+
+
 def instance_norm(x, scale, bias, eps: float = 1e-5):
     """ONNX InstanceNormalization: per-(N, C) normalization over the
-    spatial dims; scale/bias are per-channel. Statistics in fp32
-    (matches the BN policy under AMP)."""
+    spatial dims; scale/bias are per-channel. Statistics in
+    at-least-fp32 (matches the BN policy under AMP)."""
     axes = tuple(range(2, x.ndim))
-    xf = x.astype(jnp.float32)
+    xf = _at_least_f32(x)
     mean = jnp.mean(xf, axis=axes, keepdims=True)
     var = jnp.var(xf, axis=axes, keepdims=True)
     shape = [1, -1] + [1] * (x.ndim - 2)
@@ -213,10 +221,9 @@ def batchnorm_training(handle: BatchNormHandle, x, scale, bias, running_mean, ru
     running state from them).
     """
     axes = tuple(i for i in range(x.ndim) if i != 1)
-    # Statistics always in fp32 (under AMP, x is bf16 but cuDNN-parity
-    # running stats must not drift); the normalized output returns to
-    # x's dtype so bf16 activations stay bf16 through BN.
-    xf = x.astype(jnp.float32)
+    # The normalized output returns to x's dtype so bf16 activations
+    # stay bf16 through BN; stats math happens in _at_least_f32.
+    xf = _at_least_f32(x)
     mean = jnp.mean(xf, axis=axes)
     # cuDNN uses biased variance for normalization.
     var = jnp.var(xf, axis=axes)
@@ -234,7 +241,8 @@ def batchnorm_inference(handle: BatchNormHandle, x, scale, bias, running_mean, r
     """Reference: `GpuBatchNormForwardInference`."""
     shape = [1, -1] + [1] * (x.ndim - 2)
     inv = lax.rsqrt(running_var + handle.eps).reshape(shape)
-    y = (x.astype(jnp.float32) - running_mean.reshape(shape)) * inv \
+    xf = _at_least_f32(x)
+    y = (xf - running_mean.reshape(shape)) * inv \
         * scale.reshape(shape) + bias.reshape(shape)
     return y.astype(x.dtype)
 
