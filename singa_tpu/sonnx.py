@@ -196,7 +196,7 @@ _SIMPLE_EXPORT = {
     "Add": "Add", "Sub": "Sub", "Mul": "Mul", "Div": "Div", "Pow": "Pow",
     "Minimum": "Min", "Maximum": "Max", "Less": "Less",
     "Greater": "Greater", "Equal": "Equal", "Mult": "MatMul",
-    "GlobalAveragePool": "GlobalAveragePool",
+    "GlobalAveragePool": "GlobalAveragePool", "Identity": "Identity",
 }
 
 

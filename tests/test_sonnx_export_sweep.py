@@ -1,0 +1,286 @@
+"""Export-side conformance sweep (VERDICT r4 Missing #4).
+
+The import direction is covered by the golden corpus
+(tests/test_onnx_conformance.py); until now the EXPORT direction was
+only exercised by zoo round-trips, and nothing enforced that every
+exportable op stays exportable.  This sweep:
+
+  * builds a tiny single-op graph for EVERY Operator class
+    `sonnx._export_node` supports, runs the eager forward (golden),
+    exports with `sonnx.to_onnx`, serializes through the wire proto,
+    re-imports with `sonnx.prepare`, and compares outputs numerically;
+  * `test_export_registry_complete` fails when an autograd op class is
+    neither in the sweep nor in the documented not-exportable list —
+    so adding an op without deciding its export story breaks CI;
+  * `test_unexportable_actually_raise` pins the not-exportable list:
+    when someone later adds an export mapping, the case must move up.
+
+Reference: `sonnx.py` `_rename_operators` symmetry (SURVEY P7) — the
+reference keeps import and export tables side by side; this enforces
+the same discipline mechanically.
+"""
+import inspect
+
+import numpy as np
+import pytest
+
+from singa_tpu import autograd, sonnx, tensor
+from singa_tpu.ops import native
+from singa_tpu.ops.rnn import RNNHandle
+
+A = autograd
+_RS = np.random.RandomState(7)
+
+
+def _t(a):
+    return tensor.from_numpy(np.asarray(a, np.float32))
+
+
+def _ti(a):
+    return tensor.from_numpy(np.asarray(a, np.int32))
+
+
+def _r(*shape):
+    return _RS.randn(*shape).astype(np.float32)
+
+
+class _OpGraph:
+    """Minimal exportable model: forward applies `fn` to the inputs.
+    Weights/attrs are closed over (baked as initializers on export)."""
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def forward(self, *xs):
+        return self._fn(*xs)
+
+
+# one entry per exportable op class: name -> (fn, [input tensors])
+# (weights that the ONNX node wants constant are closed over)
+_CONV = native.ConvHandle(2, 3, 3, stride=1, padding=1, bias=True)
+_CONVW, _CONVB = _t(_r(3, 2, 3, 3) * 0.3), _t(_r(3))
+_CONVT = native.ConvTransposeHandle(2, 3, 3, stride=2, padding=1,
+                                    output_padding=1, bias=False)
+_CONVTW = _t(_r(2, 3, 3, 3) * 0.3)
+_POOL = native.PoolingHandle(2, stride=2)
+_BNH = native.BatchNormHandle(factor=0.9, eps=1e-5)
+_BN_RM, _BN_RV = _t(np.zeros(3)), _t(np.ones(3) * 1.5)
+_LSTM = RNNHandle(3, 4, 1, "lstm")
+_LSTM_W = _t(np.asarray(
+    _LSTM.init_weights(__import__("jax").random.PRNGKey(0))))
+_LSTM_H = _t(np.zeros(_LSTM.state_shape(2), np.float32))
+_LSTM_C = _t(np.zeros(_LSTM.state_shape(2), np.float32))
+# op attributes must be FIXED arrays: to_onnx re-runs forward, so a
+# fresh _r() inside the lambda would export different constants than
+# the golden run used
+_SCAT_UPD = _r(2, 3)
+
+EXPORT_CASES = {
+    # simple table ops
+    "ReLU": (lambda x: A.ReLU()(x), [_t(_r(3, 4))]),
+    "Sigmoid": (lambda x: A.Sigmoid()(x), [_t(_r(3, 4))]),
+    "Tanh": (lambda x: A.Tanh()(x), [_t(_r(3, 4))]),
+    "Tanh_": (lambda x: A.Tanh_()(x), [_t(_r(3, 4))]),
+    "Abs": (lambda x: A.Abs()(x), [_t(_r(3, 4))]),
+    "Exp": (lambda x: A.Exp()(x), [_t(_r(3, 4))]),
+    "Log": (lambda x: A.Log()(x), [_t(np.abs(_r(3, 4)) + 0.5)]),
+    "Sqrt": (lambda x: A.Sqrt()(x), [_t(np.abs(_r(3, 4)) + 0.5)]),
+    "Negative": (lambda x: A.Negative()(x), [_t(_r(3, 4))]),
+    "Reciprocal": (lambda x: A.Reciprocal()(x),
+                   [_t(np.abs(_r(3, 4)) + 0.5)]),
+    "Erf": (lambda x: A.Erf()(x), [_t(_r(3, 4))]),
+    "Ceil": (lambda x: A.Ceil()(x), [_t(_r(3, 4))]),
+    "Floor": (lambda x: A.Floor()(x), [_t(_r(3, 4))]),
+    "Round": (lambda x: A.Round()(x), [_t(_r(3, 4))]),
+    "Sign": (lambda x: A.Sign()(x), [_t(_r(3, 4))]),
+    "Cos": (lambda x: A.Cos()(x), [_t(_r(3, 4))]),
+    "Sin": (lambda x: A.Sin()(x), [_t(_r(3, 4))]),
+    "Tan": (lambda x: A.Tan()(x), [_t(_r(3, 4) * 0.4)]),
+    "Acos": (lambda x: A.Acos()(x), [_t(_r(3, 4) * 0.4)]),
+    "Asin": (lambda x: A.Asin()(x), [_t(_r(3, 4) * 0.4)]),
+    "Atan": (lambda x: A.Atan()(x), [_t(_r(3, 4))]),
+    "Cosh": (lambda x: A.Cosh()(x), [_t(_r(3, 4))]),
+    "Sinh": (lambda x: A.Sinh()(x), [_t(_r(3, 4))]),
+    "Acosh": (lambda x: A.Acosh()(x), [_t(np.abs(_r(3, 4)) + 1.5)]),
+    "Asinh": (lambda x: A.Asinh()(x), [_t(_r(3, 4))]),
+    "Atanh": (lambda x: A.Atanh()(x), [_t(_r(3, 4) * 0.4)]),
+    "SoftPlus": (lambda x: A.SoftPlus()(x), [_t(_r(3, 4))]),
+    "SoftSign": (lambda x: A.SoftSign()(x), [_t(_r(3, 4))]),
+    "Gelu": (lambda x: A.Gelu()(x), [_t(_r(3, 4))]),
+    "Identity": (lambda x: A.Identity()(x), [_t(_r(3, 4))]),
+    "Add": (lambda a, b: A.Add()(a, b), [_t(_r(3, 4)), _t(_r(3, 4))]),
+    "Sub": (lambda a, b: A.Sub()(a, b), [_t(_r(3, 4)), _t(_r(3, 4))]),
+    "Mul": (lambda a, b: A.Mul()(a, b), [_t(_r(3, 4)), _t(_r(3, 4))]),
+    "Div": (lambda a, b: A.Div()(a, b),
+            [_t(_r(3, 4)), _t(np.abs(_r(3, 4)) + 0.5)]),
+    "Pow": (lambda a, b: A.Pow()(a, b),
+            [_t(np.abs(_r(3, 4)) + 0.5), _t(_r(3, 4))]),
+    "Minimum": (lambda a, b: A.Minimum()(a, b),
+                [_t(_r(3, 4)), _t(_r(3, 4))]),
+    "Maximum": (lambda a, b: A.Maximum()(a, b),
+                [_t(_r(3, 4)), _t(_r(3, 4))]),
+    "Less": (lambda a, b: A.Less()(a, b),
+             [_t(_r(3, 4)), _t(_r(3, 4))]),
+    "Greater": (lambda a, b: A.Greater()(a, b),
+                [_t(_r(3, 4)), _t(_r(3, 4))]),
+    "Equal": (lambda a, b: A.Equal()(a, b),
+              [_t(_r(3, 4)), _t(_r(3, 4))]),
+    "Mult": (lambda a, b: A.Mult()(a, b), [_t(_r(3, 4)), _t(_r(4, 2))]),
+    "GlobalAveragePool": (lambda x: A.GlobalAveragePool()(x),
+                          [_t(_r(2, 3, 4, 4))]),
+    # attr / decomposed ops
+    "Square": (lambda x: A.Square()(x), [_t(_r(3, 4))]),
+    "AddBias": (lambda x, b: A.AddBias(axis=1)(x, b),
+                [_t(_r(3, 4)), _t(_r(3))]),
+    "SoftMax": (lambda x: A.SoftMax(axis=-1)(x), [_t(_r(3, 5))]),
+    "LogSoftMax": (lambda x: A.LogSoftMax(axis=-1)(x), [_t(_r(3, 5))]),
+    "Clip": (lambda x: A.Clip(-0.5, 0.8)(x), [_t(_r(3, 4))]),
+    "Elu": (lambda x: A.Elu(0.7)(x), [_t(_r(3, 4))]),
+    "SeLU": (lambda x: A.SeLU()(x), [_t(_r(3, 4))]),
+    "LeakyRelu": (lambda x: A.LeakyRelu(0.1)(x), [_t(_r(3, 4))]),
+    "HardSigmoid": (lambda x: A.HardSigmoid()(x), [_t(_r(3, 4))]),
+    "Cast": (lambda x: A.Cast(np.int32)(x), [_t(_r(3, 4) * 3)]),
+    "Gemm": (lambda a, b, c: A.Gemm(0.5, 1.5, 0, 1)(a, b, c),
+             [_t(_r(3, 4)), _t(_r(2, 4)), _t(_r(3, 2))]),
+    "Reshape": (lambda x: A.Reshape((2, 6))(x), [_t(_r(3, 4))]),
+    "Flatten": (lambda x: A.Flatten(1)(x), [_t(_r(2, 3, 4))]),
+    "Transpose": (lambda x: A.Transpose((1, 0, 2))(x),
+                  [_t(_r(2, 3, 4))]),
+    "Concat": (lambda a, b: A.Concat(1)(a, b),
+               [_t(_r(2, 3)), _t(_r(2, 2))]),
+    "Slice": (lambda x: A.Slice([1], [5], [1], [2])(x),
+              [_t(_r(3, 6))]),
+    "SplitOp": (lambda x: A.SplitOp(1, [2, 3])(x), [_t(_r(2, 5))]),
+    "Gather": (lambda x: A.Gather(1, np.array([0, 2]))(x),
+               [_t(_r(3, 4))]),
+    "Embedding": (lambda w: A.Embedding(np.array([1, 3, 0]))(w),
+                  [_t(_r(5, 4))]),
+    "Tile": (lambda x: A.Tile((2, 3))(x), [_t(_r(2, 3))]),
+    "Squeeze": (lambda x: A.Squeeze(1)(x), [_t(_r(3, 1, 4))]),
+    "Unsqueeze": (lambda x: A.Unsqueeze([0])(x), [_t(_r(3, 4))]),
+    "Pad": (lambda x: A.Pad("constant", [0, 1, 0, 2], 1.5)(x),
+            [_t(_r(3, 4))]),
+    "Expand": (lambda x: A.Expand((3, 4))(x), [_t(_r(3, 1))]),
+    "DepthToSpace": (lambda x: A.DepthToSpace(2, "DCR")(x),
+                     [_t(_r(1, 8, 2, 2))]),
+    "SpaceToDepth": (lambda x: A.SpaceToDepth(2)(x),
+                     [_t(_r(1, 2, 4, 4))]),
+    "Where": (lambda a, b: A.Where(np.array([[1, 0, 1, 0]] * 3))(a, b),
+              [_t(_r(3, 4)), _t(_r(3, 4))]),
+    "OneHot": (lambda x: A.OneHot(5)(x), [_ti([1, 3, 0])]),
+    "ReduceSum": (lambda x: A.ReduceSum((1,), True)(x),
+                  [_t(_r(3, 4, 2))]),
+    "ReduceMean": (lambda x: A.ReduceMean((1,), True)(x),
+                   [_t(_r(3, 4, 2))]),
+    "Max": (lambda x: A.Max((1,), True)(x), [_t(_r(3, 5))]),
+    "Min": (lambda x: A.Min((1,), True)(x), [_t(_r(3, 5))]),
+    "Dropout": (lambda x: A.Dropout(0.5)(x), [_t(_r(3, 4))]),
+    "LayerNorm": (lambda x, g, b: A.LayerNorm(1e-5)(x, g, b),
+                  [_t(_r(2, 3, 4)), _t(_r(4)), _t(_r(4))]),
+    "InstanceNorm": (lambda x, s, b: A.InstanceNorm(1e-5)(x, s, b),
+                     [_t(_r(2, 3, 4, 4)), _t(_r(3)), _t(_r(3))]),
+    "ScatterElements": (
+        lambda x: A.ScatterElements(np.array([[0, 2, 1], [3, 0, 2]]),
+                                    _SCAT_UPD, axis=0)(x),
+        [_t(_r(4, 3))]),
+    "Einsum": (lambda a, b: A.Einsum("bij,bjk->bik")(a, b),
+               [_t(_r(2, 3, 4)), _t(_r(2, 4, 2))]),
+    # native-handle ops (weights closed over -> initializers)
+    "_Conv2d": (lambda x: A._Conv2d(_CONV)(x, _CONVW, _CONVB),
+                [_t(_r(2, 2, 5, 5))]),
+    "_ConvTranspose2d": (
+        lambda x: A._ConvTranspose2d(_CONVT)(x, _CONVTW),
+        [_t(_r(1, 2, 4, 4))]),
+    "_Pooling2d": (lambda x: A._Pooling2d(_POOL)(x),
+                   [_t(_r(1, 2, 4, 4))]),
+    "_BatchNorm2d": (
+        lambda x, s, b: A._BatchNorm2d(_BNH, _BN_RM, _BN_RV)(x, s, b),
+        [_t(_r(2, 3, 4, 4)), _t(_r(3)), _t(_r(3))]),
+    "_RNN": (lambda x: A._RNN(_LSTM)(x, _LSTM_H, _LSTM_C, _LSTM_W),
+             [_t(_r(3, 2, 3))]),
+    "Attention": (lambda q, k, v: A.Attention(causal=True)(q, k, v),
+                  [_t(_r(1, 2, 4, 4)), _t(_r(1, 2, 4, 4)),
+                   _t(_r(1, 2, 4, 4))]),
+}
+
+# documented not-exportable ops; each must keep RAISING on export
+EXPORT_UNSUPPORTED = {
+    "Dummy": "leaf marker, never appears in a creator graph's ops",
+    "UpSample": "ONNX Upsample is deprecated (Resize is not in the "
+                "importer either); converter-only op",
+    "SoftMaxCrossEntropy": "loss head — the reference's sonnx also "
+                           "exports inference graphs only",
+    "MeanSquareError": "loss head (inference-graph export only)",
+    "BinaryCrossEntropy": "loss head (inference-graph export only)",
+}
+
+
+def _registry():
+    out = set()
+    for name, obj in vars(autograd).items():
+        if (inspect.isclass(obj) and issubclass(obj, autograd.Operator)
+                and obj is not autograd.Operator):
+            out.add(name)
+    return out
+
+
+def test_export_registry_complete():
+    """Every autograd op class must either have an export sweep case
+    or a documented not-exportable reason."""
+    covered = set(EXPORT_CASES) | set(EXPORT_UNSUPPORTED)
+    missing = sorted(_registry() - covered)
+    assert not missing, (
+        f"ops with no export-sweep entry and no documented "
+        f"not-exportable reason: {missing}")
+
+
+@pytest.mark.parametrize("name", sorted(EXPORT_CASES))
+def test_export_reimport_matches(name, tmp_path):
+    fn, inputs = EXPORT_CASES[name]
+    model = _OpGraph(fn)
+    golden = fn(*inputs)
+    golden = golden if isinstance(golden, tuple) else (golden,)
+    golden = [np.asarray(g.to_numpy()) for g in golden]
+
+    mp = sonnx.to_onnx(model, inputs)
+    # through the wire: serialize + reparse (what a real consumer sees)
+    path = str(tmp_path / f"{name}.onnx")
+    sonnx.save(mp, path)
+    rep = sonnx.prepare(sonnx.load(path))
+    outs = rep.run([np.asarray(t.to_numpy()) for t in inputs])
+    assert len(outs) == len(golden), (
+        f"{name}: {len(outs)} outputs vs {len(golden)} golden")
+    for got_t, want in zip(outs, golden):
+        got = got_t.to_numpy()
+        assert got.shape == want.shape, (
+            f"{name}: {got.shape} != {want.shape}")
+        if np.issubdtype(want.dtype, np.integer):
+            np.testing.assert_array_equal(got, want, err_msg=name)
+        else:
+            np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5,
+                                       err_msg=name)
+
+
+@pytest.mark.parametrize("name", sorted(EXPORT_UNSUPPORTED))
+def test_unexportable_actually_raise(name):
+    """Pin the not-exportable list: if an export mapping lands later,
+    this fails and the op must move into EXPORT_CASES."""
+    if name == "Dummy":
+        pytest.skip("Dummy wraps leaves; it cannot appear as a "
+                    "creator in a forward graph")
+    build = {
+        "UpSample": (lambda x: A.UpSample([1, 1, 2, 2])(x),
+                     [_t(_r(1, 2, 3, 3))]),
+        "SoftMaxCrossEntropy": (
+            lambda x: A.SoftMaxCrossEntropy(np.array([1, 0, 3]))(x),
+            [_t(_r(3, 5))]),
+        "MeanSquareError": (
+            lambda x: A.MeanSquareError(_r(3, 4))(x), [_t(_r(3, 4))]),
+        "BinaryCrossEntropy": (
+            lambda x: A.BinaryCrossEntropy(
+                _RS.rand(3, 4).round().astype(np.float32))(x),
+            [_t(_RS.rand(3, 4).astype(np.float32) * 0.8 + 0.1)]),
+    }[name]
+    fn, inputs = build
+    with pytest.raises(ValueError, match="no ONNX mapping"):
+        sonnx.to_onnx(_OpGraph(fn), inputs)
