@@ -232,8 +232,25 @@ def _op_executables(cls, key, op):
     return ent
 
 
+_ONES_CACHE: dict = {}
+
+
 def _ones_like(arr):
-    return jnp.ones_like(arr)
+    """Root cotangent. Concrete shapes hit a tiny cache — the eager
+    path pays one jnp dispatch per step for this otherwise. Keyed on
+    sharding too: a cached ones committed to device 0 must not leak
+    into a backward running on device 1."""
+    if isinstance(arr, jax.core.Tracer):
+        return jnp.ones_like(arr)
+    try:
+        key = (arr.shape, str(arr.dtype), arr.sharding)
+        hash(key)
+    except (AttributeError, TypeError):
+        return jnp.ones_like(arr)
+    v = _ONES_CACHE.get(key)
+    if v is None:
+        v = _ONES_CACHE[key] = jnp.ones_like(arr)
+    return v
 
 
 def backward(y: Tensor, dy=None):
@@ -1006,6 +1023,28 @@ class GlobalAveragePool(Operator):
 
 
 # ---- losses ---------------------------------------------------------------
+@jax.jit
+def _smce_int_fwd(x, ti):
+    """Fused eager softmax-CE forward (int labels): returns
+    (loss, softmax probs, one-hot targets, validity mask).  Semantics
+    identical to the inline traced path in SoftMaxCrossEntropy.forward
+    — invalid labels (e.g. -1 padding) one_hot to zero rows -> zero
+    loss, and the mask zeroes their grads in backward."""
+    n = x.shape[0] if x.ndim > 1 else 1
+    valid = ((ti >= 0) & (ti < x.shape[-1]))[..., None]
+    t = jax.nn.one_hot(ti, x.shape[-1], dtype=x.dtype)
+    logp = jax.nn.log_softmax(x, axis=-1)
+    p = jnp.exp(logp)
+    return -jnp.sum(t * logp) / n, p, t, valid
+
+
+@jax.jit
+def _smce_bwd(dy, p, onehot, valid):
+    n = p.shape[0] if p.ndim > 1 else 1
+    dx = dy * (p - onehot) / n
+    return jnp.where(valid, dx, 0.0)
+
+
 class SoftMaxCrossEntropy(Operator):
     """Fused softmax + CE, mean over batch. Hand-written backward
     (softmax(x) - onehot(t)) / N — matches the reference's fused
@@ -1040,8 +1079,14 @@ class SoftMaxCrossEntropy(Operator):
             return jnp.sum(_pk.softmax_xent(x, lab)) / n
         self._pallas_res = None
         self._valid = None
+        traced = isinstance(x, jax.core.Tracer)
         if int_labels:
             ti = t.reshape(t.shape[: x.ndim - 1]).astype(jnp.int32)
+            if not traced and not isinstance(ti, jax.core.Tracer):
+                # eager: one jitted executable instead of ~6 dispatches
+                loss, self._p, self._onehot, self._valid = (
+                    _smce_int_fwd(x, ti))
+                return loss
             # Padding labels (e.g. -1) produce an all-zero one_hot row
             # -> zero loss; the backward masks the same rows to zero
             # grad (matching the Pallas kernel's semantics).
@@ -1059,6 +1104,11 @@ class SoftMaxCrossEntropy(Operator):
             x, lab = self._pallas_res
             g = jnp.full((x.shape[0],), dy / self._n, jnp.float32)
             dx, _ = _pk._softmax_xent_bwd((x, lab), g)
+            return dx.astype(self._in_dtype)
+        if self._valid is not None and not isinstance(
+                dy, jax.core.Tracer):
+            dx = _smce_bwd(jnp.asarray(dy, jnp.float32), self._p,
+                           self._onehot, self._valid)
             return dx.astype(self._in_dtype)
         dx = dy * (self._p - self._onehot) / self._n
         if self._valid is not None:
