@@ -15,7 +15,11 @@ def finetune_imported(path: str, steps: int, num_classes: int, x,
     fine-tune it for `steps` on random labels; returns per-step
     losses."""
     ft = sonnx.SONNXModel(sonnx.load(path))
-    ft.set_optimizer(opt.SGD(lr=lr, momentum=0.9))
+    # Global-norm clipping: a randomly-labeled finetune on a
+    # fresh-initialized BN net (batch 2) is a chaotic trajectory —
+    # without the clip, bitwise rounding luck decides between smooth
+    # descent and a momentum blow-up to NaN.
+    ft.set_optimizer(opt.SGD(lr=lr, momentum=0.9).set_clip_norm(1.0))
     ft.train()
     y = tensor.from_numpy(np.random.RandomState(1)
                           .randint(0, num_classes, x.shape[0])

@@ -98,8 +98,13 @@ class Device:
     set_rand_seed = SetRandSeed
 
     def next_key(self):
-        """Split and return a fresh PRNG key (counter-based, reproducible)."""
-        self._rng_key, sub = jax.random.split(self._rng_key)
+        """Split and return a fresh PRNG key (counter-based,
+        reproducible).  The split runs under compile-time eval: the
+        key is host state, so even inside a trace (the eval_shape init
+        forward, a jitted init) it advances CONCRETELY — a traced key
+        could never be handed back to host-side consumers."""
+        with jax.ensure_compile_time_eval():
+            self._rng_key, sub = jax.random.split(self._rng_key)
         return sub
 
     # ---- Execution ------------------------------------------------------

@@ -152,15 +152,29 @@ class Optimizer:
         cache instead of silently keeping the old math.  The step
         counter is excluded — it is threaded through as a traced
         argument and stays dynamic."""
+        import numbers
+
+        def leaf(v):
+            if isinstance(v, (int, float, bool, str, type(None))):
+                return v
+            if isinstance(v, numbers.Number):  # np scalars etc.
+                return float(v)
+            if isinstance(v, (list, tuple)):
+                return tuple(leaf(x) for x in v)
+            if isinstance(v, np.ndarray):
+                return (v.shape, str(v.dtype), v.tobytes())
+            if isinstance(v, DecayScheduler):
+                return snap(v)
+            # unknown object: key on identity so SWAPPING it retraces
+            # (in-place mutation of an opaque object is out of scope)
+            return ("obj", type(v).__name__, id(v))
+
         def snap(obj):
             items = []
             for k, v in sorted(vars(obj).items()):
-                if k == "step_counter":
+                if k in ("step_counter", "states", "_fused_cache"):
                     continue
-                if isinstance(v, (int, float, bool, str)):
-                    items.append((k, v))
-                elif isinstance(v, DecayScheduler):
-                    items.append((k, snap(v)))
+                items.append((k, leaf(v)))
             return (type(obj).__name__, tuple(items))
 
         return snap(self)
@@ -192,11 +206,23 @@ class Optimizer:
                         for (p, _), nm in zip(prepared, names_list)
                         for n in nm])
         donate = len({id(a) for a in flat_args}) == len(flat_args)
+        pids_key = tuple(id(p) for p, _ in prepared)
         key = (self._hyper_key(), donate, tuple(
             (id(p), nm, p.data.shape, str(p.data.dtype), str(g.dtype))
             for (p, g), nm in zip(prepared, names_list)))
         cache = self.__dict__.setdefault("_fused_cache", {})
         ent = cache.get(key)
+        if ent is None:
+            # Evict superseded entries for the same param set (the
+            # pre-slot-creation executable from step 1 is dead weight
+            # once slots exist — its closure pins the param list), and
+            # bound the cache overall (an optimizer reused across
+            # rebuilt models would otherwise pin dead params forever).
+            for k in [k for k, (_, _, pk_) in cache.items()
+                      if pk_ == pids_key and k != key]:
+                del cache[k]
+            while len(cache) >= 32:
+                del cache[next(iter(cache))]
         if ent is None:
             params = [p for p, _ in prepared]
             pids = [id(p) for p in params]
@@ -233,9 +259,9 @@ class Optimizer:
             # reference (checkpoint snapshots fork with jnp.copy first)
             # would error loudly on use-after-donate.
             ent = (jax.jit(pure, donate_argnums=(0, 3) if donate
-                           else ()), meta)
+                           else ()), meta, pids_key)
             cache[key] = ent
-        fn, meta = ent
+        fn, meta, _ = ent
         values = [p.data for p, _ in prepared]
         gs = [g for _, g in prepared]
         slots = [[self.states[id(p)][n] for n in nm] if nm else []

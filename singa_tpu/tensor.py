@@ -102,7 +102,13 @@ class Tensor:
         self.device = device or get_default_device()
         dtype = _as_dtype(dtype)
         if data is None:
-            arr = jnp.zeros(tuple(shape), dtype=dtype)
+            # Host-side numpy allocation placed with device_put: no
+            # XLA program per shape, and the buffer stays concrete
+            # even when constructed during a trace (lazy layer init
+            # under the eval_shape compile pass).
+            with jax.ensure_compile_time_eval():
+                arr = jax.device_put(
+                    np.zeros(tuple(shape), dtype=np.dtype(dtype)))
         elif isinstance(data, (np.ndarray, list, tuple, float, int)):
             arr = jnp.asarray(data, dtype=dtype)
         else:  # jax array — keep its dtype unless caller asked otherwise
@@ -213,26 +219,43 @@ class Tensor:
     # ---- random fill ----------------------------------------------------
     # Reference: curand-backed `Uniform/Gaussian/Bernoulli` free fns;
     # here: counter-based threefry via the device key stream.
+    # Fill methods compute values with HOST numpy (a Philox generator
+    # seeded from the device's jax PRNG key, so `SetRandSeed`
+    # determinism is preserved) and place the result with device_put
+    # under `ensure_compile_time_eval`.  Two reasons: (a) values stay
+    # CONCRETE even when the fill happens inside a trace — which is
+    # what lets the zero-compile `Model._eval_shape_init_forward`
+    # create real params while the init forward traces abstractly;
+    # (b) no XLA programs get compiled per fill shape (ResNet-50 init
+    # used to trigger 55 tiny backend compiles ≈ 14 s on first use).
+    def _fill(self, arr) -> None:
+        with jax.ensure_compile_time_eval():
+            self.data = self.device.put(
+                np.ascontiguousarray(
+                    arr.astype(np.dtype(self.dtype), copy=False)))
+
+    def _np_rng(self) -> np.random.Generator:
+        kb = np.asarray(self.device.next_key()).ravel().view(np.uint32)
+        return np.random.Generator(
+            np.random.Philox((int(kb[0]) << 32) | int(kb[1])))
+
     def gaussian(self, mean: float = 0.0, std: float = 1.0) -> None:
-        self.data = (
-            jax.random.normal(self.device.next_key(), self.shape, self.dtype)
-            * std
-            + mean
-        )
+        rng = self._np_rng()
+        self._fill(rng.standard_normal(self.shape) * std + mean)
 
     def uniform(self, low: float = 0.0, high: float = 1.0) -> None:
-        self.data = jax.random.uniform(
-            self.device.next_key(), self.shape, self.dtype, low, high
-        )
+        rng = self._np_rng()
+        self._fill(rng.random(self.shape) * (high - low) + low)
 
     def bernoulli(self, p: float) -> None:
-        self.data = jax.random.bernoulli(
-            self.device.next_key(), p, self.shape
-        ).astype(self.dtype)
+        rng = self._np_rng()
+        self._fill((rng.random(self.shape) < p).astype(np.float32))
 
     def set_value(self, x) -> None:
         """Reference: `Tensor::SetValue` — fill with scalar."""
-        self.data = jnp.full(self.shape, x, dtype=self.dtype)
+        with jax.ensure_compile_time_eval():
+            self.data = self.device.put(
+                np.full(self.shape, x, dtype=np.dtype(self.dtype)))
 
     # ---- python protocol -------------------------------------------------
     def __len__(self):
