@@ -199,7 +199,7 @@ def stage_smoke():
     print(json.dumps({"ok": True, "phases": phases}), flush=True)
 
 
-def stage_resnet(batch, steps, deadline_s, amp=False):
+def stage_resnet(batch, steps, deadline_s, amp=False, remat=False):
     """ResNet-50 synthetic throughput at one batch size.
 
     Timing is pipelined: enqueue `steps` train steps back-to-back and
@@ -228,6 +228,13 @@ def stage_resnet(batch, steps, deadline_s, amp=False):
     tensor.set_matmul_precision("default")
     if amp:
         tensor.set_compute_dtype("bfloat16")
+    if remat:
+        # Rematerialize conv activations: ResNet-50 here is HBM-bound
+        # (BASELINE.md roofline), so trading FLOPs for activation
+        # traffic is the interesting experiment, not a memory saver.
+        from singa_tpu import autograd as _ag
+
+        _ag.set_remat(True)
 
     m = resnet.create_model(depth=50)
     m.set_optimizer(opt.SGD(lr=0.1, momentum=0.9))
@@ -278,6 +285,7 @@ def stage_resnet(batch, steps, deadline_s, amp=False):
     ips = batch / med
     out = {"ok": True, "batch": batch, "ips": round(ips, 2),
            "step_ms": round(1e3 * med, 2),
+           "remat": bool(remat),
            "precision": "bf16" if amp else "fp32",
            "compile_s": round(host_compile + first_step, 1),
            "loss": round(float(loss.to_numpy()), 3)}
@@ -474,6 +482,9 @@ def main():
     p.add_argument("--deadline", type=float, default=420.0)
     p.add_argument("--amp", action="store_true",
                    help="bf16 compute policy for the resnet stage")
+    p.add_argument("--remat", action="store_true",
+                   help="activation remat for the resnet stage "
+                   "(HBM-traffic-vs-FLOPs experiment)")
     p.add_argument("--smoke", action="store_true",
                    help="<=2min chip smoke test only")
     a = p.parse_args()
@@ -483,7 +494,8 @@ def main():
     if a.stage == "smoke":
         return stage_smoke()
     if a.stage == "resnet":
-        return stage_resnet(a.batch, a.steps, a.deadline, amp=a.amp)
+        return stage_resnet(a.batch, a.steps, a.deadline, amp=a.amp,
+                            remat=a.remat)
     if a.stage == "lm":
         return stage_lm(a.batch, a.seq, a.steps, a.deadline)
     if a.stage == "pallas":

@@ -191,9 +191,11 @@ class Model(Layer):
         reads feature dims, which batch shardings leave whole.
 
         Uses the same batch-1 slicing policy as
-        `_eval_shape_init_forward` so
+        `_eval_shape_init_forward`, and compile() wraps both paths in
+        eval mode, so
         the two init paths leave identical model state (params by RNG
-        determinism; BN running stats because both see the same slice).
+        determinism; BN running stats stay at creation values — eval
+        mode never updates them).
         """
         from .device import get_default_device
 

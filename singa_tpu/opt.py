@@ -179,7 +179,7 @@ class Optimizer:
 
         return snap(self)
 
-    def _fused_eager_update_all(self, pairs) -> None:
+    def _fused_eager_update_all(self, pairs, clip=False) -> None:
         """Whole-step eager optimizer fusion: every (param, grad)
         pair's update — slot math included — runs as ONE jitted
         executable.  Same shim-trace technique as
@@ -207,7 +207,8 @@ class Optimizer:
                         for n in nm])
         donate = len({id(a) for a in flat_args}) == len(flat_args)
         pids_key = tuple(id(p) for p, _ in prepared)
-        key = (self._hyper_key(), donate, tuple(
+        do_clip = clip and self.clip_norm is not None
+        key = (self._hyper_key(), donate, do_clip, tuple(
             (id(p), nm, p.data.shape, str(p.data.dtype), str(g.dtype))
             for (p, g), nm in zip(prepared, names_list)))
         cache = self.__dict__.setdefault("_fused_cache", {})
@@ -233,6 +234,14 @@ class Optimizer:
                 saved_step = self.step_counter
                 self.step_counter = step
                 try:
+                    if do_clip:
+                        # global-norm clip fused into the same program
+                        # (only from backward_and_update, which sees
+                        # the FULL grad set; a single-pair update()
+                        # must never clip by one grad's norm)
+                        scale = _global_clip_scale(self.clip_norm, gs)
+                        gs = [(g.astype(jnp.float32)
+                               * scale).astype(g.dtype) for g in gs]
                     new_values, new_slots, out_names = [], [], []
                     for p, pid, nm, v, g, sl in zip(
                             params, pids, names_list, values, gs,
@@ -288,33 +297,36 @@ class Optimizer:
         apply updates per (param, grad) pair in emission order (with
         optional global-norm clipping, which buffers the pairs first
         but preserves the deterministic update order)."""
-        if self.clip_norm is None:
-            import jax
+        import jax
 
-            pairs = []
-            eager = True
-            for p, g in autograd.iter_backward(loss):
-                pairs.append((p, g))
-                if (isinstance(p.data, jax.core.Tracer)
-                        or isinstance(
-                            g.data if isinstance(g, Tensor) else g,
-                            jax.core.Tracer)):
-                    eager = False
-            if eager and pairs:
-                # one jitted executable for ALL param updates
-                # (VERDICT r4 next #7: batch the optimizer's per-param
-                # updates) instead of one dispatch per param
-                self._fused_eager_update_all(pairs)
-            else:
-                for p, g in pairs:
-                    self.update(p, g)
+        pairs = []
+        eager = True
+        for p, g in autograd.iter_backward(loss):
+            pairs.append((p, g))
+            if (isinstance(p.data, jax.core.Tracer)
+                    or isinstance(
+                        g.data if isinstance(g, Tensor) else g,
+                        jax.core.Tracer)):
+                eager = False
+        if eager and pairs:
+            # one jitted executable for ALL param updates (VERDICT r4
+            # next #7) instead of one dispatch per param; global-norm
+            # clipping happens INSIDE the same program (the fused
+            # trace reads self.clip_norm, which is part of the cache
+            # key)
+            self._fused_eager_update_all(pairs, clip=True)
             self.step()
             return loss
-        pairs = [(p, g.data if isinstance(g, Tensor) else g)
-                 for p, g in autograd.iter_backward(loss)]
+        if self.clip_norm is None:
+            for p, g in pairs:
+                self.update(p, g)
+            self.step()
+            return loss
+        raw = [(p, g.data if isinstance(g, Tensor) else g)
+               for p, g in pairs]
         scale = _global_clip_scale(self.clip_norm,
-                                   [g for _, g in pairs])
-        for p, g in pairs:
+                                   [g for _, g in raw])
+        for p, g in raw:
             self.update(p, (g.astype(jnp.float32) * scale).astype(g.dtype))
         self.step()
         return loss

@@ -45,3 +45,23 @@ def default_dev():
     from singa_tpu import device
 
     return device.get_default_device()
+
+
+def pytest_collection_modifyitems(config, items):
+    """Deselect `slow`-marked tests by default (keeps the default run
+    under the CI budget — VERDICT r4 next #8) WITHOUT the addopts
+    trap: passing any -m expression (including -m "") or naming an
+    explicit ::node id bypasses the filter, so
+    `pytest tests/test_gan.py::test_vanilla_gan_moves_toward_ring`
+    runs the test instead of silently collecting nothing."""
+    args = [str(a) for a in config.invocation_params.args]
+    if any(a == "-m" or a.startswith("-m=") or a.startswith("--markexpr")
+           for a in args):
+        return
+    if any("::" in a for a in args):
+        return
+    selected = [i for i in items if "slow" not in i.keywords]
+    deselected = [i for i in items if "slow" in i.keywords]
+    if deselected:
+        config.hook.pytest_deselected(items=deselected)
+        items[:] = selected

@@ -109,6 +109,7 @@ class TestBertImport:
                 assert not np.allclose(before[k], after[k]), \
                     f"param {k} received no gradient"
 
+    @pytest.mark.slow
     def test_finetune_mesh_dp_loss_decreases(self, bert_proto):
         """The north-star workflow: imported graph + Model.compile over
         a data-parallel mesh, one SPMD program per step."""

@@ -68,6 +68,7 @@ def test_resnet_train_step_graph_mode():
     assert float(l2.to_numpy()) < float(l0.to_numpy())
 
 
+@pytest.mark.slow
 def test_vgg_forward_shapes_and_train():
     import vgg
 
@@ -85,6 +86,7 @@ def test_vgg_forward_shapes_and_train():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_mobilenetv2_forward_shapes_and_train():
     import mobilenet
 

@@ -2,6 +2,7 @@
 — SURVEY.md §2.3). Few iterations; asserts the generator's samples
 move from the origin toward the data ring (radius 1)."""
 import importlib.util
+import pytest
 import os
 import sys
 
@@ -18,6 +19,7 @@ def _load(name):
     return mod
 
 
+@pytest.mark.slow
 def test_vanilla_gan_moves_toward_ring():
     mod = _load("vanilla")
     r = mod.run(iters=150, batch=64, verbose=False)

@@ -6,6 +6,7 @@ greedy incremental decode must match full-context forward argmax
 token for token.
 """
 import numpy as np
+import pytest
 
 from singa_tpu import device, tensor
 from singa_tpu.models.transformer import TransformerLM
@@ -32,6 +33,7 @@ def _naive_greedy(m, prompt, n):
     return ids
 
 
+@pytest.mark.slow
 def test_greedy_matches_full_forward():
     m = _build()
     rs = np.random.RandomState(0)

@@ -35,7 +35,7 @@ from singa_tpu import autograd, tensor
 from singa_tpu.ops import native
 from singa_tpu.ops.rnn import RNNHandle
 
-MAX_ELEMS_PER_INPUT = 24  # sampled central-difference points per input
+MAX_ELEMS_PER_INPUT = 16  # sampled central-difference points per input
 
 
 # ---------------------------------------------------------------------------

@@ -34,6 +34,7 @@ def _dense_ref(params, xt, cap):
     return y
 
 
+@pytest.mark.slow
 def test_moe_matches_dense_reference():
     params = _params()
     rs = np.random.RandomState(1)

@@ -3,6 +3,10 @@ launch modes, examples/cnn/{train_multiprocess,train_mpi}.py —
 SURVEY.md §2.3 "Distributed CNN"). Spawns real worker processes that
 bootstrap jax.distributed over a coordinator, form a global 2-device
 mesh, and train with XLA-inserted gradient reductions."""
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import os
 import socket
 import subprocess

@@ -7,6 +7,20 @@ import sys
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(autouse=True)
+def _seed_device():
+    """Zoo exports build NATIVE models whose init draws from the
+    global device key — without a per-test seed, each test's weights
+    (and the chaotic random-label finetune trajectories) depend on
+    which tests ran before it in the process."""
+    from singa_tpu import device
+
+    device.get_default_device().SetRandSeed(123)
+
+
 _ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 sys.path.insert(0, os.path.join(_ROOT, "examples", "onnx"))
 sys.path.insert(0, os.path.join(_ROOT, "examples", "cnn", "model"))

@@ -96,6 +96,7 @@ class TestRingAttention:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-5)
 
+    @pytest.mark.slow
     def test_grads_match_plain(self, qkv):
         q, k, v = qkv
         mesh = create_mesh({"data": 2, "seq": 4})
@@ -222,6 +223,7 @@ class TestTransformerParallel:
         losses = [float(m(tx, ty)[1].to_numpy()) for _ in range(6)]
         assert losses[-1] < losses[0] * 0.6
 
+    @pytest.mark.slow
     def test_mesh_matches_single_device_loss(self):
         from singa_tpu.models.transformer import TransformerLM
 

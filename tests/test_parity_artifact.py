@@ -20,9 +20,9 @@ def test_cifar_cnn_eager_vs_graph_parity_small():
     sys.path.insert(0, _ROOT)
     from tools.parity_cifar10 import max_rel_diff, train_curve
 
-    eager = train_curve("cpu", False, steps=6)
-    graph = train_curve("cpu", True, steps=6)
-    assert len(eager) == len(graph) == 6
+    eager = train_curve("cpu", False, steps=4)
+    graph = train_curve("cpu", True, steps=4)
+    assert len(eager) == len(graph) == 4
     assert max_rel_diff(eager, graph) <= 2e-2, (eager, graph)
     # and training actually trains
     assert graph[-1] < graph[0]

@@ -54,6 +54,7 @@ def test_forward_matches_sequential(mesh4, microbatches):
                                rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_grads_match_sequential(mesh4):
     per_stage = _stages(4, 16, seed=2)
     x = jnp.asarray(
