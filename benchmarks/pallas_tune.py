@@ -1,0 +1,126 @@
+"""Pallas kernel block-shape tuner (VERDICT r4 next #3).
+
+Sweeps the env-overridable tiling knobs in
+`singa_tpu/ops/pallas_kernels.py` by re-running the relevant
+`pallas_micro.py` measurements in subprocesses (the knobs are read at
+import), and prints a winners table.  Run ON the chip:
+
+    python benchmarks/pallas_tune.py
+
+Knobs swept:
+  SINGA_TPU_ATTN_TQ      flash-attention query tile (seq-512 case is
+                         the one below the XLA crossover)
+  SINGA_TPU_ROW_BUDGET   elements/block for the row-tiled kernels
+                         (dropout + softmax-xent)
+  SINGA_TPU_HIST_BUDGET  top-K histogram accumulation tile
+
+If a knob setting pushes a currently-losing kernel past 1.1x XLA,
+bake it in as the default in pallas_kernels.py and re-run
+pallas_micro.py to refresh PALLAS_BENCH.md; otherwise the per-kernel
+default-off policy stands (see the policy note in pallas_kernels.py).
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.abspath(os.path.join(HERE, ".."))
+
+CASE_SRC = r"""
+import json, sys, time
+sys.path.insert(0, {root!r})
+import numpy as np
+import jax, jax.numpy as jnp
+from singa_tpu.ops import pallas_kernels as pk
+
+def timeit(fn, *args, iters=30, warmup=5):
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+case = {case!r}
+rs = np.random.RandomState(0)
+if case == "attn512":
+    B, H, S, D = 8, 12, 512, 64
+    q = jnp.asarray(rs.randn(B, H, S, D), jnp.float32)
+    k = jnp.asarray(rs.randn(B, H, S, D), jnp.float32)
+    v = jnp.asarray(rs.randn(B, H, S, D), jnp.float32)
+    def step(q, k, v):
+        out, vjp = jax.vjp(lambda a, b, c:
+                           pk.flash_attention(a, b, c, True, None),
+                           q, k, v)
+        return vjp(out)
+    f = jax.jit(step)
+    us = timeit(f, q, k, v) * 1e6
+elif case == "dropout":
+    x = jnp.asarray(rs.randn(4096, 4096), jnp.float32)
+    f = jax.jit(lambda x: pk.dropout(x, 0.3, jnp.int32(7)))
+    us = timeit(f, x) * 1e6
+elif case == "topk20":
+    x = jnp.asarray(rs.randn(1 << 20), jnp.float32)
+    f = jax.jit(lambda x: pk.topk_sparsify(x, 0.01))
+    us = timeit(f, x) * 1e6
+elif case == "xent1024":
+    x = jnp.asarray(rs.randn(1024, 1000), jnp.float32)
+    lab = jnp.asarray(rs.randint(0, 1000, 1024), jnp.int32)
+    def step(x):
+        loss, vjp = jax.vjp(lambda a: jnp.sum(pk.softmax_xent(a, lab)), x)
+        return vjp(1.0)
+    f = jax.jit(step)
+    us = timeit(f, x) * 1e6
+print("RESULT " + json.dumps({{"case": case, "us": us}}))
+"""
+
+
+def run_case(case, env_overrides, deadline=240):
+    env = dict(os.environ)
+    env.update({k: str(v) for k, v in env_overrides.items()})
+    code = CASE_SRC.format(root=ROOT, case=case)
+    try:
+        out = subprocess.run([sys.executable, "-c", code], env=env,
+                             capture_output=True, text=True,
+                             timeout=deadline)
+    except subprocess.TimeoutExpired:
+        return None
+    for line in out.stdout.splitlines():
+        if line.startswith("RESULT "):
+            return json.loads(line[len("RESULT "):])["us"]
+    print(out.stderr[-400:], file=sys.stderr)
+    return None
+
+
+def main():
+    sweeps = [
+        ("attn512", "SINGA_TPU_ATTN_TQ", [64, 128, 256, 512]),
+        ("xent1024", "SINGA_TPU_ROW_BUDGET",
+         [1 << 17, 1 << 18, 1 << 19, 1 << 20]),
+        ("dropout", "SINGA_TPU_ROW_BUDGET",
+         [1 << 17, 1 << 18, 1 << 19, 1 << 20, 1 << 21]),
+        ("topk20", "SINGA_TPU_HIST_BUDGET",
+         [1 << 11, 1 << 12, 1 << 13, 1 << 14, 1 << 15]),
+    ]
+    print(f"# pallas tune sweep ({time.strftime('%Y-%m-%d %H:%M')})")
+    for case, knob, values in sweeps:
+        rows = []
+        for v in values:
+            us = run_case(case, {knob: v})
+            rows.append((v, us))
+            print(f"{case:10s} {knob}={v:<9} "
+                  f"{'FAIL' if us is None else f'{us:9.1f} us'}",
+                  flush=True)
+        good = [(v, us) for v, us in rows if us is not None]
+        if good:
+            best = min(good, key=lambda t: t[1])
+            print(f"--> best {case}: {knob}={best[0]} "
+                  f"({best[1]:.1f} us)\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -1234,7 +1234,7 @@ class Dropout(Operator):
             key = get_default_device().next_key()
         from .ops import pallas_kernels as _pk
 
-        if _pk.enabled() and not _pk._interpret():
+        if _pk.dropout_enabled() and not _pk._interpret():
             # Pallas tier: on-core PRNG + mask + scale in one kernel
             # (TPU only — the interpreter can't emulate the core PRNG).
             seed = jax.random.randint(key, (), 0, 2 ** 31 - 1, jnp.int32)

@@ -233,7 +233,7 @@ class Communicator:
 
         flat = jnp.ravel(x)
         if topK:
-            if _pk.enabled():
+            if _pk.sparsify_enabled():
                 # Pallas tier: histogram-threshold kernel (keeps >= K;
                 # see pallas_kernels.topk_sparsify).
                 masked = _pk.topk_sparsify(flat, spars)
@@ -241,7 +241,7 @@ class Communicator:
                 k = max(1, int(flat.size * spars))
                 thresh = jax.lax.top_k(jnp.abs(flat), k)[0][-1]
                 masked = jnp.where(jnp.abs(flat) >= thresh, flat, 0.0)
-        elif _pk.enabled():
+        elif _pk.sparsify_enabled():
             masked = _pk.threshold_mask(flat, spars)
         else:
             masked = jnp.where(jnp.abs(flat) >= spars, flat, 0.0)

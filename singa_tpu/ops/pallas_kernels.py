@@ -43,6 +43,23 @@ except ImportError:  # pragma: no cover
 
 _ENABLED = os.environ.get("SINGA_TPU_PALLAS", "0") == "1"
 
+# Per-kernel policy (VERDICT r4 next #3: "make every Pallas kernel pay
+# or cut it").  Measured on the v5e (benchmarks/PALLAS_BENCH.md):
+# fused softmax-xent wins at every tested shape (1.07-1.80x) and flash
+# attention wins from seq >= ~1024 (1.14-1.27x; 0.98x at 512), so the
+# default tier routes ONLY those, with the attention crossover
+# enforced by `attn_supported`.  The on-core-PRNG dropout (0.94x) and
+# the histogram top-K sparsifier (0.89-1.03x) sit at parity with
+# XLA's own fusion — they remain correct, tested, and available, but
+# engage only with SINGA_TPU_PALLAS_ALL=1 (or `enable_all`) so the
+# default tier never trades a measured win for a measured loss.
+_ALL = os.environ.get("SINGA_TPU_PALLAS_ALL", "0") == "1"
+# ALL implies the tier itself: opting into the parity kernels with
+# only SINGA_TPU_PALLAS_ALL=1 must not be a silent no-op.
+_ENABLED = _ENABLED or _ALL
+# Tuning knobs (exercised by benchmarks/pallas_tune.py on the chip):
+_ATTN_MIN_SEQ = int(os.environ.get("SINGA_TPU_ATTN_MIN_SEQ", "1024"))
+
 
 def enable(flag: bool = True) -> None:
     """Switch the Pallas kernel tier on/off (SINGA_TPU_PALLAS env also
@@ -55,14 +72,38 @@ def enabled() -> bool:
     return _ENABLED
 
 
+def enable_all(flag: bool = True) -> None:
+    """Also route the parity-with-XLA kernels (dropout, top-K
+    sparsify) through Pallas — off by default; see the policy note.
+    Enabling ALL enables the tier itself (never a silent no-op);
+    disabling ALL leaves the tier's own switch untouched."""
+    global _ALL, _ENABLED
+    _ALL = bool(flag)
+    if _ALL:
+        _ENABLED = True
+
+
+def dropout_enabled() -> bool:
+    return _ENABLED and _ALL
+
+
+def sparsify_enabled() -> bool:
+    return _ENABLED and _ALL
+
+
 def _interpret() -> bool:
     """Interpret mode off-TPU so CI covers the kernel code paths."""
     return jax.default_backend() not in ("tpu", "axon")
 
 
-def _row_tile(batch: int, ncol: int, budget: int = 1 << 19) -> int:
+_ROW_BUDGET = int(os.environ.get("SINGA_TPU_ROW_BUDGET", str(1 << 19)))
+_HIST_BUDGET = int(os.environ.get("SINGA_TPU_HIST_BUDGET", str(1 << 13)))
+
+
+def _row_tile(batch: int, ncol: int, budget: int = 0) -> int:
     """Rows per block: keep a block under ~budget elements, multiple
     of 8 (f32 sublane)."""
+    budget = budget or _ROW_BUDGET
     rows = max(1, budget // max(ncol, 1))
     rows = min(batch, rows)
     if rows >= 8:
@@ -267,7 +308,7 @@ def topk_threshold(flat, k: int):
     pad = (-n) % lane
     x = jnp.pad(flat, (0, pad)) if pad else flat
     x2 = x.reshape(-1, lane)
-    tile = _row_tile(x2.shape[0], lane, budget=1 << 13)
+    tile = _row_tile(x2.shape[0], lane, budget=_HIST_BUDGET)
     x2, _ = _pad_rows(x2, tile)
     grid = (x2.shape[0] // tile,)
     nrows = _BINS // _HIST_CHUNK
@@ -326,15 +367,26 @@ def threshold_mask(x, thr):
 # probabilities in-VMEM (the flash attention recipe). MXU does the four
 # matmuls; padding and causality are iota masks.
 # ===========================================================================
-_ATTN_TQ = 128          # query rows per grid step (f32 sublane-aligned)
+_ATTN_TQ = int(os.environ.get("SINGA_TPU_ATTN_TQ", "128"))
+# query rows per grid step; env knob for tuning.  Validate HERE: a
+# misaligned tile would otherwise surface as an opaque Mosaic
+# BlockSpec rejection deep inside jit.
+if _ATTN_TQ < 8 or _ATTN_TQ % 8:
+    raise ValueError(
+        f"SINGA_TPU_ATTN_TQ={_ATTN_TQ}: the flash-attention query "
+        "tile must be a positive multiple of 8 (f32 sublane)")
 _ATTN_VMEM_BUDGET = 6 * (1 << 20)  # bytes of k/v/q residents per head
 
 
 def attn_supported(s: int, d: int) -> bool:
-    """This kernel keeps a head's full K and V (and in backward, Q and
-    dO) resident in VMEM — sizes beyond the budget must fall back to
-    the XLA path (long-context runs use ring attention anyway)."""
-    return 4 * s * d * 4 <= _ATTN_VMEM_BUDGET
+    """Route attention through the fused kernel only where it WINS:
+    the head's K/V (and in backward, Q and dO) must fit the VMEM
+    residency budget, and the sequence must clear the measured
+    XLA crossover (~1024 on v5e — at 512 the kernel is 0.98x XLA;
+    benchmarks/PALLAS_BENCH.md).  Long-context runs use ring
+    attention anyway."""
+    return (s >= _ATTN_MIN_SEQ
+            and 4 * s * d * 4 <= _ATTN_VMEM_BUDGET)
 
 
 def _attn_mask(scores, qi0, tq, sq, sk, causal):

@@ -120,13 +120,21 @@ class TestTopKSparsify:
     def test_communicator_sparsification_uses_kernel(self):
         from singa_tpu.dist.communicator import Communicator
 
-        comm = Communicator(world_size=1)
-        rs = np.random.RandomState(8)
-        g = jnp.asarray(rs.randn(32, 16).astype(np.float32))
-        y = comm.sparsification(g, spars=0.1, topK=True)
-        assert y.shape == g.shape
-        kept = int(jnp.sum(y != 0))
-        assert kept >= int(g.size * 0.1)
+        # the sparsifier is behind the opt-in ALL switch (routing
+        # policy: parity-with-XLA kernels don't ship by default)
+        pk.enable_all(True)
+        try:
+            assert pk.sparsify_enabled()
+            comm = Communicator(world_size=1)
+            rs = np.random.RandomState(8)
+            g = jnp.asarray(rs.randn(32, 16).astype(np.float32))
+            y = comm.sparsification(g, spars=0.1, topK=True)
+            assert y.shape == g.shape
+            kept = int(jnp.sum(y != 0))
+            assert kept >= int(g.size * 0.1)
+        finally:
+            pk.enable_all(False)
+            pk.enable(False)
 
 
 @pytest.mark.skipif(jax.default_backend() not in ("tpu", "axon"),
@@ -200,6 +208,11 @@ class TestFlashAttention:
         from singa_tpu import autograd, tensor
 
         pk.enable(True)
+        # drop the seq>=1024 crossover gate so the 64-token case still
+        # exercises the autograd->kernel ROUTING (the gate itself is
+        # perf policy, covered by test_attn_supported_crossover)
+        saved_min = pk._ATTN_MIN_SEQ
+        pk._ATTN_MIN_SEQ = 0
         try:
             q, k, v = self._qkv(1, 2, 64, 32)
             tq = tensor.from_raw(q, None)
@@ -214,6 +227,7 @@ class TestFlashAttention:
             np.testing.assert_allclose(out.to_numpy(), np.asarray(ref),
                                        rtol=1e-4, atol=1e-5)
         finally:
+            pk._ATTN_MIN_SEQ = saved_min
             pk.enable(False)
 
     def test_vmem_budget_gate(self):
@@ -243,3 +257,26 @@ class TestFlashAttention:
                                        rtol=1e-4, atol=1e-5)
         finally:
             pk.enable(False)
+
+
+def test_attn_supported_crossover_gate():
+    """Routing policy: below the measured XLA crossover the fused
+    kernel must NOT engage; above it (and within the VMEM budget) it
+    must."""
+    assert not pk.attn_supported(512, 64)      # 0.98x XLA: stay off
+    assert pk.attn_supported(1024, 64)         # 1.14x: on
+    assert pk.attn_supported(2048, 128)        # 1.27x: on
+    assert not pk.attn_supported(1 << 16, 128)  # VMEM budget exceeded
+
+
+def test_enable_all_implies_tier_on():
+    saved_e, saved_a = pk._ENABLED, pk._ALL
+    try:
+        pk.enable(False)
+        pk.enable_all(True)
+        assert pk.enabled() and pk.dropout_enabled() \
+            and pk.sparsify_enabled()
+        pk.enable_all(False)
+        assert pk.enabled() and not pk.dropout_enabled()
+    finally:
+        pk._ENABLED, pk._ALL = saved_e, saved_a
