@@ -146,13 +146,22 @@ class Operator:
         ys = ys if multiple else (ys,)
         self.num_outputs = len(ys)
         self._out_shapes = [(y.shape, y.dtype) for y in ys]
+        # Graph structure is recorded whenever any INPUT is tracked,
+        # even if forward cleared self.requires_grad (comparisons,
+        # OneHot): gradient flow and graph topology are different
+        # things — without the creator link, sonnx export would bake
+        # a non-differentiable op's OUTPUT VALUES into the file as
+        # input-independent constants.  Backward never traverses these
+        # links (outputs keep requires_grad=False), and inference
+        # graphs (all inputs untracked) still free tensors eagerly.
+        track_graph = any(t.requires_grad for t in xs)
         outs = []
         for i, y in enumerate(ys):
             t = tensor_mod.from_raw(y, dev)
-            if self.requires_grad:
-                t.requires_grad = True
+            if track_graph:
                 t.creator = self
                 t.creator_index = i
+                t.requires_grad = self.requires_grad
             outs.append(t)
         return tuple(outs) if multiple else outs[0]
 
@@ -266,7 +275,10 @@ def backward(y: Tensor, dy=None):
 def iter_backward(y: Tensor, dy=None):
     """Generator form (the reference's `backward` is consumed as
     `for p, g in autograd.backward(loss)`)."""
-    if y.creator is None:
+    if y.creator is None or not y.requires_grad:
+        # untracked root, or a tracked-but-non-differentiable output
+        # (comparisons/OneHot record graph topology for export but
+        # refuse gradient flow)
         return
     if dy is None:
         dy_arr = _ones_like(y.data)

@@ -304,8 +304,17 @@ def test_comparisons_no_grad():
     a = param(X)
     b = param(2 * X)
     y = autograd.Less()(a, b)
-    assert y.creator is None  # non-differentiable: detached
+    # graph TOPOLOGY is recorded (sonnx export needs the creator link
+    # or it would bake the comparison's output as a constant), but
+    # gradient flow stays off: requires_grad false, backward refuses.
+    assert y.creator is not None
+    assert not y.requires_grad
     np.testing.assert_array_equal(y.to_numpy(), (X < 2 * X).astype(np.float32))
+    # and a consumer of the comparison output still backprops to its
+    # OTHER (differentiable) inputs without touching the comparison
+    z = autograd.mul(y, param(np.ones_like(X)))
+    grads = autograd.gradients(autograd.reduce_sum(z))
+    assert len(grads) == 1  # only the ones-param receives a grad
 
 
 def test_conv2d_forward_and_grad():
