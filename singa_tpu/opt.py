@@ -15,6 +15,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -135,8 +136,6 @@ class Optimizer:
         if g.dtype != param.data.dtype:
             # fp16/bf16 grads (half allreduce path) apply to fp32 master.
             g = g.astype(param.data.dtype)
-        import jax
-
         if isinstance(param.data, jax.core.Tracer) or isinstance(
                 g, jax.core.Tracer):
             # graph mode: the whole step is one traced program; the
@@ -182,12 +181,10 @@ class Optimizer:
     def _fused_eager_update_all(self, pairs, clip=False) -> None:
         """Whole-step eager optimizer fusion: every (param, grad)
         pair's update — slot math included — runs as ONE jitted
-        executable.  Same shim-trace technique as
-        `_fused_eager_update` (the subclass's `apply` stays the single
-        source of the update math), but over the full param list, so
-        an N-param model pays one dispatch instead of N."""
-        import jax
-
+        executable, traced from the subclass's own `apply` by threading
+        the state dict and step counter through as traced arguments —
+        the update math stays in exactly one place, and an N-param
+        model pays one dispatch instead of N."""
         prepared = []
         for p, g in pairs:
             g = g.data if isinstance(g, Tensor) else g
@@ -196,15 +193,15 @@ class Optimizer:
             prepared.append((p, g))
         names_list = [tuple(sorted(self.states.get(id(p), {})))
                       for p, _ in prepared]
+        values = [p.data for p, _ in prepared]
+        gs = [g for _, g in prepared]
+        slots = [[self.states[id(p)][n] for n in nm] if nm else []
+                 for (p, _), nm in zip(prepared, names_list)]
         # Donation requires every donated buffer to be unique AND not
         # also appear as a non-donated argument; tied weights that
         # alias one array across Tensor objects would otherwise crash
         # with a duplicate-donation error.
-        flat_args = ([p.data for p, _ in prepared]
-                     + [g for _, g in prepared]
-                     + [self.states[id(p)][n]
-                        for (p, _), nm in zip(prepared, names_list)
-                        for n in nm])
+        flat_args = values + gs + [a for sl in slots for a in sl]
         donate = len({id(a) for a in flat_args}) == len(flat_args)
         pids_key = tuple(id(p) for p, _ in prepared)
         do_clip = clip and self.clip_norm is not None
@@ -224,7 +221,6 @@ class Optimizer:
                 del cache[k]
             while len(cache) >= 32:
                 del cache[next(iter(cache))]
-        if ent is None:
             params = [p for p, _ in prepared]
             pids = [id(p) for p in params]
             meta = {}
@@ -271,10 +267,6 @@ class Optimizer:
                            else ()), meta, pids_key)
             cache[key] = ent
         fn, meta, _ = ent
-        values = [p.data for p, _ in prepared]
-        gs = [g for _, g in prepared]
-        slots = [[self.states[id(p)][n] for n in nm] if nm else []
-                 for (p, _), nm in zip(prepared, names_list)]
         new_values, new_slots = fn(values, gs, self.step_counter, slots)
         for (p, _), onm, nv, ns in zip(prepared, meta["names"],
                                        new_values, new_slots):
@@ -297,8 +289,6 @@ class Optimizer:
         apply updates per (param, grad) pair in emission order (with
         optional global-norm clipping, which buffers the pairs first
         but preserves the deterministic update order)."""
-        import jax
-
         pairs = []
         eager = True
         for p, g in autograd.iter_backward(loss):
