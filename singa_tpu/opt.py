@@ -219,7 +219,14 @@ class Optimizer:
             for k in [k for k, (_, _, pk_) in cache.items()
                       if pk_ == pids_key and k != key]:
                 del cache[k]
-            while len(cache) >= 32:
+            # The same-pids eviction above already bounds the cache to
+            # ONE entry per param set, so steady state is 1 entry for
+            # batched updates or N for DistOpt's per-param streaming —
+            # the global cap only guards optimizer-outlives-model
+            # leaks.  It must exceed any realistic param count, or a
+            # large model streamed per-param would evict its own
+            # entries every step and retrace everything (FIFO thrash).
+            while len(cache) >= 4096:
                 del cache[next(iter(cache))]
             params = [p for p, _ in prepared]
             pids = [id(p) for p in params]

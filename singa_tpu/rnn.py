@@ -45,7 +45,14 @@ class _RNNBase(Layer):
             bidirectional=self.bidirectional,
         )
         w = Tensor((self.handle.weights_size,), device=x.device)
-        w.data = self.handle.init_weights(x.device.next_key())
+        # cuDNN-style default init U(-1/sqrt(H), 1/sqrt(H)), via the
+        # tensor fill path (host-computed from the device key) so the
+        # zero-compile eval_shape init pass stays concrete —
+        # `handle.init_weights` draws with jax.random directly, which
+        # inside a trace would leak a tracer into the param and force
+        # the eager init fallback.
+        k = 1.0 / (self.hidden_size ** 0.5)
+        w.uniform(-k, k)
         self.register_param("W", w)
 
     def _zero_state(self, batch: int, like: Tensor) -> Tensor:
