@@ -196,3 +196,32 @@ def test_cross_entropy_helpers():
 def test_scalar_item():
     t = tensor.from_numpy(np.array(3.5, np.float32))
     assert float(t) == 3.5
+
+
+def test_fills_stay_concrete_inside_a_trace():
+    """The fill methods compute host-side numpy values under
+    ensure_compile_time_eval — the property the zero-compile
+    eval_shape init pass depends on: creating + filling a tensor
+    INSIDE a trace must produce a concrete array, not a tracer."""
+    import jax
+
+    captured = {}
+
+    def f(x):
+        t = Tensor((4, 3))
+        t.gaussian(0.0, 1.0)
+        u = Tensor((5,))
+        u.set_value(2.5)
+        captured["g"] = t.data
+        captured["c"] = u.data
+        return x
+
+    jax.eval_shape(f, jax.ShapeDtypeStruct((2,), np.float32))
+    assert not isinstance(captured["g"], jax.core.Tracer)
+    assert not isinstance(captured["c"], jax.core.Tracer)
+    np.testing.assert_array_equal(np.asarray(captured["c"]), 2.5)
+    # and the RNG key advanced concretely (next fill differs)
+    t2 = Tensor((4, 3))
+    t2.gaussian(0.0, 1.0)
+    assert not np.array_equal(np.asarray(captured["g"]),
+                              t2.to_numpy())
