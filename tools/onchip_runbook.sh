@@ -1,0 +1,44 @@
+#!/bin/bash
+# On-chip evidence runbook (VERDICT r4 next #1/#2/#3): the full
+# measurement sequence to run whenever the TPU tunnel answers.
+# Each step is independently killable; artifacts flush as they land.
+#
+#   bash tools/onchip_runbook.sh [quick]
+#
+# quick = probe + parity + headline bf16 only (~8 min).
+set -u
+cd "$(dirname "$0")/.."
+
+run() { echo "== $* =="; timeout "${T:-600}" "$@"; echo "   rc=$?"; }
+
+# 1) probe (fail fast if the tunnel is down)
+T=180 run python bench.py --stage probe || exit 1
+
+# 2) the acceptance gate: CIFAR-10 TPU loss parity (fast --tpu-only
+#    path; writes PARITY_cifar10.json)
+T=600 run python bench.py --stage parity --steps 30 --deadline 540
+
+# 3) headline throughput: bf16 AMP bs128 (updates BENCH_partial +
+#    BENCH_LASTGOOD via the parent flow; standalone stage here)
+T=600 run python bench.py --stage resnet --batch 128 --steps 20 \
+    --deadline 480 --amp
+
+[ "${1:-}" = quick ] && exit 0
+
+# 4) roofline levers: bs256 and activation remat (BASELINE.md table)
+T=700 run python bench.py --stage resnet --batch 256 --steps 20 \
+    --deadline 600 --amp
+T=700 run python bench.py --stage resnet --batch 128 --steps 20 \
+    --deadline 600 --amp --remat
+
+# 5) lm + decode tokens/sec
+T=600 run python bench.py --stage lm --batch 8 --seq 1024 --steps 16 \
+    --deadline 480
+T=600 run python bench.py --stage decode --batch 8 --deadline 480
+
+# 6) Pallas: refresh PALLAS_BENCH.md, then sweep the tiling knobs
+T=900 run python benchmarks/pallas_micro.py
+T=1800 run python benchmarks/pallas_tune.py
+
+echo "== done: fold results into BASELINE.md / PALLAS_BENCH.md / "
+echo "   BENCH_LASTGOOD.json and commit =="
