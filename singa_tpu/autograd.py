@@ -1051,10 +1051,25 @@ def _smce_int_fwd(x, ti):
 
 
 @jax.jit
+def _smce_soft_fwd(x, t):
+    """Fused eager softmax-CE forward for probability-distribution
+    targets — same math as the inline traced path."""
+    n = x.shape[0] if x.ndim > 1 else 1
+    logp = jax.nn.log_softmax(x, axis=-1)
+    return -jnp.sum(t * logp) / n, jnp.exp(logp)
+
+
+@jax.jit
 def _smce_bwd(dy, p, onehot, valid):
     n = p.shape[0] if p.ndim > 1 else 1
     dx = dy * (p - onehot) / n
     return jnp.where(valid, dx, 0.0)
+
+
+@jax.jit
+def _smce_soft_bwd(dy, p, onehot):
+    n = p.shape[0] if p.ndim > 1 else 1
+    return dy * (p - onehot) / n
 
 
 class SoftMaxCrossEntropy(Operator):
@@ -1105,6 +1120,9 @@ class SoftMaxCrossEntropy(Operator):
             self._valid = ((ti >= 0) & (ti < x.shape[-1]))[..., None]
             t = jax.nn.one_hot(ti, x.shape[-1], dtype=x.dtype)
         self._onehot = t
+        if not traced and not isinstance(t, jax.core.Tracer):
+            loss, self._p = _smce_soft_fwd(x, t)
+            return loss
         logp = jax.nn.log_softmax(x, axis=-1)
         self._p = jnp.exp(logp)
         return -jnp.sum(t * logp) / n
@@ -1117,10 +1135,13 @@ class SoftMaxCrossEntropy(Operator):
             g = jnp.full((x.shape[0],), dy / self._n, jnp.float32)
             dx, _ = _pk._softmax_xent_bwd((x, lab), g)
             return dx.astype(self._in_dtype)
-        if self._valid is not None and not isinstance(
-                dy, jax.core.Tracer):
-            dx = _smce_bwd(jnp.asarray(dy, jnp.float32), self._p,
-                           self._onehot, self._valid)
+        if not isinstance(dy, jax.core.Tracer) and not isinstance(
+                self._p, jax.core.Tracer):
+            dyf = jnp.asarray(dy, jnp.float32)
+            if self._valid is not None:
+                dx = _smce_bwd(dyf, self._p, self._onehot, self._valid)
+            else:
+                dx = _smce_soft_bwd(dyf, self._p, self._onehot)
             return dx.astype(self._in_dtype)
         dx = dy * (self._p - self._onehot) / self._n
         if self._valid is not None:

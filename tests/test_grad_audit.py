@@ -142,6 +142,7 @@ _SCATTER_UPD = _rand(2, 3)
 _MSE_T = _rand(3, 4)
 _BCE_T = _RS.rand(3, 4).round().astype(np.float64)
 _BCE_X = _RS.rand(3, 4) * 0.8 + 0.1
+_sm = np.exp(_RS.randn(3, 5)); _SMCE_SOFT_T = _sm / _sm.sum(-1, keepdims=True)
 
 DIFF_CASES = {
     # --- unary activations / elementwise ---------------------------------
@@ -245,6 +246,10 @@ DIFF_CASES = {
         [_rand(3, 5)],
         # forward pins fp32 (bf16-safe logsumexp); central diff noise
         # floor is f32 machine eps, so widen eps + tolerance
+        {"eps": 1e-3, "rtol": 5e-3, "atol": 1e-3}),
+    "SoftMaxCrossEntropySoft": (
+        lambda: A.SoftMaxCrossEntropy(_SMCE_SOFT_T),
+        [_rand(3, 5)],
         {"eps": 1e-3, "rtol": 5e-3, "atol": 1e-3}),
     "SoftMaxCrossEntropyPadded": (
         lambda: A.SoftMaxCrossEntropy(np.array([1, -1, 3])),

@@ -9,7 +9,13 @@
 set -u
 cd "$(dirname "$0")/.."
 
-run() { echo "== $* =="; timeout "${T:-600}" "$@"; echo "   rc=$?"; }
+run() {
+    echo "== $* =="
+    timeout "${T:-600}" "$@"
+    local rc=$?
+    echo "   rc=$rc"
+    return $rc
+}
 
 # 1) probe (fail fast if the tunnel is down)
 T=180 run python bench.py --stage probe || exit 1
