@@ -137,10 +137,13 @@ class Operator:
         # consumed when traced into a program.
         traced = any(isinstance(t.data, jax.core.Tracer) for t in xs)
         timing = dev is not None and dev._verbosity > 0
-        with (dev.TimeOp(type(self).__name__) if timing
-              else contextlib.nullcontext()), \
-             (jax.named_scope(type(self).__name__) if traced
-              else contextlib.nullcontext()):
+        if timing or traced:
+            with (dev.TimeOp(type(self).__name__) if timing
+                  else contextlib.nullcontext()), \
+                 (jax.named_scope(type(self).__name__) if traced
+                  else contextlib.nullcontext()):
+                ys = self.forward(*[t.data for t in xs])
+        else:  # hot eager path: no context-manager machinery
             ys = self.forward(*[t.data for t in xs])
         multiple = isinstance(ys, tuple)
         ys = ys if multiple else (ys,)
@@ -216,9 +219,21 @@ class Operator:
 _EXEC_CACHE: dict = {}
 
 
+_DTYPE_STR: dict = {}
+
+
+def _dtype_str(d):
+    """Memoized str(dtype): numpy's dtype __str__ is ~5 µs and the
+    eager path builds a policy key per op dispatch."""
+    s = _DTYPE_STR.get(d)
+    if s is None:
+        s = _DTYPE_STR[d] = str(d)
+    return s
+
+
 def _policy_key():
     return (tensor_mod.get_matmul_precision(),
-            str(tensor_mod.get_compute_dtype()))
+            _dtype_str(tensor_mod.get_compute_dtype()))
 
 
 def _op_executables(cls, key, op):
