@@ -211,7 +211,6 @@ def stage_resnet(batch, steps, deadline_s, amp=False, remat=False):
     steps is the honest steady-state throughput: it is how the device
     runs in a real input pipeline.
     """
-    import numpy as np
 
     _setup_jax()
     sys.path.insert(0, os.path.join(HERE, "examples", "cnn"))
@@ -238,11 +237,22 @@ def stage_resnet(batch, steps, deadline_s, amp=False, remat=False):
 
     m = resnet.create_model(depth=50)
     m.set_optimizer(opt.SGD(lr=0.1, momentum=0.9))
-    rs = np.random.RandomState(0)
-    x_np = rs.randn(batch, 3, 224, 224).astype(np.float32)
-    y_np = rs.randint(0, 1000, batch).astype(np.int32)
-    tx = tensor.from_numpy(x_np, device=dev)
-    ty = tensor.from_numpy(y_np, device=dev)
+    # Synthetic inputs are generated ON the device: pushing the
+    # host-numpy batch through the tunnel cost ~10 s at bs256 (154 MB)
+    # of a window that historically lasts minutes.  Only the 8-byte
+    # PRNG key crosses the wire.
+    import jax.numpy as jnp
+    # Seed 1, not 0: the device RNG chain (SetRandSeed(0) -> param
+    # init keys) is split from PRNGKey(0); inputs must come from an
+    # independent stream.
+    kx, ky = jax.random.split(jax.random.PRNGKey(1))
+    x_dev = jax.jit(lambda k: jax.random.normal(
+        k, (batch, 3, 224, 224), jnp.float32))(kx)
+    y_dev = jax.jit(lambda k: jax.random.randint(
+        k, (batch,), 0, 1000, jnp.int32))(ky)
+    jax.block_until_ready([x_dev, y_dev])
+    tx = tensor.from_raw(x_dev, dev)
+    ty = tensor.from_raw(y_dev, dev)
     log(f"inputs on device (bs={batch}, amp={amp})")
 
     t0 = time.time()
