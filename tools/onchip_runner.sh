@@ -27,8 +27,26 @@ STATE=/tmp/onchip_stages
 [ "${1:-}" = reset ] && rm -rf "$STATE"
 mkdir -p "$STATE" onchip_logs
 LOG="$STATE/runner.log"
+# Hard lifetime: the driver's own bench.py run at round end must find
+# the tunnel free — a leftover runner holding a PJRT client would wedge
+# the driver's probe and zero the round. Default 6h, env-overridable.
+DEADLINE=$(( $(date +%s) + ${RUNNER_LIFETIME_S:-21600} ))
 
 say() { echo "$(date -u +%H:%M:%S) $*" | tee -a "$LOG"; }
+
+driver_active() {
+    # The driver's orchestrating invocation is a python interpreter
+    # running bench.py (possibly path-qualified, possibly with flags
+    # like --smoke) WITHOUT --stage (stages are its children — and
+    # ours). Token-based match: substring matching false-positived on
+    # a process whose argv merely MENTIONS bench.py (the build agent's
+    # own prompt text), so require argv[0] to BE python and argv[1] to
+    # BE bench.py.
+    pgrep -af "bench\.py" 2>/dev/null | awk '
+        $2 ~ /(^|\/)python[0-9.]*$/ && $3 ~ /(^|\/)bench\.py$/ \
+            && $0 !~ /--stage/ { found = 1 }
+        END { exit !found }'
+}
 
 probe() {
     timeout 90 python -c "
@@ -73,6 +91,12 @@ stage_ok() {
 }
 
 while true; do
+    [ "$(date +%s)" -ge "$DEADLINE" ] && { say "lifetime deadline reached — exiting to free the tunnel"; break; }
+    if driver_active; then
+        say "driver bench.py detected — yielding the tunnel"
+        sleep 180
+        continue
+    fi
     next=""
     for s in "${STAGES[@]}"; do
         name="${s%%|*}"
@@ -88,6 +112,12 @@ while true; do
 
     name="${next%%|*}"
     rest="${next#*|}"; tmo="${rest%%|*}"; cmd="${rest#*|}"
+    # Never let a stage outlive the lifetime deadline: a long stage
+    # started seconds before it would hold the tunnel for up to 40
+    # minutes past the point the driver needs it free.
+    rem=$(( DEADLINE - $(date +%s) ))
+    [ "$rem" -lt 120 ] && { say "lifetime nearly up — not starting $name"; break; }
+    [ "$tmo" -gt "$rem" ] && tmo="$rem"
     say "tunnel UP -> running $name (timeout ${tmo}s)"
     timeout "$tmo" $cmd >"$STATE/$name.out" 2>&1   # truncate per attempt
     rc=$?
