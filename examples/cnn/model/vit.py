@@ -1,0 +1,75 @@
+"""Vision Transformer classifier for the CNN example zoo.
+
+No reference equivalent (SINGA's zoo is conv-only; its transformers
+arrive via ONNX import) — this is a "more model families" extension
+built entirely from the native layer catalogue: `layer.Conv2d` as the
+patch embedder (kernel = stride = patch, the standard trick — one MXU
+GEMM per image), the non-causal `models.transformer.TransformerBlock`
+stack for the encoder, and global average pooling over patch tokens
+instead of a class token (the ViT paper's GAP variant; avoids a
+broadcast-concat and pools on-device).
+
+TPU notes: all sequence work is [B, N, D] batched GEMMs (MXU-shaped);
+`patch` must divide the input size (static shapes under jit); with a
+mesh the blocks pick up the same TP/SP sharding rules as the LM.
+"""
+import numpy as np
+
+from singa_tpu import autograd, layer, model, tensor
+from singa_tpu.models.transformer import TransformerBlock
+
+
+class VisionTransformer(model.Model):
+    """[B, C, H, W] float images → [B, num_classes] logits."""
+
+    def __init__(self, num_classes: int = 10, img_size: int = 32,
+                 patch: int = 4, d_model: int = 192, num_heads: int = 3,
+                 num_layers: int = 6, d_ff=None, dropout: float = 0.0,
+                 norm: str = "layer", mesh=None):
+        super().__init__()
+        if img_size % patch:
+            raise ValueError(f"img_size {img_size} not divisible by "
+                             f"patch {patch}")
+        self.num_classes = num_classes
+        self.patch = patch
+        self.n_patches = (img_size // patch) ** 2
+        d_ff = d_ff or 4 * d_model
+        self.patch_proj = layer.Conv2d(d_model, patch, stride=patch,
+                                       padding=0, bias=True)
+        self.pos_embed = layer.Embedding(self.n_patches, d_model)
+        self.blocks = layer.Sequential(*[
+            TransformerBlock(num_heads, d_ff, causal=False, mesh=mesh,
+                             dropout=dropout, norm=norm)
+            for _ in range(num_layers)
+        ])
+        self.ln_f = (layer.RMSNorm() if norm == "rms"
+                     else layer.LayerNorm())
+        self.head = layer.Linear(num_classes)
+
+    def forward(self, x):
+        h = self.patch_proj(x)                    # [B, D, H/p, W/p]
+        B, D, Hp, Wp = h.shape
+        h = autograd.reshape(h, (B, D, Hp * Wp))
+        h = autograd.transpose(h, (0, 2, 1))      # [B, N, D] tokens
+        pos = tensor.from_numpy(np.arange(Hp * Wp, dtype=np.int32))
+        if x.device is not None:
+            pos = pos.to_device(x.device)
+        h = autograd.add(h, self.pos_embed(pos))
+        h = self.blocks(h)
+        h = self.ln_f(h)
+        h = autograd.reduce_mean(h, axes=[1])     # GAP over tokens
+        return self.head(h)
+
+    def train_one_batch(self, x, y):
+        out = self.forward(x)
+        loss = autograd.softmax_cross_entropy(out, y)
+        self._optimizer.backward_and_update(loss)
+        return out, loss
+
+
+def create_model(num_classes=10, num_channels=None, img_size=32,
+                 patch=4, **kwargs):
+    """Zoo-uniform factory (num_channels is shape-inferred lazily and
+    accepted only for CLI symmetry with the conv models)."""
+    return VisionTransformer(num_classes=num_classes, img_size=img_size,
+                             patch=patch, **kwargs)

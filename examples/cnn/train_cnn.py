@@ -55,6 +55,9 @@ def run(args):
         kwargs = {"num_classes": num_classes, "depth": args.depth or 16}
     elif args.model == "mobilenet":
         kwargs = {"num_classes": num_classes}
+    elif args.model == "vit":
+        kwargs = {"num_classes": num_classes,
+                  "img_size": tx_np.shape[-1]}
     m = create_model(args.model, **kwargs)
 
     if args.precision == "bf16":
@@ -118,7 +121,8 @@ def run(args):
 if __name__ == "__main__":
     p = argparse.ArgumentParser()
     p.add_argument("model", choices=["cnn", "alexnet", "resnet",
-                                     "xceptionnet", "vgg", "mobilenet"])
+                                     "xceptionnet", "vgg", "mobilenet",
+                                     "vit"])
     p.add_argument("data", choices=["mnist", "cifar10", "cifar100"])
     p.add_argument("--data-dir", default=None)
     p.add_argument("--epochs", type=int, default=10)

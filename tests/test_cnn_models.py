@@ -118,3 +118,56 @@ def test_data_loaders_synthetic():
     tx, ty, vx, vy = cifar10.load(None)
     assert tx.shape[1:] == (3, 32, 32)
     assert int(ty.max()) <= 9
+
+
+def test_vit_forward_shapes_and_train():
+    import vit
+
+    from singa_tpu import device
+
+    device.get_default_device().SetRandSeed(13)
+    m = vit.create_model(num_classes=6, img_size=32, patch=8,
+                         d_model=64, num_heads=2, num_layers=2)
+    m.set_optimizer(opt.SGD(lr=0.05, momentum=0.9))
+    rs = np.random.RandomState(6)
+    x = tensor.from_numpy(rs.randn(4, 3, 32, 32).astype(np.float32))
+    y = tensor.from_numpy(rs.randint(0, 6, 4).astype(np.int32))
+    m.compile([x], is_train=True, use_graph=False)
+    losses = []
+    for _ in range(6):
+        out, loss = m(x, y)
+        losses.append(float(loss.to_numpy()))
+    assert out.shape == (4, 6)
+    assert losses[-1] < losses[0]
+
+
+def test_vit_eager_graph_parity():
+    import vit
+
+    from singa_tpu import device
+
+    curves = []
+    for use_graph in (False, True):
+        device.get_default_device().SetRandSeed(21)
+        m = vit.create_model(num_classes=4, img_size=16, patch=4,
+                             d_model=32, num_heads=2, num_layers=1)
+        m.set_optimizer(opt.SGD(lr=0.02, momentum=0.9))
+        rs = np.random.RandomState(9)
+        x = tensor.from_numpy(rs.randn(2, 3, 16, 16).astype(np.float32))
+        y = tensor.from_numpy(rs.randint(0, 4, 2).astype(np.int32))
+        m.compile([x], is_train=True, use_graph=use_graph)
+        losses = []
+        for _ in range(4):
+            _, loss = m(x, y)
+            losses.append(float(loss.to_numpy()))
+        curves.append(losses)
+    eager, graph = curves
+    for a, b in zip(eager, graph):
+        assert abs(a - b) <= 1e-5 * max(1.0, abs(b))
+
+
+def test_vit_rejects_indivisible_patch():
+    import vit
+
+    with pytest.raises(ValueError):
+        vit.create_model(img_size=30, patch=4)
