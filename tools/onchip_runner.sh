@@ -35,17 +35,31 @@ DEADLINE=$(( $(date +%s) + ${RUNNER_LIFETIME_S:-21600} ))
 say() { echo "$(date -u +%H:%M:%S) $*" | tee -a "$LOG"; }
 
 driver_active() {
-    # The driver's orchestrating invocation is a python interpreter
-    # running bench.py (possibly path-qualified, possibly with flags
-    # like --smoke) WITHOUT --stage (stages are its children — and
-    # ours). Token-based match: substring matching false-positived on
-    # a process whose argv merely MENTIONS bench.py (the build agent's
-    # own prompt text), so require argv[0] to BE python and argv[1] to
-    # BE bench.py.
-    pgrep -af "bench\.py" 2>/dev/null | awk '
-        $2 ~ /(^|\/)python[0-9.]*$/ && $3 ~ /(^|\/)bench\.py$/ \
-            && $0 !~ /--stage/ { found = 1 }
-        END { exit !found }'
+    # The driver's orchestrating invocation runs bench.py WITHOUT
+    # --stage (stages are its children — and ours), possibly wrapped
+    # (`timeout N python bench.py`, `python -u bench.py`, path-
+    # qualified).  Parse /proc/<pid>/cmdline at NUL boundaries: an
+    # argv ELEMENT must be bench.py — substring/field matching
+    # false-positived on a process whose argv merely mentions
+    # bench.py inside a larger string (the build agent's prompt).
+    local pid a0 el saw_bench saw_stage
+    for pid in $(pgrep -f "bench\.py" 2>/dev/null); do
+        [ -r "/proc/$pid/cmdline" ] || continue
+        local argv=()
+        mapfile -d '' -t argv < "/proc/$pid/cmdline" 2>/dev/null || continue
+        [ "${#argv[@]}" -gt 0 ] || continue
+        a0="${argv[0]##*/}"
+        case "$a0" in python*|timeout) ;; *) continue ;; esac
+        saw_bench=0; saw_stage=0
+        for el in "${argv[@]:1}"; do
+            case "${el##*/}" in
+                bench.py) saw_bench=1 ;;
+                --stage)  saw_stage=1 ;;
+            esac
+        done
+        [ "$saw_bench" = 1 ] && [ "$saw_stage" = 0 ] && return 0
+    done
+    return 1
 }
 
 probe() {
@@ -114,10 +128,12 @@ while true; do
     rest="${next#*|}"; tmo="${rest%%|*}"; cmd="${rest#*|}"
     # Never let a stage outlive the lifetime deadline: a long stage
     # started seconds before it would hold the tunnel for up to 40
-    # minutes past the point the driver needs it free.
+    # minutes past the point the driver needs it free.  A stage whose
+    # FULL timeout doesn't fit is not started at all — clamping it
+    # would record the inevitable rc=124 kill as a counted on-chip
+    # failure and could permanently .skip a healthy stage.
     rem=$(( DEADLINE - $(date +%s) ))
-    [ "$rem" -lt 120 ] && { say "lifetime nearly up — not starting $name"; break; }
-    [ "$tmo" -gt "$rem" ] && tmo="$rem"
+    [ "$tmo" -gt "$rem" ] && { say "lifetime too short for $name (${tmo}s > ${rem}s) — exiting"; break; }
     say "tunnel UP -> running $name (timeout ${tmo}s)"
     timeout "$tmo" $cmd >"$STATE/$name.out" 2>&1   # truncate per attempt
     rc=$?
