@@ -195,6 +195,12 @@ class Operator:
                 return fwd(*xs)
             fn = (jax.checkpoint(self.fn)
                   if traced and _remat_this(self) else self.fn)
+            # Invalidate any residuals a PRIOR eager forward left on
+            # this instance: backward() prefers _cached_bwd, and stale
+            # _bwd_xs would bake that step's concrete inputs into a
+            # trace replaying this op (the recorded-backward path
+            # re-drives instances under tracers).
+            self._cached_bwd = self._bwd_xs = None
             ys, self._vjp = jax.vjp(fn, *xs)
             return ys
         return self.fn(*xs)
@@ -300,6 +306,13 @@ def iter_backward(y: Tensor, dy=None):
     else:
         dy_arr = dy.data if isinstance(dy, Tensor) else jnp.asarray(dy)
 
+    # Recorded-backward fast path: the whole DAG's backward as ONE
+    # jitted executable (None = structurally unsafe -> per-op walk).
+    fast = _dag_backward(y, dy_arr)
+    if fast is not None:
+        yield from fast
+        return
+
     # Pass 1: count downstream consumer edges for every op in the DAG.
     consumers: Dict[Operator, int] = {}
     seen = set()
@@ -372,6 +385,259 @@ def iter_backward(y: Tensor, dy=None):
 def gradients(y: Tensor, dy=None) -> Dict[Tensor, Tensor]:
     """Reference: `autograd.gradients` — param tensor → grad map."""
     return {p: g for p, g in iter_backward(y, dy)}
+
+
+# ===========================================================================
+# Recorded-backward executable (the TPU-native completion of the
+# reference's record-and-replay graph: `Device::EnableGraph` buffers
+# eager ops and replays them scheduled; here the eager forward IS the
+# recording, and the backward replays as one fused XLA program keyed
+# on DAG structure).  SURVEY §7 hard-part #4, VERDICT r4 next #7.
+#
+# Safety model — an op may join the recorded program only if its
+# gradient math is a pure function of (its inputs, declared capture
+# arrays, scalar config):
+#   * vjp-derived ops (no forward/backward override) qualify
+#     automatically unless they hold undeclared array state;
+#   * hand-written ops must appear in _DAG_SPECS, declaring which
+#     attributes are per-step data ("captures" — threaded as traced
+#     arguments, never baked as constants);
+#   * anything else — Dropout (device RNG), _BatchNorm2d (mutates the
+#     layer-shared handle's running stats), Attention, Cast — falls
+#     back to the per-op walk. Wrong-exclusion costs speed, never
+#     correctness.
+# ===========================================================================
+
+_DAG_BWD_CACHE: dict = {}
+_DAG_BWD_ENABLED = True
+# Operator machinery attrs: never part of an op's config, never
+# scanned as array state.
+_DAG_MACHINERY = frozenset((
+    "inputs", "device", "name", "num_outputs", "requires_grad",
+    "_out_shapes", "_vjp", "_cached_bwd", "_bwd_xs",
+))
+# Hand-written ops whose replay is sound; "captures" lists per-step
+# array attrs. All OTHER array attrs on these classes are
+# forward-derived (recomputed during replay) and deliberately ignored.
+_DAG_SPECS: dict = {}
+
+
+def set_dag_backward(flag: bool) -> None:
+    """Toggle the recorded-backward executable (default on). The
+    per-op walk remains the semantics-defining reference path."""
+    global _DAG_BWD_ENABLED
+    _DAG_BWD_ENABLED = bool(flag)
+
+
+def _dag_op_entry(op):
+    """(config_key, capture_attrs) for a DAG-safe op, or None."""
+    cls = type(op)
+    spec = _DAG_SPECS.get(cls)
+    if spec is not None:
+        caps = spec["captures"]
+        key = spec["config"](op) if "config" in spec else ()
+        if key is None:  # spec'd class, but THIS configuration is unsafe
+            return None
+        return key + _policy_key(), caps
+    if cls.forward is not Operator.forward or (
+            cls.backward is not Operator.backward):
+        return None  # hand-written without a spec
+    key = op.cache_key()
+    if key is None:
+        # generic scalar-attr config; any undeclared array state
+        # (per-step data that would bake into the trace) disqualifies
+        items = []
+        for k in sorted(vars(op)):
+            if k in _DAG_MACHINERY:
+                continue
+            v = vars(op)[k]
+            if isinstance(v, (int, float, bool, str, type(None))):
+                items.append((k, v))
+            elif isinstance(v, tuple) and all(
+                    isinstance(e, (int, float, bool, str)) for e in v):
+                items.append((k, v))
+            elif isinstance(v, (jnp.ndarray, np.ndarray)) or isinstance(
+                    v, Tensor):
+                return None
+            else:
+                return None  # opaque config: can't prove purity
+        return (tuple(items),) + _policy_key(), ()
+    return key + _policy_key() if isinstance(key, tuple) else (
+        (key,) + _policy_key()), ()
+
+
+def _dag_signature(y, dy_arr):
+    """Structural walk. Returns (key, ops_topo, leaves, cap_refs) or
+    None when any reachable op is unsafe. `leaves` are the non-output
+    input Tensors in deterministic discovery order; `cap_refs` are
+    (op_position, attr) pairs for capture arrays."""
+    ops = []           # deterministic post-order: producers first
+    pos = {}           # id(op) -> position
+    visited = set()
+    stack = [(y.creator, False)]
+    while stack:
+        op, processed = stack.pop()
+        if processed:
+            if id(op) not in pos:
+                pos[id(op)] = len(ops)
+                ops.append(op)
+            continue
+        if id(op) in visited:
+            continue
+        visited.add(id(op))
+        stack.append((op, True))
+        for x in op.inputs:
+            src = x.creator
+            if src is not None and x.requires_grad and (
+                    id(src) not in visited):
+                stack.append((src, False))
+    leaves = []
+    leaf_pos = {}
+    key_parts = []
+    cap_refs = []
+    for i, op in enumerate(ops):
+        ent = _dag_op_entry(op)
+        if ent is None:
+            return None
+        cfg, caps = ent
+        for attr in caps:
+            cap_refs.append((i, attr))
+        refs = []
+        for x in op.inputs:
+            src = x.creator
+            if src is not None and x.requires_grad and id(src) in pos:
+                if x.stores_grad:
+                    # intermediate grad requested: the replay's
+                    # re-created intermediates wouldn't carry the
+                    # flag, silently dropping the pair — walk instead
+                    return None
+                refs.append(("o", pos[id(src)],
+                             getattr(x, "creator_index", 0)))
+            else:
+                if id(x) not in leaf_pos:
+                    leaf_pos[id(x)] = len(leaves)
+                    leaves.append(x)
+                refs.append(("l", leaf_pos[id(x)]))
+        key_parts.append((type(op).__name__, cfg, tuple(refs),
+                          op.num_outputs))
+    leaf_sig = tuple(
+        (x.data.shape, _dtype_str(x.data.dtype), bool(x.requires_grad),
+         bool(x.stores_grad)) for x in leaves)
+    cap_sig = tuple(
+        (getattr(ops[i], a).shape, _dtype_str(getattr(ops[i], a).dtype))
+        for i, a in cap_refs)
+    rem = _remat if isinstance(_remat, bool) else tuple(sorted(_remat))
+    key = (tuple(key_parts), leaf_sig, cap_sig,
+           pos[id(y.creator)], getattr(y, "creator_index", 0),
+           dy_arr.shape, _dtype_str(dy_arr.dtype), rem)
+    return key, ops, leaves, cap_refs
+
+
+def _dag_backward(y, dy_arr):
+    """One-dispatch backward for a recorded DAG; None = fall back.
+
+    Live op instances are never mutated: a later second backward on
+    the same loss, or a sonnx export of the already-backpropagated
+    graph, behaves exactly as under the per-op walk. The jit closure
+    reads the recorded instances through a holder that is emptied
+    once tracing completes, so no step's activations/labels stay
+    pinned for the cache's lifetime (same-key calls never retrace —
+    the key carries every aval; if jax ever does retrace after an
+    internal eviction, the hit path catches the failure, drops the
+    entry, and falls back to the walk)."""
+    if not _DAG_BWD_ENABLED or isinstance(y.data, jax.core.Tracer):
+        return None
+    sig = _dag_signature(y, dy_arr)
+    if sig is None:
+        return None
+    key, ops, leaves, cap_refs = sig
+    ent = _DAG_BWD_CACHE.get(key)
+    if ent is False:  # negative cache: traced once, failed — walk
+        return None
+    if ent is None:
+        meta = {}
+        leaf_flags = [(bool(x.requires_grad), bool(x.stores_grad))
+                      for x in leaves]
+        holder = {"ops": ops}
+        refs_per_op = [part[2] for part in key[0]]
+        root = (key[3], key[4])
+
+        def replay(leaf_arrays, cap_arrays, dy):
+            # Rebuild the graph with tracer-backed tensors by
+            # re-running each op's OWN __call__/backward machinery —
+            # emission order and math match the per-op walk by
+            # construction.
+            rops = holder["ops"]
+            saved = [dict(vars(op)) for op in rops]
+            try:
+                for (i, attr), arr in zip(cap_refs, cap_arrays):
+                    setattr(rops[i], attr, arr)
+                lt = []
+                for arr, (rg, sg) in zip(leaf_arrays, leaf_flags):
+                    t = tensor_mod.from_raw(arr, None)
+                    t.requires_grad = rg
+                    t.stores_grad = sg
+                    lt.append(t)
+                outs: dict = {}
+                for i, op in enumerate(rops):
+                    xs = []
+                    for ref in refs_per_op[i]:
+                        if ref[0] == "o":
+                            xs.append(outs[(ref[1], ref[2])])
+                        else:
+                            xs.append(lt[ref[1]])
+                    ys = op(*xs)
+                    ys = ys if isinstance(ys, tuple) else (ys,)
+                    for j, t in enumerate(ys):
+                        outs[(i, j)] = t
+                y_rep = outs[root]
+                dy_t = tensor_mod.from_raw(dy, None)
+                order = []
+                grads = []
+                lid = {id(t): k for k, t in enumerate(lt)}
+                for p, g in iter_backward(y_rep, dy_t):
+                    order.append(lid[id(p)])
+                    grads.append(g.data)
+                meta["order"] = order
+                return grads
+            finally:
+                for op, st in zip(rops, saved):
+                    op.__dict__.clear()
+                    op.__dict__.update(st)
+
+        fn = jax.jit(replay)
+        # Trace NOW (meta["order"] is a trace-time side channel); a
+        # failure is negatively cached so later steps skip straight
+        # to the walk instead of re-paying a doomed trace.
+        try:
+            caps = [getattr(ops[i], a) for i, a in cap_refs]
+            grads = fn([x.data for x in leaves], caps, dy_arr)
+        except Exception:
+            _DAG_BWD_CACHE[key] = False
+            return None
+        holder.clear()  # unpin the recorded instances
+        ent = (fn, meta["order"])
+        _DAG_BWD_CACHE[key] = ent
+        while len(_DAG_BWD_CACHE) > 256:
+            del _DAG_BWD_CACHE[next(iter(_DAG_BWD_CACHE))]
+        return _dag_pairs(leaves, ent[1], grads)
+    fn, order = ent
+    caps = [getattr(ops[i], a) for i, a in cap_refs]
+    try:
+        grads = fn([x.data for x in leaves], caps, dy_arr)
+    except Exception:
+        # e.g. an internal jax cache eviction forcing a retrace
+        # through the emptied holder — drop the entry, use the walk
+        del _DAG_BWD_CACHE[key]
+        return None
+    return _dag_pairs(leaves, order, grads)
+
+
+def _dag_pairs(leaves, order, grads):
+    # iter_backward already consolidates duplicate-param grads into
+    # one pair, so `order` holds unique leaf indices.
+    return [(leaves[li], tensor_mod.from_raw(g, leaves[li].device))
+            for li, g in zip(order, grads)]
 
 
 # ===========================================================================
@@ -1583,3 +1849,34 @@ _ConvTranspose2d.cache_key = lambda self: (
 _Pooling2d.cache_key = lambda self: (
     self.handle.kernel_size, self.handle.stride, self.handle.padding,
     self.handle.is_max, self.handle.count_include_pad)
+
+
+# ---------------------------------------------------------------------------
+# Recorded-backward specs for hand-written / array-stateful ops (see
+# the safety model above _DAG_BWD_CACHE). "captures" are per-step
+# array attrs threaded as traced inputs; a config hook returning None
+# rejects this particular configuration.
+# ---------------------------------------------------------------------------
+def _dag_cfg_smce(op):
+    from .ops import pallas_kernels as _pk
+
+    return (bool(_pk.enabled()),)
+
+
+def _dag_cfg_attention(op):
+    if op.mesh is not None:
+        # with a mesh, forward's ring/local routing keys on whether
+        # inputs are tracers — replay would flip it; keep per-op path
+        return None
+    from .ops import pallas_kernels as _pk
+
+    return (op.causal, op.scale, op.axis_name, bool(_pk.enabled()))
+
+
+_DAG_SPECS.update({
+    SoftMaxCrossEntropy: {"captures": ("t",), "config": _dag_cfg_smce},
+    Embedding: {"captures": ("indices",)},
+    Gather: {"captures": ("indices",),
+             "config": lambda op: (op.axis,)},
+    Attention: {"captures": (), "config": _dag_cfg_attention},
+})

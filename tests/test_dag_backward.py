@@ -1,0 +1,202 @@
+"""Recorded-backward executable (autograd._dag_backward): the eager
+DAG's backward as ONE jitted program, keyed on graph structure.
+
+The per-op walk is the semantics-defining path; these tests pin the
+recorded path to it bit-for-bit, and pin the fallback conditions
+(stochastic ops, mesh attention) that must keep using the walk.
+"""
+import numpy as np
+import pytest
+
+from singa_tpu import autograd, device, layer, model, opt, tensor
+
+
+class _MLP(model.Model):
+    def __init__(self, nh=16, nc=4):
+        super().__init__()
+        self.fc1 = layer.Linear(nh)
+        self.r = layer.ReLU()
+        self.fc2 = layer.Linear(nc)
+
+    def forward(self, x):
+        return self.fc2(self.r(self.fc1(x)))
+
+
+def _train(dag, steps=8, momentum=0.9, model_cls=_MLP, mkin=None,
+           clear=True):
+    autograd.set_dag_backward(dag)
+    if clear:
+        autograd._DAG_BWD_CACHE.clear()
+    dev = device.get_default_device()
+    dev.SetRandSeed(7)
+    rs = np.random.RandomState(1)
+    if mkin is None:
+        x = tensor.from_numpy(rs.randn(8, 12).astype(np.float32))
+        y = tensor.from_numpy(rs.randint(0, 4, 8).astype(np.int32))
+    else:
+        x, y = mkin(rs)
+    m = model_cls()
+    m.set_optimizer(opt.SGD(lr=0.05, momentum=momentum))
+    m.compile([x], is_train=True, use_graph=False)
+    losses = []
+    for _ in range(steps):
+        _, l = m(x, y)
+        losses.append(float(l.to_numpy()))
+    return losses
+
+
+def test_recorded_backward_bit_exact_vs_walk():
+    try:
+        walk = _train(False)
+        rec = _train(True)
+    finally:
+        autograd.set_dag_backward(True)
+    assert walk == rec, f"recorded path diverged: {walk} vs {rec}"
+    assert walk[-1] < walk[0]
+
+
+def test_recorded_backward_engages_and_caches():
+    try:
+        autograd.set_dag_backward(True)
+        autograd._DAG_BWD_CACHE.clear()
+        _train(True, steps=4)
+        assert len(autograd._DAG_BWD_CACHE) == 1, (
+            "expected one cached executable for a fixed-shape loop")
+    finally:
+        autograd.set_dag_backward(True)
+
+
+def test_dropout_graph_falls_back():
+    # Dropout's mask comes from the device RNG chain: a replay would
+    # draw a different mask than the eager forward -> must fall back.
+    class _Drop(model.Model):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = layer.Linear(16)
+            self.dr = layer.Dropout(0.5)
+            self.fc2 = layer.Linear(4)
+
+        def forward(self, x):
+            return self.fc2(self.dr(self.fc1(x)))
+
+    try:
+        autograd.set_dag_backward(True)
+        autograd._DAG_BWD_CACHE.clear()
+        losses = _train(True, steps=3, model_cls=_Drop)
+        assert len(autograd._DAG_BWD_CACHE) == 0, (
+            "stochastic DAG must not be recorded")
+        assert np.isfinite(losses).all()
+    finally:
+        autograd.set_dag_backward(True)
+
+
+def test_batchnorm_graph_falls_back():
+    class _BN(model.Model):
+        def __init__(self):
+            super().__init__()
+            self.conv = layer.Conv2d(4, 3, padding=1)
+            self.bn = layer.BatchNorm2d()
+            self.fl = layer.Flatten()
+            self.fc = layer.Linear(4)
+
+        def forward(self, x):
+            return self.fc(self.fl(self.bn(self.conv(x))))
+
+    def mkin(rs):
+        return (tensor.from_numpy(rs.randn(2, 3, 8, 8).astype(np.float32)),
+                tensor.from_numpy(rs.randint(0, 4, 2).astype(np.int32)))
+
+    try:
+        autograd.set_dag_backward(True)
+        autograd._DAG_BWD_CACHE.clear()
+        losses = _train(True, steps=3, model_cls=_BN, mkin=mkin)
+        assert len(autograd._DAG_BWD_CACHE) == 0, (
+            "BatchNorm mutates its layer-shared handle: must fall back")
+        assert np.isfinite(losses).all()
+    finally:
+        autograd.set_dag_backward(True)
+
+
+def test_policy_change_retraces():
+    # matmul-precision policy is folded into every op's key: flipping
+    # it must produce a second executable, not reuse the first.
+    try:
+        autograd.set_dag_backward(True)
+        autograd._DAG_BWD_CACHE.clear()
+        _train(True, steps=2)
+        n1 = len(autograd._DAG_BWD_CACHE)
+        tensor.set_matmul_precision("default")
+        _train(True, steps=2, clear=False)
+        n2 = len(autograd._DAG_BWD_CACHE)
+    finally:
+        tensor.set_matmul_precision("highest")
+        autograd.set_dag_backward(True)
+    assert n1 == 1 and n2 == 2
+
+
+def test_labels_are_threaded_not_baked():
+    # Same model/shapes, different labels each step: grads must track
+    # the labels (they are captures, not baked constants).
+    autograd.set_dag_backward(True)
+    autograd._DAG_BWD_CACHE.clear()
+    dev = device.get_default_device()
+    dev.SetRandSeed(3)
+    rs = np.random.RandomState(2)
+    x = tensor.from_numpy(rs.randn(8, 12).astype(np.float32))
+    m = _MLP()
+    m.set_optimizer(opt.SGD(lr=0.0))  # no updates: isolate grads
+    m.compile([x], is_train=True, use_graph=False)
+    out = m.forward(x)
+    ys = [tensor.from_numpy(rs.randint(0, 4, 8).astype(np.int32))
+          for _ in range(2)]
+    grads = []
+    for yv in ys:
+        l = autograd.softmax_cross_entropy(m.forward(x), yv)
+        pairs = list(autograd.iter_backward(l))
+        grads.append(np.array(pairs[0][1].to_numpy()))
+    assert len(autograd._DAG_BWD_CACHE) == 1  # same structure, one exe
+    assert not np.allclose(grads[0], grads[1]), (
+        "different labels must give different grads")
+
+
+def test_double_backward_same_loss():
+    # The walk allows a second backward on the same loss (vjp
+    # persists); the recorded path must not break that by mutating
+    # live instances.
+    autograd.set_dag_backward(True)
+    autograd._DAG_BWD_CACHE.clear()
+    dev = device.get_default_device()
+    dev.SetRandSeed(9)
+    rs = np.random.RandomState(4)
+    x = tensor.from_numpy(rs.randn(4, 12).astype(np.float32))
+    y = tensor.from_numpy(rs.randint(0, 4, 4).astype(np.int32))
+    m = _MLP()
+    m.set_optimizer(opt.SGD(lr=0.0))
+    m.compile([x], is_train=True, use_graph=False)
+    l = autograd.softmax_cross_entropy(m.forward(x), y)
+    g1 = [np.array(g.to_numpy()) for _, g in autograd.iter_backward(l)]
+    g2 = [np.array(g.to_numpy()) for _, g in autograd.iter_backward(l)]
+    for a, b in zip(g1, g2):
+        assert np.array_equal(a, b)
+
+
+def test_intermediate_stores_grad_falls_back():
+    # stores_grad on an intermediate activation: replay would drop the
+    # pair silently, so the DAG path must decline the whole graph.
+    autograd.set_dag_backward(True)
+    autograd._DAG_BWD_CACHE.clear()
+    dev = device.get_default_device()
+    dev.SetRandSeed(9)
+    rs = np.random.RandomState(4)
+    x = tensor.from_numpy(rs.randn(4, 12).astype(np.float32))
+    y = tensor.from_numpy(rs.randint(0, 4, 4).astype(np.int32))
+    m = _MLP()
+    m.set_optimizer(opt.SGD(lr=0.0))
+    m.compile([x], is_train=True, use_graph=False)
+    h = m.fc1(x)
+    h.stores_grad = True
+    l = autograd.softmax_cross_entropy(m.fc2(m.r(h)), y)
+    pairs = list(autograd.iter_backward(l))
+    assert len(autograd._DAG_BWD_CACHE) == 0, "must fall back"
+    assert any(p is h for p, _ in pairs), (
+        "intermediate grad pair must be emitted")
