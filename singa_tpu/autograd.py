@@ -522,7 +522,8 @@ def _dag_signature(y, dy_arr):
                           op.num_outputs))
     leaf_sig = tuple(
         (x.data.shape, _dtype_str(x.data.dtype), bool(x.requires_grad),
-         bool(x.stores_grad)) for x in leaves)
+         bool(x.stores_grad), getattr(x.data, "sharding", None))
+        for x in leaves)
     cap_sig = tuple(
         (getattr(ops[i], a).shape, _dtype_str(getattr(ops[i], a).dtype))
         for i, a in cap_refs)
@@ -551,7 +552,10 @@ def _dag_backward(y, dy_arr):
     if sig is None:
         return None
     key, ops, leaves, cap_refs = sig
-    ent = _DAG_BWD_CACHE.get(key)
+    try:
+        ent = _DAG_BWD_CACHE.get(key)
+    except TypeError:  # unhashable key component (exotic sharding)
+        return None
     if ent is False:  # negative cache: traced once, failed — walk
         return None
     if ent is None:
@@ -614,6 +618,8 @@ def _dag_backward(y, dy_arr):
             grads = fn([x.data for x in leaves], caps, dy_arr)
         except Exception:
             _DAG_BWD_CACHE[key] = False
+            while len(_DAG_BWD_CACHE) > 256:
+                del _DAG_BWD_CACHE[next(iter(_DAG_BWD_CACHE))]
             return None
         holder.clear()  # unpin the recorded instances
         ent = (fn, meta["order"])
@@ -1875,6 +1881,7 @@ def _dag_cfg_attention(op):
 
 _DAG_SPECS.update({
     SoftMaxCrossEntropy: {"captures": ("t",), "config": _dag_cfg_smce},
+    MeanSquareError: {"captures": ("t",)},
     Embedding: {"captures": ("indices",)},
     Gather: {"captures": ("indices",),
              "config": lambda op: (op.axis,)},

@@ -146,7 +146,6 @@ def test_labels_are_threaded_not_baked():
     m = _MLP()
     m.set_optimizer(opt.SGD(lr=0.0))  # no updates: isolate grads
     m.compile([x], is_train=True, use_graph=False)
-    out = m.forward(x)
     ys = [tensor.from_numpy(rs.randint(0, 4, 8).astype(np.int32))
           for _ in range(2)]
     grads = []
@@ -200,3 +199,59 @@ def test_intermediate_stores_grad_falls_back():
     assert len(autograd._DAG_BWD_CACHE) == 0, "must fall back"
     assert any(p is h for p, _ in pairs), (
         "intermediate grad pair must be emitted")
+
+
+def test_transformer_dag_records_within_tolerance():
+    # Deep DAG (Embedding + Attention + LayerNorm blocks): the replay
+    # fuses across ops, so expect graph-mode-class rounding (<=1e-5
+    # rel), not bit equality.
+    from singa_tpu.models.transformer import TransformerLM
+
+    def run(dag):
+        autograd.set_dag_backward(dag)
+        autograd._DAG_BWD_CACHE.clear()
+        dev = device.get_default_device()
+        dev.SetRandSeed(11)
+        rs = np.random.RandomState(0)
+        x = tensor.from_numpy(rs.randint(0, 100, (2, 16)).astype(np.int32))
+        y = tensor.from_numpy(rs.randint(0, 100, (2, 16)).astype(np.int32))
+        m = TransformerLM(100, d_model=32, num_heads=2, num_layers=2,
+                          max_len=16)
+        m.set_optimizer(opt.SGD(lr=0.05, momentum=0.9))
+        m.compile([x], is_train=True, use_graph=False)
+        ls = []
+        for _ in range(5):
+            _, l = m(x, y)
+            ls.append(float(l.to_numpy()))
+        return ls
+
+    try:
+        walk = run(False)
+        rec = run(True)
+        assert len(autograd._DAG_BWD_CACHE) == 1, "must record"
+    finally:
+        autograd.set_dag_backward(True)
+    for a, b in zip(walk, rec):
+        assert abs(a - b) <= 1e-5 * max(1.0, abs(a)), (walk, rec)
+
+
+def test_mse_graph_records_and_tracks_targets():
+    autograd.set_dag_backward(True)
+    autograd._DAG_BWD_CACHE.clear()
+    dev = device.get_default_device()
+    dev.SetRandSeed(15)
+    rs = np.random.RandomState(6)
+    x = tensor.from_numpy(rs.randn(4, 8).astype(np.float32))
+    m = _MLP(nc=8)
+    m.set_optimizer(opt.SGD(lr=0.0))
+    m.compile([x], is_train=True, use_graph=False)
+    grads = []
+    for seed in (1, 2):
+        t = tensor.from_numpy(
+            np.random.RandomState(seed).randn(4, 8).astype(np.float32))
+        l = autograd.mse_loss(m.forward(x), t)
+        pairs = list(autograd.iter_backward(l))
+        grads.append(np.array(pairs[0][1].to_numpy()))
+    assert len(autograd._DAG_BWD_CACHE) == 1, "MSE DAG must record"
+    assert not np.allclose(grads[0], grads[1]), (
+        "targets are captures, not baked constants")
