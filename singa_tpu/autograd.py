@@ -1869,6 +1869,18 @@ def _dag_cfg_smce(op):
     return (bool(_pk.enabled()),)
 
 
+def _dag_cfg_dropout(op):
+    if op._key is None:
+        # internal next_key() draw: a replay would re-draw (different
+        # mask than the eager forward, and a trace-time chain advance)
+        return None
+    from .ops import pallas_kernels as _pk
+
+    # the explicit key is the capture: replay reproduces the exact
+    # eager mask from it, with no device-chain side effect
+    return (op.ratio, bool(training), bool(_pk.dropout_enabled()))
+
+
 def _dag_cfg_attention(op):
     if op.mesh is not None:
         # with a mesh, forward's ring/local routing keys on whether
@@ -1882,6 +1894,7 @@ def _dag_cfg_attention(op):
 _DAG_SPECS.update({
     SoftMaxCrossEntropy: {"captures": ("t",), "config": _dag_cfg_smce},
     MeanSquareError: {"captures": ("t",)},
+    Dropout: {"captures": ("_key",), "config": _dag_cfg_dropout},
     Embedding: {"captures": ("indices",)},
     Gather: {"captures": ("indices",),
              "config": lambda op: (op.axis,)},

@@ -66,28 +66,56 @@ def test_recorded_backward_engages_and_caches():
         autograd.set_dag_backward(True)
 
 
-def test_dropout_graph_falls_back():
-    # Dropout's mask comes from the device RNG chain: a replay would
-    # draw a different mask than the eager forward -> must fall back.
-    class _Drop(model.Model):
-        def __init__(self):
-            super().__init__()
-            self.fc1 = layer.Linear(16)
-            self.dr = layer.Dropout(0.5)
-            self.fc2 = layer.Linear(4)
+class _Drop(model.Model):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = layer.Linear(16)
+        self.dr = layer.Dropout(0.5)
+        self.fc2 = layer.Linear(4)
 
-        def forward(self, x):
-            return self.fc2(self.dr(self.fc1(x)))
+    def forward(self, x):
+        return self.fc2(self.dr(self.fc1(x)))
 
+
+def test_layer_dropout_records_exactly():
+    # layer.Dropout passes an explicit per-step key: the key is a
+    # capture, so the replay reproduces the eager mask exactly and
+    # the device RNG chain is untouched — curves match the walk.
     try:
-        autograd.set_dag_backward(True)
-        autograd._DAG_BWD_CACHE.clear()
-        losses = _train(True, steps=3, model_cls=_Drop)
-        assert len(autograd._DAG_BWD_CACHE) == 0, (
-            "stochastic DAG must not be recorded")
-        assert np.isfinite(losses).all()
+        walk = _train(False, steps=6, model_cls=_Drop)
+        rec = _train(True, steps=6, model_cls=_Drop)
+        assert len(autograd._DAG_BWD_CACHE) == 1, (
+            "keyed dropout DAG must record")
     finally:
         autograd.set_dag_backward(True)
+    for a, b in zip(walk, rec):
+        assert abs(a - b) <= 1e-6 * max(1.0, abs(a)), (walk, rec)
+    # randomness across steps is preserved (different keys -> the
+    # recorded executable sees different capture values)
+    assert len(set(round(v, 9) for v in rec)) == len(rec)
+
+
+def test_keyless_dropout_falls_back():
+    # A raw Dropout op with no explicit key draws from the device
+    # chain inside forward: a replay would re-draw a different mask
+    # (and advance the chain at trace time) -> must fall back. Both
+    # the layer and the functional wrapper pass explicit keys, so
+    # this only arises from direct op construction.
+    autograd.set_dag_backward(True)
+    autograd._DAG_BWD_CACHE.clear()
+    dev = device.get_default_device()
+    dev.SetRandSeed(17)
+    rs = np.random.RandomState(8)
+    x = tensor.from_numpy(rs.randn(4, 12).astype(np.float32))
+    y = tensor.from_numpy(rs.randint(0, 4, 4).astype(np.int32))
+    m = _MLP()
+    m.set_optimizer(opt.SGD(lr=0.0))
+    m.compile([x], is_train=True, use_graph=False)
+    h = autograd.Dropout(0.5)(m.fc1(x))  # keyless: internal draw
+    l = autograd.softmax_cross_entropy(m.fc2(m.r(h)), y)
+    pairs = list(autograd.iter_backward(l))
+    assert len(autograd._DAG_BWD_CACHE) == 0, "must fall back"
+    assert len(pairs) > 0
 
 
 def test_batchnorm_graph_falls_back():
