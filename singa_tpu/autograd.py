@@ -1881,6 +1881,17 @@ def _dag_cfg_dropout(op):
     return (op.ratio, bool(training), bool(_pk.dropout_enabled()))
 
 
+def _dag_cfg_rnn(op):
+    h = op.handle
+    if training and h.dropout > 0 and h.num_layers > 1:
+        # inter-layer dropout draws from op._key: keep the walk (the
+        # capture protocol is static per class). Single-layer nets
+        # record fine — the dropout branch only fires between layers.
+        return None
+    return (h.input_size, h.hidden_size, h.num_layers, h.mode,
+            h.bias, h.bidirectional, bool(training))
+
+
 def _dag_cfg_attention(op):
     if op.mesh is not None:
         # with a mesh, forward's ring/local routing keys on whether
@@ -1895,6 +1906,7 @@ _DAG_SPECS.update({
     SoftMaxCrossEntropy: {"captures": ("t",), "config": _dag_cfg_smce},
     MeanSquareError: {"captures": ("t",)},
     Dropout: {"captures": ("_key",), "config": _dag_cfg_dropout},
+    _RNN: {"captures": (), "config": _dag_cfg_rnn},
     Embedding: {"captures": ("indices",)},
     Gather: {"captures": ("indices",),
              "config": lambda op: (op.axis,)},

@@ -283,3 +283,72 @@ def test_mse_graph_records_and_tracks_targets():
     assert len(autograd._DAG_BWD_CACHE) == 1, "MSE DAG must record"
     assert not np.allclose(grads[0], grads[1]), (
         "targets are captures, not baked constants")
+
+
+class _CharRNN(model.Model):
+    def __init__(self):
+        super().__init__()
+        from singa_tpu import rnn as rnn_layer
+
+        self.lstm = rnn_layer.LSTM(16)
+        self.fc = layer.Linear(4)
+
+    def forward(self, x):
+        y, _ = self.lstm(x)
+        B, S, H = y.shape
+        return self.fc(autograd.reshape(y, (B * S, H)))
+
+
+def _rnn_in(rs):
+    x = tensor.from_numpy(rs.randn(2, 5, 8).astype(np.float32))
+    y = tensor.from_numpy(rs.randint(0, 4, 10).astype(np.int32))
+    return x, y
+
+
+def test_rnn_graph_records():
+    # LSTM scan (no inter-layer dropout): pure given handle config,
+    # so the DAG records and training stays finite + decreasing.
+    try:
+        rec = _train(True, steps=3, model_cls=_CharRNN, mkin=_rnn_in)
+        n = len(autograd._DAG_BWD_CACHE)
+    finally:
+        autograd.set_dag_backward(True)
+    assert n == 1, "RNN DAG must record"
+    assert np.isfinite(rec).all() and rec[-1] < rec[0]
+
+
+@pytest.mark.slow
+def test_rnn_graph_matches_walk():
+    # the scan compiles twice (walk + recorded): slow-marked
+    try:
+        walk = _train(False, steps=5, model_cls=_CharRNN, mkin=_rnn_in)
+        rec = _train(True, steps=5, model_cls=_CharRNN, mkin=_rnn_in)
+    finally:
+        autograd.set_dag_backward(True)
+    for a, b in zip(walk, rec):
+        assert abs(a - b) <= 1e-5 * max(1.0, abs(a)), (walk, rec)
+
+
+def test_multilayer_dropout_rnn_falls_back():
+    # Inter-layer RNN dropout draws from op._key: recording would
+    # bake the key (same mask every step) -> must decline.
+    from singa_tpu import rnn as rnn_layer
+
+    class _Deep(model.Model):
+        def __init__(self):
+            super().__init__()
+            self.lstm = rnn_layer.LSTM(16, num_layers=2, dropout=0.5)
+            self.fc = layer.Linear(4)
+
+        def forward(self, x):
+            y, _ = self.lstm(x)
+            B, S, H = y.shape
+            return self.fc(autograd.reshape(y, (B * S, H)))
+
+    try:
+        losses = _train(True, steps=2, model_cls=_Deep, mkin=_rnn_in)
+        n = len(autograd._DAG_BWD_CACHE)
+    finally:
+        autograd.set_dag_backward(True)
+    assert n == 0, "inter-layer-dropout RNN must fall back"
+    assert np.isfinite(losses).all()
