@@ -351,7 +351,15 @@ def iter_backward(y: Tensor, dy=None):
             g if g is not None else jnp.zeros(shape, dtype)
             for g, (shape, dtype) in zip(pending.pop(id(op)), op._out_shapes)
         ]
-        in_grads = op.backward(*grads_out)
+        opdev = getattr(op, "device", None)
+        if opdev is not None and opdev._verbosity > 0:
+            # backward rows in the profiling table (forward rows come
+            # from Operator.__call__); this is also why profiled runs
+            # use the walk instead of the one-dispatch recorded path
+            with opdev.TimeOp(type(op).__name__ + ".bwd"):
+                in_grads = op.backward(*grads_out)
+        else:
+            in_grads = op.backward(*grads_out)
         if not isinstance(in_grads, (tuple, list)):
             in_grads = (in_grads,)
         assert len(in_grads) == len(op.inputs), (
@@ -547,6 +555,11 @@ def _dag_backward(y, dy_arr):
     internal eviction, the hit path catches the failure, drops the
     entry, and falls back to the walk)."""
     if not _DAG_BWD_ENABLED or isinstance(y.data, jax.core.Tracer):
+        return None
+    dev = y.device
+    if dev is not None and dev._verbosity > 0:
+        # per-op time profiling is on: the walk dispatches each
+        # backward individually, which is what the timing table shows
         return None
     sig = _dag_signature(y, dy_arr)
     if sig is None:

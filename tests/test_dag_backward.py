@@ -352,3 +352,29 @@ def test_multilayer_dropout_rnn_falls_back():
         autograd.set_dag_backward(True)
     assert n == 0, "inter-layer-dropout RNN must fall back"
     assert np.isfinite(losses).all()
+
+
+def test_profiling_mode_uses_walk_with_backward_rows():
+    # SetVerbosity(1): the recorded path defers to the walk, and the
+    # walk now times each op's backward, so the table gains .bwd rows.
+    autograd.set_dag_backward(True)
+    autograd._DAG_BWD_CACHE.clear()
+    dev = device.get_default_device()
+    dev.SetRandSeed(23)
+    rs = np.random.RandomState(12)
+    x = tensor.from_numpy(rs.randn(4, 12).astype(np.float32))
+    y = tensor.from_numpy(rs.randint(0, 4, 4).astype(np.int32))
+    m = _MLP()
+    m.set_optimizer(opt.SGD(lr=0.01))
+    m.compile([x], is_train=True, use_graph=False)
+    dev.SetVerbosity(1)
+    dev.SetSkipIteration(0)
+    try:
+        m(x, y)
+        table = dev.PrintTimeProfiling()
+    finally:
+        dev.SetVerbosity(0)
+        dev.SetSkipIteration(5)
+    assert len(autograd._DAG_BWD_CACHE) == 0, (
+        "profiled runs must use the per-op walk")
+    assert ".bwd" in table, f"no backward rows in:\n{table}"

@@ -61,6 +61,7 @@ def test_sampling_reproducible_and_in_range():
     assert ((a >= 0) & (a < 50)).all()
 
 
+@pytest.mark.slow
 def test_rmsnorm_variant_greedy_parity_and_roundtrip():
     """norm="rms": training forward, KV-cache decode, and ONNX export
     (RMSNorm composes from primitive ops) all agree."""
@@ -87,6 +88,7 @@ def test_rmsnorm_variant_greedy_parity_and_roundtrip():
                    for n in mp.graph.node)
 
 
+@pytest.mark.slow
 def test_tied_embeddings_greedy_parity_and_no_head_param():
     from singa_tpu import device
 
