@@ -410,10 +410,10 @@ def gradients(y: Tensor, dy=None) -> Dict[Tensor, Tensor]:
 #   * hand-written ops must appear in _DAG_SPECS, declaring which
 #     attributes are per-step data ("captures" — threaded as traced
 #     arguments, never baked as constants);
-#   * anything else — Dropout (device RNG), _BatchNorm2d (mutates the
-#     layer-shared handle's running stats), Attention, Cast — falls
-#     back to the per-op walk. Wrong-exclusion costs speed, never
-#     correctness.
+#   * anything else — a keyless Dropout (internal device-RNG draw),
+#     meshed Attention, multi-layer-dropout RNN, Cast, any op holding
+#     undeclared array state — falls back to the per-op walk.
+#     Wrong-exclusion costs speed, never correctness.
 # ===========================================================================
 
 _DAG_BWD_CACHE: dict = {}
@@ -1894,6 +1894,11 @@ def _dag_cfg_dropout(op):
     return (op.ratio, bool(training), bool(_pk.dropout_enabled()))
 
 
+def _dag_cfg_bn(op):
+    h = op.handle
+    return (h.factor, h.eps, bool(training))
+
+
 def _dag_cfg_rnn(op):
     h = op.handle
     if training and h.dropout > 0 and h.num_layers > 1:
@@ -1920,6 +1925,10 @@ _DAG_SPECS.update({
     MeanSquareError: {"captures": ("t",)},
     Dropout: {"captures": ("_key",), "config": _dag_cfg_dropout},
     _RNN: {"captures": (), "config": _dag_cfg_rnn},
+    # BN's running stats are per-step INPUTS (the op never mutates its
+    # handle — it exposes new_running_* and the Layer rebinds, so the
+    # generic instance snapshot covers the replay's trace-time writes)
+    _BatchNorm2d: {"captures": ("rm", "rv"), "config": _dag_cfg_bn},
     Embedding: {"captures": ("indices",)},
     Gather: {"captures": ("indices",),
              "config": lambda op: (op.axis,)},

@@ -118,31 +118,55 @@ def test_keyless_dropout_falls_back():
     assert len(pairs) > 0
 
 
-def test_batchnorm_graph_falls_back():
-    class _BN(model.Model):
-        def __init__(self):
-            super().__init__()
-            self.conv = layer.Conv2d(4, 3, padding=1)
-            self.bn = layer.BatchNorm2d()
-            self.fl = layer.Flatten()
-            self.fc = layer.Linear(4)
+class _BN(model.Model):
+    def __init__(self):
+        super().__init__()
+        self.conv = layer.Conv2d(4, 3, padding=1)
+        self.bn = layer.BatchNorm2d()
+        self.fl = layer.Flatten()
+        self.fc = layer.Linear(4)
 
-        def forward(self, x):
-            return self.fc(self.fl(self.bn(self.conv(x))))
+    def forward(self, x):
+        return self.fc(self.fl(self.bn(self.conv(x))))
 
-    def mkin(rs):
-        return (tensor.from_numpy(rs.randn(2, 3, 8, 8).astype(np.float32)),
-                tensor.from_numpy(rs.randint(0, 4, 2).astype(np.int32)))
 
+def _bn_in(rs):
+    return (tensor.from_numpy(rs.randn(2, 3, 8, 8).astype(np.float32)),
+            tensor.from_numpy(rs.randint(0, 4, 2).astype(np.int32)))
+
+
+def test_batchnorm_graph_records_and_matches_walk():
+    # BN's running stats are per-step captures (the op exposes
+    # new_running_* instead of mutating its handle, so the replay has
+    # no external side effect to corrupt); a full conv+BN net records.
     try:
-        autograd.set_dag_backward(True)
-        autograd._DAG_BWD_CACHE.clear()
-        losses = _train(True, steps=3, model_cls=_BN, mkin=mkin)
-        assert len(autograd._DAG_BWD_CACHE) == 0, (
-            "BatchNorm mutates its layer-shared handle: must fall back")
-        assert np.isfinite(losses).all()
+        walk = _train(False, steps=4, model_cls=_BN, mkin=_bn_in)
+        rec = _train(True, steps=4, model_cls=_BN, mkin=_bn_in)
+        n = len(autograd._DAG_BWD_CACHE)
     finally:
         autograd.set_dag_backward(True)
+    assert n == 1, "conv+BN DAG must record"
+    for a, b in zip(walk, rec):
+        assert abs(a - b) <= 1e-5 * max(1.0, abs(a)), (walk, rec)
+
+
+def test_batchnorm_running_stats_still_update():
+    autograd.set_dag_backward(True)
+    autograd._DAG_BWD_CACHE.clear()
+    dev = device.get_default_device()
+    dev.SetRandSeed(7)
+    rs = np.random.RandomState(1)
+    x, y = _bn_in(rs)
+    m = _BN()
+    m.set_optimizer(opt.SGD(lr=0.01))
+    m.compile([x], is_train=True, use_graph=False)
+    m(x, y)
+    rm1 = np.array(m.bn.running_mean.to_numpy())
+    m(x, y)
+    rm2 = np.array(m.bn.running_mean.to_numpy())
+    assert np.isfinite(rm2).all()
+    assert not np.array_equal(rm1, rm2), (
+        "running stats must keep evolving under the recorded path")
 
 
 def test_policy_change_retraces():
