@@ -203,3 +203,26 @@ def test_gpt2_causality_and_finetune(tmp_path):
         _, loss = m.train_one_batch(tx, ty)
         losses.append(float(loss.to_numpy()))
     assert losses[-1] < losses[0]
+
+
+def test_vit_wire_roundtrip(tmp_path):
+    """Native ViT (Conv patch-embed + attention blocks + GAP head)
+    export -> serialized wire file -> load -> reimport -> logits
+    match the native eval to float tolerance."""
+    import vit
+
+    from singa_tpu import device
+
+    dev = device.get_default_device()
+    dev.SetRandSeed(3)
+    m = vit.create_model(num_classes=5, img_size=16, patch=4,
+                         d_model=32, num_heads=2, num_layers=1)
+    rs = np.random.RandomState(0)
+    x = tensor.from_numpy(rs.randn(2, 3, 16, 16).astype(np.float32))
+    m.compile([x], is_train=False, use_graph=False)
+    m.eval()
+    golden = m(x).to_numpy()
+    path = str(tmp_path / "vit.onnx")
+    sonnx.save(sonnx.to_onnx(m, [x], model_name="vit"), path)
+    got = sonnx.prepare(sonnx.load(path)).run([x])[0].to_numpy()
+    np.testing.assert_allclose(got, golden, rtol=1e-5, atol=1e-6)
