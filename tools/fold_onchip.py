@@ -1,0 +1,95 @@
+"""Summarize on-chip stage logs into a BASELINE-ready table.
+
+`tools/onchip_runner.sh` mirrors every stage attempt's output into
+`onchip_logs/<stage>.out` (append-only across attempts); this reads
+each file's LAST result-JSON line and prints one row per stage, ready
+to fold into BASELINE.md. A result with trailing non-JSON output
+after it (a later attempt that died before printing its result) is
+flagged stale rather than reported as current.
+
+    python tools/fold_onchip.py            # table of everything seen
+"""
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+LOGS = os.path.join(HERE, "..", "onchip_logs")
+
+
+def json_lines(path):
+    """Yield (parsed, line_no) for every JSON-object line."""
+    with open(path, errors="replace") as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if line.startswith("{") and line.endswith("}"):
+                try:
+                    yield json.loads(line), i
+                except ValueError:
+                    pass
+
+
+def last_json(path):
+    """(last result, stale?) — stale when non-blank lines follow it
+    (a later attempt wrote output but never reached its result)."""
+    out, at = None, -1
+    for obj, i in json_lines(path):
+        out, at = obj, i
+    if out is None:
+        return None, False
+    with open(path, errors="replace") as f:
+        trailing = [ln for ln in list(f)[at + 1:] if ln.strip()]
+    return out, bool(trailing)
+
+
+def main():
+    if not os.path.isdir(LOGS):
+        print("no onchip_logs/ yet — run tools/onchip_runner.sh first")
+        return 1
+    entries = []  # (stage, result-dict or None, stale)
+    for name in sorted(os.listdir(LOGS)):
+        path = os.path.join(LOGS, name)
+        if name.endswith(".out"):  # per-stage file
+            r, stale = last_json(path)
+            entries.append((name[:-4], r, stale))
+        elif name.endswith(".log"):  # aggregated runbook log: all lines
+            for obj, _ in json_lines(path):
+                entries.append((name[:-4], obj, False))
+    rows = []
+    for stage, r, stale in entries:
+        mark = "  [STALE: a later attempt left no result]" if stale else ""
+        if r is None:
+            if stage.startswith("pallas_") and os.path.getsize(
+                    os.path.join(LOGS, stage + ".out")) > 0:
+                # these stages print a table, not a JSON contract
+                rows.append((stage, "ran — see benchmarks/"
+                                    "PALLAS_BENCH.md / the .out log"))
+            else:
+                rows.append((stage, "no result line"))
+            continue
+        if not r.get("ok", False):
+            rows.append((stage, f"FAILED: {r.get('error', r)}" + mark))
+            continue
+        if "ips" in r:
+            rows.append((stage,
+                         f"{r['ips']:.1f} img/s  ({r['step_ms']:.1f} "
+                         f"ms/step, bs{r['batch']}, {r.get('precision')}"
+                         f"{', remat' if r.get('remat') else ''})" + mark))
+        elif "tokens_per_sec" in r:
+            rows.append((stage, f"{r['tokens_per_sec']:.0f} tok/s  "
+                                f"({r.get('config')})" + mark))
+        elif "diffs" in r:
+            d = r["diffs"].get("cpu_graph_vs_tpu_graph")
+            rows.append((stage, "parity max rel "
+                         + (f"{d:.4f}" if d is not None
+                            else "NO TPU COLUMN") + mark))
+        else:
+            rows.append((stage, json.dumps(r)[:100] + mark))
+    width = max((len(s) for s, _ in rows), default=8)
+    for stage, desc in rows:
+        print(f"  {stage:<{width}}  {desc}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
