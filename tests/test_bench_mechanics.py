@@ -1,0 +1,43 @@
+"""bench.py mechanics on the CPU backend (BENCH_PLATFORM=cpu).
+
+BENCH_r{N}.json — the round's driver artifact — depends on bench.py
+importing, parsing args, and running stages; nothing else in the
+suite exercises it. These tests pin the subprocess contract the
+driver and tools/onchip_runner.sh rely on: one parseable result-JSON
+line on stdout, ok flag, rc 0.
+"""
+import json
+import os
+import subprocess
+import sys
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _run_stage(args, timeout=240):
+    env = dict(os.environ, BENCH_PLATFORM="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "bench.py")] + args,
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=_ROOT,
+    )
+    last = None
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{") and line.endswith("}"):
+            last = json.loads(line)
+    return proc, last
+
+
+def test_probe_stage_contract():
+    proc, result = _run_stage(["--stage", "probe"])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert result is not None, "no JSON result line on stdout"
+    assert result["ok"] is True
+    assert result["platform"] == "cpu"
+
+
+def test_unknown_stage_is_loud():
+    proc, result = _run_stage(["--stage", "probe", "--bogus-flag"])
+    assert proc.returncode != 0, (
+        "unknown flags must fail loudly, not measure the wrong thing")
