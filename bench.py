@@ -514,6 +514,13 @@ def main():
         return stage_decode(a.batch, 64, 192, a.deadline)
     if a.stage == "parity":
         return stage_parity(a.steps, a.deadline)
+    if a.stage:
+        # a typo'd stage must not silently run the FULL 23-minute
+        # driver flow below
+        print(json.dumps({"ok": False,
+                          "error": f"unknown stage {a.stage!r}"}),
+              flush=True)
+        sys.exit(2)
 
     global_deadline = time.time() + float(
         os.environ.get("BENCH_DEADLINE", "1380"))  # default 23 min

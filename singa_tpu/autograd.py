@@ -461,9 +461,9 @@ def _dag_op_entry(op):
             v = vars(op)[k]
             if isinstance(v, (int, float, bool, str, type(None))):
                 items.append((k, v))
-            elif isinstance(v, tuple) and all(
+            elif isinstance(v, (tuple, list)) and all(
                     isinstance(e, (int, float, bool, str)) for e in v):
-                items.append((k, v))
+                items.append((k, tuple(v)))  # lists: axes/pads configs
             elif isinstance(v, (jnp.ndarray, np.ndarray)) or isinstance(
                     v, Tensor):
                 return None

@@ -37,7 +37,16 @@ def test_probe_stage_contract():
     assert result["platform"] == "cpu"
 
 
-def test_unknown_stage_is_loud():
-    proc, result = _run_stage(["--stage", "probe", "--bogus-flag"])
+def test_unknown_flag_is_loud():
+    proc, _ = _run_stage(["--stage", "probe", "--bogus-flag"])
     assert proc.returncode != 0, (
         "unknown flags must fail loudly, not measure the wrong thing")
+
+
+def test_unknown_stage_is_loud():
+    # A typo'd stage must not silently fall through into the full
+    # multi-stage driver flow (23-minute default deadline).
+    proc, result = _run_stage(["--stage", "probee"], timeout=60)
+    assert proc.returncode != 0
+    assert result is not None and result["ok"] is False
+    assert "unknown stage" in result["error"]

@@ -402,3 +402,22 @@ def test_profiling_mode_uses_walk_with_backward_rows():
     assert len(autograd._DAG_BWD_CACHE) == 0, (
         "profiled runs must use the per-op walk")
     assert ".bwd" in table, f"no backward rows in:\n{table}"
+
+
+def test_list_config_ops_record():
+    # Slice stores starts/ends/axes as LISTS: the generic config scan
+    # normalizes them to tuples instead of disqualifying the op.
+    autograd.set_dag_backward(True)
+    autograd._DAG_BWD_CACHE.clear()
+    rs = np.random.RandomState(0)
+    w = tensor.from_numpy(rs.randn(6, 8).astype(np.float32))
+    w.requires_grad = True
+    w.stores_grad = True
+    h = autograd.Slice([1], [5], [0])(w)
+    l = autograd.reduce_mean(autograd.mul(h, h))
+    pairs = list(autograd.iter_backward(l))
+    assert len(autograd._DAG_BWD_CACHE) == 1, "list-config op must record"
+    g = pairs[0][1].to_numpy()
+    ref = np.zeros((6, 8), np.float32)
+    ref[1:5] = 2 * w.to_numpy()[1:5] / 32.0
+    np.testing.assert_allclose(g, ref, atol=1e-6)
