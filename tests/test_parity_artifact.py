@@ -10,6 +10,7 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 _ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 
@@ -45,3 +46,33 @@ def test_committed_artifact_is_valid():
     if art["curves"]["tpu_graph"] is None:
         assert art["errors"].get("tpu_graph"), \
             "missing TPU curve must be explained"
+
+
+def test_failed_tpu_attempt_never_erases_recorded_column(tmp_path):
+    """A parity run whose TPU curve fails (half-open tunnel window)
+    must keep the recorded on-chip artifact intact — the acceptance
+    gate's evidence must be monotone."""
+    import shutil
+    import subprocess
+    import sys
+
+    art = os.path.join(_ROOT, "PARITY_cifar10.json")
+    with open(art) as f:
+        before = f.read()
+    if not json.loads(before).get("curves", {}).get("tpu_graph"):
+        pytest.skip("no recorded tpu_graph column to protect")
+    backup = tmp_path / "parity_backup.json"
+    shutil.copy(art, backup)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_ROOT, "tools",
+                                          "parity_cifar10.py"),
+             "--tpu-only", "--skip-tpu", "--steps", "30"],
+            capture_output=True, text=True, timeout=120, cwd=_ROOT)
+        assert proc.returncode == 0, proc.stderr[-1000:]
+        with open(art) as f:
+            after = f.read()
+        assert after == before, (
+            "tool rewrote the artifact, nulling the recorded column")
+    finally:
+        shutil.copy(backup, art)

@@ -176,12 +176,27 @@ def main():
         "curves": curves, "max_rel_diffs": diffs, "errors": errors,
     }
     path = os.path.join(_ROOT, "PARITY_cifar10.json")
+    degrade = None
+    try:
+        with open(path) as f:
+            prev = json.load(f)
+        # A failed/timed-out TPU attempt must never erase a recorded
+        # on-chip column (the acceptance-gate evidence): a half-open
+        # tunnel window — probe OK, then death mid-curve — would
+        # otherwise null out the PASSED artifact.
+        if prev.get("curves", {}).get("tpu_graph") and not curves.get(
+                "tpu_graph"):
+            degrade = "recorded tpu_graph present, this run has none"
+    except (OSError, ValueError):
+        pass
     if (a.tpu_only and not (curves.get("cpu_eager")
                             and curves.get("cpu_graph"))):
         # Never overwrite a recorded artifact with an all-null one
         # (e.g. budget ran out before the CPU fallback finished).
         print(f"keeping existing {path} (no CPU curves this run)",
               file=sys.stderr)
+    elif degrade:
+        print(f"keeping existing {path} ({degrade})", file=sys.stderr)
     else:
         with open(path, "w") as f:
             json.dump(artifact, f, indent=1)
