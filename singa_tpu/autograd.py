@@ -411,7 +411,7 @@ def gradients(y: Tensor, dy=None) -> Dict[Tensor, Tensor]:
 #     attributes are per-step data ("captures" — threaded as traced
 #     arguments, never baked as constants);
 #   * anything else — a keyless Dropout (internal device-RNG draw),
-#     meshed Attention, multi-layer-dropout RNN, Cast, any op holding
+#     meshed Attention, multi-layer-dropout RNN, any op holding
 #     undeclared array state — falls back to the per-op walk.
 #     Wrong-exclusion costs speed, never correctness.
 # ===========================================================================
@@ -561,7 +561,12 @@ def _dag_backward(y, dy_arr):
         # per-op time profiling is on: the walk dispatches each
         # backward individually, which is what the timing table shows
         return None
-    sig = _dag_signature(y, dy_arr)
+    try:
+        sig = _dag_signature(y, dy_arr)
+    except Exception:
+        # a config hook choking on an exotic attribute must degrade
+        # to the walk, never break backward
+        sig = None
     if sig is None:
         return None
     key, ops, leaves, cap_refs = sig
@@ -1921,6 +1926,11 @@ def _dag_cfg_attention(op):
 
 
 _DAG_SPECS.update({
+    # Cast: hand-written backward (grad re-cast to the input dtype,
+    # which forward derives from its input — pure given `to`);
+    # np.dtype() normalizes spelling (np.float16 / "float16" / dtype)
+    Cast: {"captures": (),
+           "config": lambda op: (_dtype_str(np.dtype(op.to)),)},
     SoftMaxCrossEntropy: {"captures": ("t",), "config": _dag_cfg_smce},
     MeanSquareError: {"captures": ("t",)},
     Dropout: {"captures": ("_key",), "config": _dag_cfg_dropout},

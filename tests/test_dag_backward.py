@@ -421,3 +421,30 @@ def test_list_config_ops_record():
     ref = np.zeros((6, 8), np.float32)
     ref[1:5] = 2 * w.to_numpy()[1:5] / 32.0
     np.testing.assert_allclose(g, ref, atol=1e-6)
+
+
+def test_cast_and_amp_graphs_record():
+    # Cast op (hand-written backward) and the bf16 AMP policy both
+    # record; AMP curves match the walk.
+    autograd.set_dag_backward(True)
+    autograd._DAG_BWD_CACHE.clear()
+    rs = np.random.RandomState(0)
+    w = tensor.from_numpy(rs.randn(4, 6).astype(np.float32))
+    w.requires_grad = True
+    w.stores_grad = True
+    h = autograd.cast(w, np.float16)
+    l = autograd.reduce_mean(autograd.mul(h, h))
+    pairs = list(autograd.iter_backward(l))
+    assert len(autograd._DAG_BWD_CACHE) == 1, "Cast DAG must record"
+    assert pairs[0][1].to_numpy().dtype == np.float32
+
+    try:
+        tensor.set_compute_dtype("bfloat16")
+        walk = _train(False, steps=4)
+        rec = _train(True, steps=4)
+    finally:
+        tensor.set_compute_dtype(None)
+        autograd.set_dag_backward(True)
+    assert len(autograd._DAG_BWD_CACHE) == 1, "AMP DAG must record"
+    for a, b in zip(walk, rec):
+        assert abs(a - b) <= 1e-5 * max(1.0, abs(a)), (walk, rec)
