@@ -1,17 +1,30 @@
-"""Eager per-op dispatch overhead vs graph mode (SURVEY.md §7
-hard-part #4: "op-executable cache from day one"; VERDICT r3 Weak #9).
+"""Eager per-op dispatch overhead vs graph mode, plus cache-layer
+observability (SURVEY.md §7 hard-part #4: "op-executable cache from
+day one"; VERDICT r3 Weak #9; ADVICE r5: FIFO DAG-cache thrash).
 
-Measures the MLP config (the reference's `examples/mlp`) in both
-execution modes and reports µs/op. Eager mode dispatches each
-`Operator` as its own XLA program through jax's C++ dispatch cache —
-that cache IS the op-executable cache the survey demands (keyed on
-primitive + shapes + dtypes); this benchmark quantifies what it costs
-vs the single fused program graph mode compiles.
+Part 1 measures the MLP config (the reference's `examples/mlp`) in
+both execution modes and reports µs/op. Eager mode dispatches each
+`Operator` as its own XLA program through jax's C++ dispatch cache;
+this quantifies what that costs vs the single fused program graph
+mode compiles.
 
-Run: python benchmarks/eager_overhead.py  [--steps N] [--cpu]
-Writes a row suitable for BASELINE.md to stdout.
+Part 2 demonstrates the recorded-backward cache's eviction policy on
+a cycling workload (bucketed batch sizes: a hot subset touched every
+round plus a cold tail that cycles through more shapes than fit).
+Under the tiered LRU (default) the hot executables stay resident —
+the retrace counter goes flat after warmup; under the legacy FIFO
+policy (the demo runs both via `device.set_dag_cache_policy`) the
+cold tail evicts the hot set and every round re-pays full traces.
+
+Output contract: human-readable rows (BASELINE.md format), one
+`cache_stats <name> ...` line per executable cache
+(singa_tpu.stats.format_stats), and ONE final JSON line with every
+number — the same last-JSON-line contract bench.py stages follow.
+
+Run: python benchmarks/eager_overhead.py  [--steps N] [--cpu] [--quick]
 """
 import argparse
+import json
 import os
 import sys
 import time
@@ -22,20 +35,8 @@ sys.path.insert(0, os.path.abspath(
     os.path.join(os.path.dirname(__file__), "..")))
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--steps", type=int, default=30)
-    ap.add_argument("--cpu", action="store_true")
-    a = ap.parse_args()
-
-    import jax
-
-    if a.cpu:
-        jax.config.update("jax_platforms", "cpu")
-        from jax.extend.backend import clear_backends
-
-        clear_backends()
-
+def _measure_modes(steps):
+    """Part 1: eager vs graph step time on the reference MLP config."""
     from singa_tpu import device, layer, model, opt, tensor
 
     class MLP(model.Model):
@@ -67,14 +68,12 @@ def main():
             out, loss = m(tx, ty)
         loss.data.block_until_ready()
         t0 = time.perf_counter()
-        for _ in range(a.steps):
+        for _ in range(steps):
             out, loss = m(tx, ty)
         loss.data.block_until_ready()
-        results[mode] = (time.perf_counter() - t0) / a.steps
+        results[mode] = (time.perf_counter() - t0) / steps
 
-    # op count for the eager step: fwd 8 ops (3 matmul + 3 bias-add via
-    # Linear, 2 relu ≈ 8 Operator calls) + xent + backward ~2x fwd +
-    # 5 SGD updates — count it live instead of guessing:
+    # count ops live instead of guessing (fwd + bwd + optimizer)
     from singa_tpu import autograd
 
     n_ops = 0
@@ -95,14 +94,157 @@ def main():
         m2(tx, ty)
     finally:
         autograd.Operator.__call__ = orig
+    return results["eager"], results["graph"], n_ops
 
-    eager, graph = results["eager"], results["graph"]
+
+class _DemoMLP:
+    """Tiny fixed-feature MLP; distinct BATCH sizes give distinct DAG
+    signatures (the leaf/cotangent shapes key the recorded-backward
+    cache), which is exactly the bucketed-sequence-length shape churn
+    the LRU exists for."""
+
+    def build(self):
+        from singa_tpu import layer, model
+
+        class M(model.Model):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = layer.Linear(16)
+                self.r = layer.ReLU()
+                self.fc2 = layer.Linear(4)
+
+            def forward(self, x):
+                return self.fc2(self.r(self.fc1(x)))
+
+        return M()
+
+
+def _cache_demo(policy, capacity, hot_n, warm_rounds, measure_rounds):
+    """Run the cycling workload under one eviction policy.
+
+    Each round touches every hot shape, then `capacity - hot_n` cold
+    shapes drawn round-robin from a pool twice that size (so colds
+    always miss). Under LRU the round-start hot accesses promote the
+    hot set past the cold churn — it never retraces after warmup;
+    under FIFO the cold inserts push the (never-reordered) hot
+    entries out and the hot set re-pays full traces every other
+    round. Returns (steady hot retraces per round, mean ms per hot
+    step, total retraces).
+    """
+    from singa_tpu import autograd, device, opt, stats, tensor
+
+    device.set_dag_cache_policy(policy)
+    device.set_dag_cache_capacity(capacity)
+    autograd._DAG_BWD_CACHE.clear()
+    dev = device.get_default_device()
+    dev.SetRandSeed(0)
+    rs = np.random.RandomState(0)
+    m = _DemoMLP().build()
+    m.set_optimizer(opt.SGD(lr=0.01, momentum=0.9))
+
+    def batch(bs):
+        x = tensor.from_numpy(rs.randn(bs, 12).astype(np.float32))
+        y = tensor.from_numpy(rs.randint(0, 4, bs).astype(np.int32))
+        return x, y
+
+    cold_per_round = capacity - hot_n
+    hot = [batch(4 + i) for i in range(hot_n)]
+    cold = [batch(64 + i) for i in range(2 * cold_per_round)]
+    m.compile([hot[0][0]], is_train=True, use_graph=False)
+
+    def retraces():
+        return stats.cache_stats()["dag_backward"]["retraces"]
+
+    r_start = retraces()
+    hot_retraces = 0
+    hot_time = 0.0
+    hot_steps = 0
+    ci = 0
+    for rnd in range(warm_rounds + measure_rounds):
+        measuring = rnd >= warm_rounds
+        r0 = retraces()
+        t0 = time.perf_counter()
+        for x, y in hot:
+            m(x, y)
+        if measuring:
+            hot_time += time.perf_counter() - t0
+            hot_retraces += retraces() - r0
+            hot_steps += len(hot)
+        for _ in range(cold_per_round):
+            x, y = cold[ci % len(cold)]
+            ci += 1
+            m(x, y)
+    total = retraces() - r_start
+    return (hot_retraces / max(measure_rounds, 1),
+            hot_time / max(hot_steps, 1) * 1e3, total)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--quick", action="store_true",
+                    help="CI-sized run (fewer steps, smaller demo)")
+    a = ap.parse_args()
+
+    import jax
+
+    if a.cpu:
+        jax.config.update("jax_platforms", "cpu")
+        from jax.extend.backend import clear_backends
+
+        clear_backends()
+
+    from singa_tpu import device, stats
+
+    steps = min(a.steps, 3) if a.quick else a.steps
+    eager, graph, n_ops = _measure_modes(steps)
     per_op_us = eager / max(n_ops, 1) * 1e6
-    print(f"platform={jax.default_backend()} steps={a.steps} "
+    print(f"platform={jax.default_backend()} steps={steps} "
           f"fwd_ops_per_step={n_ops}")
     print(f"eager_step_ms={eager * 1e3:.3f} graph_step_ms="
           f"{graph * 1e3:.3f} ratio={eager / graph:.2f}x "
           f"eager_us_per_op={per_op_us:.1f}")
+
+    # -- Part 2: DAG-cache eviction policy A/B ----------------------------
+    if a.quick:
+        capacity, hot_n, measure_rounds = 4, 2, 4
+    else:
+        capacity, hot_n, measure_rounds = 8, 4, 6
+    warm_rounds = 2  # round 0 fills, round 1 reaches steady churn
+    demo = {"capacity": capacity, "hot_shapes": hot_n,
+            "cold_shapes": 2 * (capacity - hot_n),
+            "rounds_measured": measure_rounds}
+    saved = device.get_eager_config()
+    try:
+        for policy in ("lru", "fifo"):
+            hot_rt, hot_ms, total = _cache_demo(
+                policy, capacity, hot_n, warm_rounds, measure_rounds)
+            demo[policy] = {
+                "steady_hot_retraces_per_round": round(hot_rt, 3),
+                "hot_step_ms": round(hot_ms, 3),
+                "total_retraces": total,
+            }
+            print(f"cache_demo policy={policy} capacity={capacity} "
+                  f"hot={hot_n} cold={demo['cold_shapes']} "
+                  f"steady_hot_retraces_per_round={hot_rt:.2f} "
+                  f"hot_step_ms={hot_ms:.3f} total_retraces={total}")
+    finally:
+        device.set_dag_cache_policy(saved["dag_cache_policy"])
+        device.set_dag_cache_capacity(saved["dag_cache_capacity"])
+
+    # -- Part 3: observability snapshot + final JSON ----------------------
+    print(stats.format_stats())
+    print(json.dumps({
+        "ok": True,
+        "platform": jax.default_backend(),
+        "steps": steps,
+        "eager_step_ms": round(eager * 1e3, 3),
+        "graph_step_ms": round(graph * 1e3, 3),
+        "ratio": round(eager / graph, 2),
+        "eager_us_per_op": round(per_op_us, 1),
+        "demo": demo,
+    }), flush=True)
 
 
 if __name__ == "__main__":

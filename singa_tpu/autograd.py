@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import contextlib
 import math
+import time
 from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -38,9 +39,14 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from . import stats as stats_mod
 from . import tensor as tensor_mod
 from .ops import native
 from .tensor import Tensor
+
+# Cache observability snapshot (singa_tpu.stats): per-cache
+# hit/miss/evict/retrace counters + trace-time accounting.
+cache_stats = stats_mod.cache_stats
 
 # Module-level training flag. Reference: `autograd.training`.
 training = False
@@ -223,6 +229,8 @@ class Operator:
 
 
 _EXEC_CACHE: dict = {}
+_EXEC_STATS = stats_mod.CacheStats("op_exec")
+stats_mod.register_cache("op_exec", _EXEC_STATS)
 
 
 _DTYPE_STR: dict = {}
@@ -251,6 +259,8 @@ def _op_executables(cls, key, op):
     ck = (cls, key)
     ent = _EXEC_CACHE.get(ck)
     if ent is None:
+        _EXEC_STATS.misses += 1
+        _EXEC_STATS.retraces += 1  # jit built; XLA compiles on 1st call
         fwd = jax.jit(lambda *a: cls.fn(op, *a))
 
         def bwd_fn(cot, *a):
@@ -259,6 +269,8 @@ def _op_executables(cls, key, op):
 
         ent = (fwd, jax.jit(bwd_fn))
         _EXEC_CACHE[ck] = ent
+    else:
+        _EXEC_STATS.hits += 1
     return ent
 
 
@@ -278,7 +290,10 @@ def _ones_like(arr):
     except (AttributeError, TypeError):
         return jnp.ones_like(arr)
     v = _ONES_CACHE.get(key)
-    if v is None:
+    # is_deleted: a cached ones that leaked into a donated argument
+    # list (buffer donation, opt.py) must refresh, not propagate a
+    # dead buffer into every later backward.
+    if v is None or (hasattr(v, "is_deleted") and v.is_deleted()):
         v = _ONES_CACHE[key] = jnp.ones_like(arr)
     return v
 
@@ -416,7 +431,13 @@ def gradients(y: Tensor, dy=None) -> Dict[Tensor, Tensor]:
 #     Wrong-exclusion costs speed, never correctness.
 # ===========================================================================
 
-_DAG_BWD_CACHE: dict = {}
+# Tiered LRU (singa_tpu.stats.TieredLRUCache): positive entries are
+# compiled backward executables, promoted on hit; negative entries
+# (False = traced once, failed) evict first. Capacity/policy read the
+# shared eager config live — `device.set_dag_cache_capacity()` /
+# `set_dag_cache_policy()` apply without rebuild.
+_DAG_BWD_CACHE = stats_mod.TieredLRUCache("dag_backward")
+stats_mod.register_cache("dag_backward", _DAG_BWD_CACHE)
 _DAG_BWD_ENABLED = True
 # Operator machinery attrs: never part of an op's config, never
 # scanned as array state.
@@ -568,6 +589,10 @@ def _dag_backward(y, dy_arr):
         # to the walk, never break backward
         sig = None
     if sig is None:
+        # structurally unsafe DAG: not a cache miss (nothing to look
+        # up), but worth counting — a workload living here pays the
+        # per-op walk every step
+        _DAG_BWD_CACHE.stats.uncached_fallbacks += 1
         return None
     key, ops, leaves, cap_refs = sig
     try:
@@ -630,20 +655,20 @@ def _dag_backward(y, dy_arr):
         fn = jax.jit(replay)
         # Trace NOW (meta["order"] is a trace-time side channel); a
         # failure is negatively cached so later steps skip straight
-        # to the walk instead of re-paying a doomed trace.
+        # to the walk instead of re-paying a doomed trace. Either way
+        # the trace was paid: account it (retraces + trace_time_s).
+        t0 = time.perf_counter()
         try:
             caps = [getattr(ops[i], a) for i, a in cap_refs]
             grads = fn([x.data for x in leaves], caps, dy_arr)
         except Exception:
+            _DAG_BWD_CACHE.stats.record_trace(time.perf_counter() - t0)
             _DAG_BWD_CACHE[key] = False
-            while len(_DAG_BWD_CACHE) > 256:
-                del _DAG_BWD_CACHE[next(iter(_DAG_BWD_CACHE))]
             return None
+        _DAG_BWD_CACHE.stats.record_trace(time.perf_counter() - t0)
         holder.clear()  # unpin the recorded instances
         ent = (fn, meta["order"])
         _DAG_BWD_CACHE[key] = ent
-        while len(_DAG_BWD_CACHE) > 256:
-            del _DAG_BWD_CACHE[next(iter(_DAG_BWD_CACHE))]
         return _dag_pairs(leaves, ent[1], grads)
     fn, order = ent
     caps = [getattr(ops[i], a) for i, a in cap_refs]
@@ -659,9 +684,18 @@ def _dag_backward(y, dy_arr):
 
 def _dag_pairs(leaves, order, grads):
     # iter_backward already consolidates duplicate-param grads into
-    # one pair, so `order` holds unique leaf indices.
-    return [(leaves[li], tensor_mod.from_raw(g, leaves[li].device))
-            for li, g in zip(order, grads)]
+    # one pair, so `order` holds unique leaf indices. The grad arrays
+    # are fresh outputs of the replay jit (jit outputs never alias
+    # inputs), so nothing else can hold their buffers: mark them
+    # donatable — the fused optimizer update may consume them in
+    # place (opt._fused_eager_update_all) instead of keeping a dead
+    # copy alive across the update.
+    out = []
+    for li, g in zip(order, grads):
+        t = tensor_mod.from_raw(g, leaves[li].device)
+        t._donatable = True
+        out.append((leaves[li], t))
+    return out
 
 
 # ===========================================================================
@@ -1884,7 +1918,11 @@ _Pooling2d.cache_key = lambda self: (
 def _dag_cfg_smce(op):
     from .ops import pallas_kernels as _pk
 
-    return (bool(_pk.enabled()),)
+    # _interpret() is folded in (here and in the Dropout/Attention
+    # keys) even though today it is fixed per process by
+    # jax.default_backend(): a future runtime-togglable interpret flag
+    # must retrace, not replay the wrong kernel tier from cache.
+    return (bool(_pk.enabled()), bool(_pk._interpret()))
 
 
 def _dag_cfg_dropout(op):
@@ -1895,8 +1933,11 @@ def _dag_cfg_dropout(op):
     from .ops import pallas_kernels as _pk
 
     # the explicit key is the capture: replay reproduces the exact
-    # eager mask from it, with no device-chain side effect
-    return (op.ratio, bool(training), bool(_pk.dropout_enabled()))
+    # eager mask from it, with no device-chain side effect.
+    # _interpret() gates whether the Pallas tier actually engages
+    # (forward checks both), so it is part of the kernel-tier config.
+    return (op.ratio, bool(training), bool(_pk.dropout_enabled()),
+            bool(_pk._interpret()))
 
 
 def _dag_cfg_bn(op):
@@ -1922,7 +1963,8 @@ def _dag_cfg_attention(op):
         return None
     from .ops import pallas_kernels as _pk
 
-    return (op.causal, op.scale, op.axis_name, bool(_pk.enabled()))
+    return (op.causal, op.scale, op.axis_name, bool(_pk.enabled()),
+            bool(_pk._interpret()))
 
 
 _DAG_SPECS.update({

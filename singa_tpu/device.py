@@ -48,6 +48,11 @@ __all__ = [
     "create_tpu_devices",
     "get_default_device",
     "enable_lazy_alloc",  # no-op parity shim
+    # Eager hot-path config (singa_tpu.stats owns the state):
+    "set_dag_cache_capacity",
+    "set_dag_cache_policy",
+    "set_buffer_donation",
+    "get_eager_config",
     # Migration aliases (reference names):
     "create_cuda_gpu",
     "create_cuda_gpu_on",
@@ -343,6 +348,50 @@ def create_tpu_devices(num: int):
 
 def enable_lazy_alloc(flag: bool) -> None:
     """Parity shim: reference toggles cnmem lazy allocation; PJRT owns HBM."""
+
+
+# ---------------------------------------------------------------------------
+# Eager hot-path config. The reference configures execution policy on
+# the device layer (EnableGraph, SetVerbosity); the TPU-native eager
+# cache knobs live on the same surface. State is owned by
+# `singa_tpu.stats` so autograd/opt read it without an import cycle.
+# ---------------------------------------------------------------------------
+def set_dag_cache_capacity(n: int) -> None:
+    """Max entries in the recorded-backward executable cache
+    (autograd._DAG_BWD_CACHE). Shrinking evicts immediately (negative
+    entries first). Default 256; size it above the working set of
+    distinct DAG shapes (e.g. the number of sequence-length buckets x
+    models sharing the process)."""
+    from . import stats
+
+    stats.configure(dag_cache_capacity=n)
+
+
+def set_dag_cache_policy(policy: str) -> None:
+    """"lru" (default: hits promote, hot executables survive cycling
+    workloads) or "fifo" (insertion order only — the pre-observability
+    behavior, kept for A/B measurement; see
+    benchmarks/eager_overhead.py)."""
+    from . import stats
+
+    stats.configure(dag_cache_policy=policy)
+
+
+def set_buffer_donation(flag: bool) -> None:
+    """Donate param/momentum/grad buffers into the jitted optimizer
+    update and the graph-mode step (default on). Read at executable
+    build time: an already-compiled graph-mode step keeps its donation
+    contract until the model is re-compile()d."""
+    from . import stats
+
+    stats.configure(buffer_donation=flag)
+
+
+def get_eager_config() -> dict:
+    """Snapshot of the eager hot-path config knobs."""
+    from . import stats
+
+    return stats.get_config()
 
 
 # ---------------------------------------------------------------------------

@@ -33,7 +33,7 @@ from typing import Dict, List, Optional
 import jax
 import numpy as np
 
-from . import autograd, tensor as tensor_mod
+from . import autograd, stats as stats_mod, tensor as tensor_mod
 from .layer import Layer
 from .tensor import Tensor
 
@@ -255,6 +255,13 @@ class Model(Layer):
         out = self.forward(x)
         l = self.loss(out, y)
         self.optim(l)
+        # Step accounting for cache observability: retraces/step after
+        # warmup is the retrace-storm signal (stats.cache_stats()).
+        # Counted here (not in __call__) so user models overriding
+        # train_one_batch wholesale — the reference's idiom — opt out
+        # explicitly rather than silently, and the graph path counts
+        # in _JitStep.__call__ where a trace is one step too.
+        stats_mod.count_train_step()
         return out, l
 
     def __call__(self, *args, **kwargs):
@@ -290,6 +297,15 @@ class Model(Layer):
         if self._use_graph:
             return self.train_one_batch_graph(*batch)
         return self.train_one_batch(*batch)
+
+    def cache_stats(self):
+        """Snapshot of every executable-cache's counters
+        (`singa_tpu.stats.cache_stats()`): the DAG backward cache, the
+        per-op executable cache, and the fused-optimizer cache, plus
+        the global train-step count. The numbers are process-global
+        (caches are shared across models by design — two models with
+        identical DAG structure share executables)."""
+        return stats_mod.cache_stats()
 
     def forward_graph(self, *xs: Tensor):
         """Run `forward` as one compiled XLA program (the eval-path
@@ -628,7 +644,10 @@ class _JitStep:
         # opt state) is stable from step one. step_counter is traced
         # (not static) so LR schedules don't retrigger compilation.
         self._ensure_opt_slots()
-        return jax.jit(step_fn, donate_argnums=(0, 1, 2, 3),
+        # Donation honors the eager-config knob at build time
+        # (device.set_buffer_donation); re-compile() to re-arm.
+        donate = (0, 1, 2, 3) if stats_mod.donation_enabled() else ()
+        return jax.jit(step_fn, donate_argnums=donate,
                        **self._jit_kwargs(batch_arrays))
 
     def _jit_kwargs(self, batch_arrays):
@@ -707,6 +726,7 @@ class _JitStep:
         out, new_p, new_s, new_o, new_key = self._compiled(
             pvals, svals, ovals, key, step, batch_arrays
         )
+        stats_mod.count_train_step()
         if profiling:
             jax.block_until_ready(new_key)
             dt = time.perf_counter() - t0

@@ -13,14 +13,22 @@ whole-step `jax.jit` program built by `Model.compile(use_graph=True)`
 """
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import autograd, tensor as tensor_mod
+from . import autograd, stats as stats_mod, tensor as tensor_mod
 from .tensor import Tensor
+
+# Shared counters over every optimizer instance's fused-update cache
+# (the caches themselves are per-instance; the observability question
+# — "is the process retracing optimizer updates every step?" — is
+# process-global). Snapshot via singa_tpu.stats.cache_stats().
+_FUSED_STATS = stats_mod.CacheStats("fused_opt")
+stats_mod.register_cache("fused_opt", _FUSED_STATS)
 
 
 class DecayScheduler:
@@ -198,10 +206,13 @@ class Optimizer:
         # shape/dtype tuple) is itself memoized per param set: building
         # it fresh each step (N sorted() calls + 2N str(dtype)) was
         # ~25% of eager step time. The validation tuple is cheap
-        # attribute reads; slot-name sets only ever grow once (absent
-        # -> the subclass's fixed set on first apply), so a length
-        # match means the names match.
-        val = tuple((len(self.states.get(pid, ())), p.data.dtype,
+        # attribute reads; it must be NAME-sensitive, not count-
+        # sensitive — an optimizer whose slot set swaps one name for
+        # another at equal count (a hyper toggle) must invalidate the
+        # memoized names_list/stat_key, not silently fetch the wrong
+        # slots. tuple(dict) (insertion-order key tuple, <=2 names)
+        # costs about the same as len() did.
+        val = tuple((tuple(self.states.get(pid, ())), p.data.dtype,
                      p.data.shape) for (p, _), pid in
                     zip(prepared, pids_key))
         smemo = self.__dict__.setdefault("_fused_static", {})
@@ -226,21 +237,46 @@ class Optimizer:
         # Donation requires every donated buffer to be unique AND not
         # also appear as a non-donated argument; tied weights that
         # alias one array across Tensor objects would otherwise crash
-        # with a duplicate-donation error.
+        # with a duplicate-donation error. The whole path is gated on
+        # the `buffer_donation` eager-config knob
+        # (device.set_buffer_donation) — part of the donate cache key,
+        # so toggling retraces instead of reusing the wrong aliasing.
         flat_args = values + gs + [a for sl in slots for a in sl]
-        donate = len({id(a) for a in flat_args}) == len(flat_args)
-        key = (self._hyper_key(), donate, do_clip, stat_key)
+        donate = stats_mod.donation_enabled() and (
+            len({id(a) for a in flat_args}) == len(flat_args))
+        # Grad buffers are additionally donatable only on the
+        # whole-step path (`clip=True`: the pairs are internal to
+        # backward_and_update, never handed to the caller) AND when
+        # every grad carries the recorded-backward provenance flag
+        # (autograd._dag_pairs: fresh replay-jit outputs nothing else
+        # references). A user-held grad Tensor, or a walk-path
+        # cotangent that may alias the cached root ones, must never be
+        # invalidated under the user.
+        donate_grads = donate and clip and all(
+            isinstance(g, Tensor) and getattr(g, "_donatable", False)
+            for _, g in pairs)
+        key = (self._hyper_key(), donate, donate_grads, do_clip,
+               stat_key)
         cache = self.__dict__.setdefault("_fused_cache", {})
         ent = cache.get(key)
-        if ent is None:
+        created = ent is None
+        if created:
+            _FUSED_STATS.misses += 1
             # Evict superseded entries for the same param set (the
             # pre-slot-creation executable from step 1 is dead weight
             # once slots exist — its closure pins the param list), and
             # bound the cache overall (an optimizer reused across
             # rebuilt models would otherwise pin dead params forever).
+            # Entries that differ ONLY in the donation flags (key[1:3])
+            # are siblings, not superseded: a workload alternating
+            # recorded-backward and walk grads flips donate_grads per
+            # step, and evicting the other variant would retrace the
+            # fused update on every flip.
             for k in [k for k, (_, _, pk_) in cache.items()
-                      if pk_ == pids_key and k != key]:
+                      if pk_ == pids_key and k != key
+                      and not (k[0] == key[0] and k[3:] == key[3:])]:
                 del cache[k]
+                _FUSED_STATS.evictions_positive += 1
             # The same-pids eviction above already bounds the cache to
             # ONE entry per param set, so steady state is 1 entry for
             # batched updates or N for DistOpt's per-param streaming —
@@ -250,6 +286,7 @@ class Optimizer:
             # entries every step and retrace everything (FIFO thrash).
             while len(cache) >= 4096:
                 del cache[next(iter(cache))]
+                _FUSED_STATS.evictions_positive += 1
             params = [p for p, _ in prepared]
             pids = [id(p) for p in params]
             meta = {}
@@ -288,15 +325,37 @@ class Optimizer:
                             self.states[pid] = saved[pid]
 
             # Donate the param/slot buffers (same contract as the
-            # graph-mode _JitStep): XLA updates them in place, halving
-            # the update's memory traffic.  Anything holding a stale
-            # reference (checkpoint snapshots fork with jnp.copy first)
-            # would error loudly on use-after-donate.
-            ent = (jax.jit(pure, donate_argnums=(0, 3) if donate
-                           else ()), meta, pids_key)
+            # graph-mode _JitStep) — plus the grad buffers on the
+            # flagged whole-step path: XLA updates them in place,
+            # halving the update's memory traffic.  Anything holding a
+            # stale reference (checkpoint snapshots fork with jnp.copy
+            # first) would error loudly on use-after-donate.
+            argnums = () if not donate else (
+                (0, 1, 3) if donate_grads else (0, 3))
+            ent = (jax.jit(pure, donate_argnums=argnums), meta,
+                   pids_key)
             cache[key] = ent
+        else:
+            _FUSED_STATS.hits += 1
         fn, meta, _ = ent
-        new_values, new_slots = fn(values, gs, self.step_counter, slots)
+        if created:
+            # First invocation = the trace+compile; steady-state hits
+            # replay the executable. Donated-but-unaliased buffers are
+            # deliberate here (grads outnumber outputs; donation still
+            # frees them early), so jax's lowering warning about them
+            # is noise.
+            import warnings
+
+            t0 = time.perf_counter()
+            with warnings.catch_warnings():
+                warnings.filterwarnings(
+                    "ignore", message=".*donated buffers were not usable.*")
+                new_values, new_slots = fn(values, gs,
+                                           self.step_counter, slots)
+            _FUSED_STATS.record_trace(time.perf_counter() - t0)
+        else:
+            new_values, new_slots = fn(values, gs, self.step_counter,
+                                       slots)
         for (p, _), onm, nv, ns in zip(prepared, meta["names"],
                                        new_values, new_slots):
             p.data = nv

@@ -1,0 +1,319 @@
+"""Eager hot-path cache observability + policy config.
+
+The eager path leans on three executable caches (SURVEY §7 hard-part
+#4): the recorded-backward DAG cache (`autograd._DAG_BWD_CACHE`), the
+per-op executable cache (`autograd._EXEC_CACHE`), and the fused
+optimizer-update cache (`opt.Optimizer._fused_cache`). A retrace storm
+in any of them silently turns a µs-dispatch step into a ms-trace step;
+this module makes that visible instead of guessable:
+
+  - `CacheStats` — per-cache hit/miss/evict/retrace counters plus
+    trace-time accounting;
+  - `TieredLRUCache` — the DAG backward cache's container: LRU with
+    hit promotion (a hot executable cycling among >capacity shapes
+    stays resident) and *tiered* eviction — negative entries (a trace
+    that failed once; cheap to rediscover) are evicted before positive
+    compiled executables (expensive to re-pay);
+  - `cache_stats()` — one snapshot dict over every registered cache,
+    printed by `benchmarks/eager_overhead.py` and plumbed through
+    `Model.cache_stats()`;
+  - the eager config knobs (`dag_cache_capacity`, `dag_cache_policy`,
+    `buffer_donation`), owned here so `device`, `autograd`, and `opt`
+    can share them without an import cycle. User-facing setters live
+    on `singa_tpu.device` (the reference's config surface).
+
+µ-cuDNN (arXiv:1804.04806) and TVM (arXiv:1802.04799) make the same
+point from both sides: framework-level caching decisions around a
+fixed kernel library dominate end-to-end throughput, and compiled
+artifacts must be cached on program structure — so the cache layer is
+a first-class, observable subsystem here, not an implementation detail.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Callable, Dict, Optional
+
+__all__ = [
+    "CacheStats",
+    "TieredLRUCache",
+    "cache_stats",
+    "reset_cache_stats",
+    "register_cache",
+    "configure",
+    "get_config",
+    "donation_enabled",
+    "count_train_step",
+]
+
+
+# ---------------------------------------------------------------------------
+# Eager policy config (user-facing setters: singa_tpu.device).
+# ---------------------------------------------------------------------------
+_CONFIG: Dict = {
+    # Max entries in the recorded-backward DAG cache (was a hard-coded
+    # 256 FIFO before this subsystem existed).
+    "dag_cache_capacity": 256,
+    # "lru": promote on hit (default). "fifo": insertion order only —
+    # kept for A/B measurement (benchmarks/eager_overhead.py shows the
+    # retrace storm it causes on cycling workloads).
+    "dag_cache_policy": "lru",
+    # Donate param/momentum/grad buffers into the jitted optimizer
+    # update (and the graph-mode step): XLA reuses the memory in place
+    # instead of round-tripping fresh allocations.
+    "buffer_donation": True,
+}
+
+
+def configure(**kw) -> Dict:
+    """Update eager-config knobs; returns the live config dict."""
+    for k, v in kw.items():
+        if k not in _CONFIG:
+            raise KeyError(
+                f"unknown eager config key {k!r}; known: {sorted(_CONFIG)}")
+        if k == "dag_cache_capacity":
+            v = int(v)
+            if v < 1:
+                raise ValueError("dag_cache_capacity must be >= 1")
+        elif k == "dag_cache_policy":
+            if v not in ("lru", "fifo"):
+                raise ValueError("dag_cache_policy must be 'lru' or 'fifo'")
+        else:
+            v = bool(v)
+        _CONFIG[k] = v
+    # capacity shrink applies immediately, not on next insert
+    for cache in _CACHES.values():
+        if isinstance(cache, TieredLRUCache):
+            cache.trim()
+    return _CONFIG
+
+
+def get_config() -> Dict:
+    return dict(_CONFIG)
+
+
+def donation_enabled() -> bool:
+    return _CONFIG["buffer_donation"]
+
+
+class CacheStats:
+    """Counters for one executable cache.
+
+    `retraces` counts traces actually paid (every miss that went on to
+    trace, including failed traces that became negative entries);
+    `trace_time_s` is the wall time those traces cost — the number to
+    watch for retrace storms. `clear()`ing a cache does NOT reset its
+    counters (they describe the process, not the container); use
+    `reset_cache_stats()`.
+    """
+
+    __slots__ = ("name", "hits", "negative_hits", "misses",
+                 "evictions_negative", "evictions_positive", "retraces",
+                 "trace_time_s", "uncached_fallbacks")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.reset()
+
+    def reset(self) -> None:
+        self.hits = 0
+        self.negative_hits = 0
+        self.misses = 0
+        self.evictions_negative = 0
+        self.evictions_positive = 0
+        self.retraces = 0
+        self.trace_time_s = 0.0
+        self.uncached_fallbacks = 0
+
+    def record_trace(self, seconds: float) -> None:
+        self.retraces += 1
+        self.trace_time_s += seconds
+
+    def snapshot(self) -> Dict:
+        return {
+            "hits": self.hits,
+            "negative_hits": self.negative_hits,
+            "misses": self.misses,
+            "evictions": self.evictions_negative + self.evictions_positive,
+            "evictions_negative": self.evictions_negative,
+            "evictions_positive": self.evictions_positive,
+            "retraces": self.retraces,
+            "trace_time_s": round(self.trace_time_s, 6),
+            "uncached_fallbacks": self.uncached_fallbacks,
+        }
+
+
+_MISSING = object()
+
+
+class TieredLRUCache:
+    """LRU cache with tiered eviction for trace executables.
+
+    Entries matching `negative` (default: the literal `False` the DAG
+    cache stores for trace-once-failed keys) form the LOW tier: they
+    are never promoted on hit and are evicted before any positive
+    entry — a negative entry only saves a doomed re-trace attempt,
+    while a positive entry is a paid-for compiled executable.
+
+    `capacity`/`policy` of None read the shared eager config live, so
+    `device.set_dag_cache_capacity()` applies without rebuild; pass
+    ints/strings for a fixed-config cache (unit tests).
+
+    Deliberately dict-shaped (`get`/`[]=`/`del`/`len`/`clear`/`in`):
+    existing callers and tests treat the DAG cache as a dict.
+    """
+
+    def __init__(self, name: str, capacity: Optional[int] = None,
+                 policy: Optional[str] = None,
+                 negative: Callable = lambda v: v is False,
+                 stats: Optional[CacheStats] = None):
+        self._od: OrderedDict = OrderedDict()
+        self._neg: Dict = {}  # negative keys, insertion-ordered
+        self._capacity = capacity
+        self._policy = policy
+        self._is_negative = negative
+        self.stats = stats if stats is not None else CacheStats(name)
+        self.name = name
+
+    @property
+    def capacity(self) -> int:
+        return (self._capacity if self._capacity is not None
+                else _CONFIG["dag_cache_capacity"])
+
+    @property
+    def policy(self) -> str:
+        return (self._policy if self._policy is not None
+                else _CONFIG["dag_cache_policy"])
+
+    # -- mapping surface --------------------------------------------------
+    def get(self, key, default=None):
+        ent = self._od.get(key, _MISSING)
+        if ent is _MISSING:
+            self.stats.misses += 1
+            return default
+        if self._is_negative(ent):
+            self.stats.negative_hits += 1
+            return ent
+        self.stats.hits += 1
+        if self.policy == "lru":
+            self._od.move_to_end(key)
+        return ent
+
+    def __setitem__(self, key, value) -> None:
+        od = self._od
+        if key in od:
+            self._neg.pop(key, None)
+            od.move_to_end(key)  # re-insert semantics for both policies
+        od[key] = value
+        if self._is_negative(value):
+            self._neg[key] = True
+        self.trim(protect=key)
+
+    def __delitem__(self, key) -> None:
+        del self._od[key]
+        self._neg.pop(key, None)
+
+    def pop(self, key, *default):
+        self._neg.pop(key, None)
+        return self._od.pop(key, *default)
+
+    def __contains__(self, key) -> bool:
+        return key in self._od
+
+    def __len__(self) -> int:
+        return len(self._od)
+
+    def __iter__(self):
+        return iter(self._od)
+
+    def clear(self) -> None:
+        """Drop all entries. Counters survive (see CacheStats)."""
+        self._od.clear()
+        self._neg.clear()
+
+    # -- eviction ---------------------------------------------------------
+    def trim(self, protect=None) -> None:
+        """Evict down to capacity: oldest negative first, else oldest
+        (LRU) entry. The entry being inserted (`protect`) is never the
+        victim — otherwise a negative admitted to a positives-full
+        cache would evict ITSELF, and the doomed trace it memoizes
+        would be re-paid every step."""
+        cap = self.capacity
+        while len(self._od) > cap:
+            victim = next((k for k in self._neg if k != protect), None)
+            if victim is not None:
+                del self._neg[victim]
+                self._od.pop(victim, None)
+                self.stats.evictions_negative += 1
+                continue
+            victim = next((k for k in self._od if k != protect), None)
+            if victim is None:
+                return  # capacity 1 holding only the protected entry
+            self._od.pop(victim)
+            self._neg.pop(victim, None)
+            self.stats.evictions_positive += 1
+
+    def snapshot(self) -> Dict:
+        out = self.stats.snapshot()
+        out["size"] = len(self._od)
+        out["negative_size"] = len(self._neg)
+        out["capacity"] = self.capacity
+        out["policy"] = self.policy
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Registry + global counters
+# ---------------------------------------------------------------------------
+_CACHES: Dict[str, object] = {}  # name -> TieredLRUCache | CacheStats
+_COUNTERS: Dict[str, int] = {"train_steps": 0}
+
+
+def register_cache(name: str, cache) -> None:
+    """Register anything with a `.snapshot() -> dict` for cache_stats()."""
+    _CACHES[name] = cache
+
+
+def count_train_step() -> None:
+    """One train step ran (eager or graph). Lets observability report
+    per-step rates (retraces/step is the retrace-storm smoke signal)."""
+    _COUNTERS["train_steps"] += 1
+
+
+def cache_stats() -> Dict:
+    """Snapshot every registered cache's counters.
+
+    Keys (per cache): hits / negative_hits / misses / evictions
+    (+ negative/positive split) / retraces / trace_time_s, plus
+    size/capacity/policy for bounded caches. `train_steps` counts
+    `Model.train_one_batch` invocations since process start (or the
+    last `reset_cache_stats`), so `retraces / train_steps` after
+    warmup ≈ 0 is the healthy steady state.
+    """
+    out = {name: c.snapshot() for name, c in sorted(_CACHES.items())}
+    out["train_steps"] = _COUNTERS["train_steps"]
+    return out
+
+
+def reset_cache_stats() -> None:
+    """Zero all counters (entries stay cached — resetting observability
+    must not force retraces)."""
+    for c in _CACHES.values():
+        st = c.stats if isinstance(c, TieredLRUCache) else c
+        if isinstance(st, CacheStats):
+            st.reset()
+    for k in _COUNTERS:
+        _COUNTERS[k] = 0
+
+
+def format_stats(snapshot: Optional[Dict] = None) -> str:
+    """One `cache_stats <name> k=v ...` line per cache — the stable
+    grep-able form emitted by benchmarks/eager_overhead.py."""
+    snap = cache_stats() if snapshot is None else snapshot
+    lines = []
+    for name, s in snap.items():
+        if not isinstance(s, dict):
+            continue
+        kv = " ".join(f"{k}={s[k]}" for k in sorted(s))
+        lines.append(f"cache_stats {name} {kv}")
+    lines.append(f"cache_stats train_steps={snap.get('train_steps', 0)}")
+    return "\n".join(lines)

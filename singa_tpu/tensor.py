@@ -86,6 +86,10 @@ class Tensor:
         "creator",
         "creator_index",  # which output of `creator` this tensor is
         "name",
+        # provenance flag set by autograd._dag_pairs: the wrapped array
+        # is a fresh recorded-backward output nothing else references,
+        # so the fused optimizer update may donate its buffer
+        "_donatable",
     )
 
     def __init__(

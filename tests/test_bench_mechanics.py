@@ -50,3 +50,37 @@ def test_unknown_stage_is_loud():
     assert proc.returncode != 0
     assert result is not None and result["ok"] is False
     assert "unknown stage" in result["error"]
+
+
+def test_eager_overhead_emits_stats_line_and_final_json():
+    """benchmarks/eager_overhead.py output contract: one
+    `cache_stats <name> ...` line per executable cache plus ONE final
+    JSON line (the same last-JSON-line shape bench.py stages emit and
+    tools/onchip_runner.sh / fold_onchip.py parse), carrying the
+    LRU-vs-FIFO retrace demo numbers."""
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(_ROOT, "benchmarks", "eager_overhead.py"),
+         "--cpu", "--quick"],
+        capture_output=True, text=True, timeout=600, cwd=_ROOT,
+        env=dict(os.environ),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = proc.stdout.splitlines()
+    for cache in ("dag_backward", "fused_opt", "op_exec"):
+        assert any(ln.startswith(f"cache_stats {cache} ")
+                   for ln in lines), f"no cache_stats line for {cache}"
+    # same parse the runner tooling applies: LAST JSON line wins
+    last = None
+    for line in lines:
+        line = line.strip()
+        if line.startswith("{") and line.endswith("}"):
+            last = json.loads(line)
+    assert last is not None, "no final JSON line"
+    assert last["ok"] is True
+    assert last["eager_step_ms"] > 0 and last["graph_step_ms"] > 0
+    demo = last["demo"]
+    # the acceptance behavior: hot retraces flat under LRU after
+    # warmup, growing under the legacy FIFO policy
+    assert demo["lru"]["steady_hot_retraces_per_round"] == 0
+    assert demo["fifo"]["steady_hot_retraces_per_round"] > 0

@@ -1,0 +1,300 @@
+"""Eager cache layer: tiered LRU eviction, observability counters,
+and buffer donation (singa_tpu.stats + the autograd/opt wiring).
+
+The recorded-backward cache is the hottest cache in the codebase;
+these tests pin (a) the LRU/tiered eviction semantics that keep hot
+executables resident on cycling workloads, (b) the cache_stats()
+counter contract benchmarks and future PRs read, and (c) that buffer
+donation is a pure memory optimization — parameter updates are
+bit-identical with it on or off.
+"""
+import numpy as np
+import pytest
+
+from singa_tpu import autograd, device, layer, model, opt, stats, tensor
+
+
+@pytest.fixture(autouse=True)
+def _restore_eager_config():
+    """Every test here twiddles global knobs; leave the process as
+    found (capacity shrink evicts other tests' entries otherwise)."""
+    saved = device.get_eager_config()
+    yield
+    stats.configure(**saved)
+    autograd.set_dag_backward(True)
+
+
+# ---------------------------------------------------------------------------
+# TieredLRUCache unit semantics
+# ---------------------------------------------------------------------------
+def test_lru_promotion_keeps_hot_entry_past_capacity():
+    c = stats.TieredLRUCache("t", capacity=2, policy="lru")
+    c["hot"] = "H"
+    c["c1"] = "A"
+    assert c.get("hot") == "H"  # promote
+    c["c2"] = "B"               # over capacity: evicts LRU = c1
+    assert "hot" in c and "c1" not in c and "c2" in c
+    assert c.stats.evictions_positive == 1
+
+
+def test_fifo_policy_does_not_promote():
+    c = stats.TieredLRUCache("t", capacity=2, policy="fifo")
+    c["hot"] = "H"
+    c["c1"] = "A"
+    assert c.get("hot") == "H"  # hit, but no reorder under fifo
+    c["c2"] = "B"               # evicts insertion-oldest = hot
+    assert "hot" not in c and "c1" in c
+
+
+def test_negative_entries_evict_before_positive():
+    c = stats.TieredLRUCache("t", capacity=2, policy="lru")
+    c["p1"] = "exe"
+    c["neg"] = False
+    c["p2"] = "exe2"  # over capacity: negative goes first, NOT the
+    assert "neg" not in c          # older positive p1
+    assert "p1" in c and "p2" in c
+    assert c.stats.evictions_negative == 1
+    assert c.stats.evictions_positive == 0
+    # with no negatives left, oldest positive is the victim
+    c["p3"] = "exe3"
+    assert "p1" not in c
+    assert c.stats.evictions_positive == 1
+
+
+def test_inserted_negative_not_its_own_victim():
+    """A negative admitted to a positives-full cache must evict the
+    LRU positive, not itself — else the doomed trace it memoizes is
+    re-paid on every step."""
+    c = stats.TieredLRUCache("t", capacity=2, policy="lru")
+    c["p1"] = "exe"
+    c["p2"] = "exe2"
+    c["neg"] = False
+    assert "neg" in c, "negative evicted itself on insert"
+    assert "p1" not in c and "p2" in c
+    # ...and the resident negative is still first out on the NEXT insert
+    c["p3"] = "exe3"
+    assert "neg" not in c and "p2" in c and "p3" in c
+
+
+def test_counters_hit_miss_negative():
+    c = stats.TieredLRUCache("t", capacity=4, policy="lru")
+    assert c.get("absent") is None
+    c["k"] = "v"
+    c["n"] = False
+    assert c.get("k") == "v"
+    assert c.get("n") is False
+    s = c.snapshot()
+    assert s["misses"] == 1 and s["hits"] == 1
+    assert s["negative_hits"] == 1
+    assert s["size"] == 2 and s["negative_size"] == 1
+    assert s["capacity"] == 4 and s["policy"] == "lru"
+
+
+def test_clear_drops_entries_keeps_counters():
+    c = stats.TieredLRUCache("t", capacity=2)
+    c["k"] = "v"
+    c.get("k")
+    c.clear()
+    assert len(c) == 0 and c.stats.hits == 1
+
+
+def test_capacity_config_applies_immediately():
+    for i in range(6):
+        autograd._DAG_BWD_CACHE[("__cap_test__", i)] = "x"
+    before = len(autograd._DAG_BWD_CACHE)
+    assert before >= 6
+    device.set_dag_cache_capacity(2)
+    assert len(autograd._DAG_BWD_CACHE) == 2
+    # restore happens in the fixture; drop the probe keys now
+    autograd._DAG_BWD_CACHE.clear()
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        device.set_dag_cache_policy("mru")
+    with pytest.raises(ValueError):
+        device.set_dag_cache_capacity(0)
+    with pytest.raises(KeyError):
+        stats.configure(bogus_knob=1)
+
+
+# ---------------------------------------------------------------------------
+# Integration: the real recorded-backward cache + counters
+# ---------------------------------------------------------------------------
+class _MLP(model.Model):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = layer.Linear(16)
+        self.r = layer.ReLU()
+        self.fc2 = layer.Linear(4)
+
+    def forward(self, x):
+        return self.fc2(self.r(self.fc1(x)))
+
+
+def _mk(rs, bs):
+    x = tensor.from_numpy(rs.randn(bs, 12).astype(np.float32))
+    y = tensor.from_numpy(rs.randint(0, 4, bs).astype(np.int32))
+    return x, y
+
+
+def _fresh_model(x, optimizer=None):
+    dev = device.get_default_device()
+    dev.SetRandSeed(7)
+    m = _MLP()
+    m.set_optimizer(optimizer or opt.SGD(lr=0.05, momentum=0.9))
+    m.compile([x], is_train=True, use_graph=False)
+    return m
+
+
+def _dag_counter(name):
+    return stats.cache_stats()["dag_backward"][name]
+
+
+def test_cache_stats_counters_move_on_training():
+    autograd._DAG_BWD_CACHE.clear()
+    rs = np.random.RandomState(1)
+    x, y = _mk(rs, 8)
+    m = _fresh_model(x)
+    before = stats.cache_stats()
+    for _ in range(4):
+        m(x, y)
+    after = stats.cache_stats()
+    d0, d1 = before["dag_backward"], after["dag_backward"]
+    # one distinct DAG shape: 1 miss+retrace, then hits
+    assert d1["misses"] == d0["misses"] + 1
+    assert d1["retraces"] == d0["retraces"] + 1
+    assert d1["hits"] >= d0["hits"] + 3
+    assert d1["trace_time_s"] > d0["trace_time_s"]
+    f0, f1 = before["fused_opt"], after["fused_opt"]
+    # slot creation on step 1 supersedes the step-0 executable: 2
+    # misses, then steady hits
+    assert f1["misses"] >= f0["misses"] + 1
+    assert f1["hits"] >= f0["hits"] + 2
+    assert after["train_steps"] == before["train_steps"] + 4
+    # the Model-level plumbing returns the same snapshot
+    assert m.cache_stats()["train_steps"] == after["train_steps"]
+
+
+def test_hot_dag_survives_cycling_past_capacity():
+    """The acceptance scenario in miniature: >capacity distinct DAG
+    shapes with a hot subset — LRU keeps the hot executable, FIFO
+    re-pays its trace."""
+    rs = np.random.RandomState(2)
+    hot = _mk(rs, 4)
+    colds = [_mk(rs, 8), _mk(rs, 16)]
+    for policy, expect_hot_retrace in (("lru", 0), ("fifo", 1)):
+        device.set_dag_cache_policy(policy)
+        device.set_dag_cache_capacity(2)
+        autograd._DAG_BWD_CACHE.clear()
+        m = _fresh_model(hot[0])
+        m(*hot)                 # trace hot
+        m(*colds[0])            # fill capacity
+        m(*hot)                 # lru: promote; fifo: plain hit
+        r0 = _dag_counter("retraces")
+        m(*colds[1])            # overflow: evicts per policy
+        m(*hot)
+        hot_retraces = _dag_counter("retraces") - r0 - 1  # -1: cold trace
+        assert hot_retraces == expect_hot_retrace, (
+            f"policy={policy}: hot entry "
+            f"{'evicted' if hot_retraces else 'kept'}")
+
+
+def test_unsafe_dag_counts_fallback():
+    autograd._DAG_BWD_CACHE.clear()
+    rs = np.random.RandomState(3)
+    x, y = _mk(rs, 4)
+    m = _fresh_model(x, optimizer=opt.SGD(lr=0.0))
+    before = _dag_counter("uncached_fallbacks")
+    # keyless Dropout draws from the device chain: structurally unsafe
+    h = autograd.Dropout(0.5)(m.fc1(x))
+    l = autograd.softmax_cross_entropy(m.fc2(m.r(h)), y)
+    list(autograd.iter_backward(l))
+    assert _dag_counter("uncached_fallbacks") == before + 1
+    assert len(autograd._DAG_BWD_CACHE) == 0
+
+
+# ---------------------------------------------------------------------------
+# Buffer donation: pure memory optimization, bit-identical math
+# ---------------------------------------------------------------------------
+def _train_params(donate, opt_fn, steps=6):
+    device.set_buffer_donation(donate)
+    autograd._DAG_BWD_CACHE.clear()
+    rs = np.random.RandomState(5)
+    x, y = _mk(rs, 8)
+    m = _fresh_model(x, optimizer=opt_fn())
+    for _ in range(steps):
+        m(x, y)
+    return [np.array(p.to_numpy()) for p in m.param_tensors()]
+
+
+@pytest.mark.parametrize("opt_fn", [
+    lambda: opt.SGD(lr=0.05, momentum=0.9),
+    lambda: opt.Adam(lr=0.01),
+], ids=["sgd-momentum", "adam"])
+def test_donation_bit_identical_updates(opt_fn):
+    on = _train_params(True, opt_fn)
+    off = _train_params(False, opt_fn)
+    assert len(on) == len(off) and len(on) > 0
+    for a, b in zip(on, off):
+        assert np.array_equal(a, b), "donation changed the math"
+
+
+def test_donation_default_on_and_toggle():
+    assert device.get_eager_config()["buffer_donation"] is True
+    device.set_buffer_donation(False)
+    assert device.get_eager_config()["buffer_donation"] is False
+
+
+def test_optimizer_slot_swap_invalidates_fused_static():
+    """ADVICE r5: a same-count slot-name swap must invalidate the
+    memoized names_list, not silently reuse stale slot fetch order."""
+    rs = np.random.RandomState(6)
+    p = tensor.from_numpy(rs.randn(4, 3).astype(np.float32))
+    p.requires_grad = p.stores_grad = True
+    g = rs.randn(4, 3).astype(np.float32)
+
+    class SwapOpt(opt.Optimizer):
+        def __init__(self):
+            super().__init__(lr=0.1)
+            self.slot_name = "a"
+
+        def apply(self, param, value, grad):
+            st = self.states.setdefault(id(param), {})
+            st.pop("a" if self.slot_name == "b" else "b", None)
+            buf = st.get(self.slot_name)
+            buf = grad if buf is None else buf + grad
+            st[self.slot_name] = buf
+            return value - self.lr_value * buf
+
+    o = SwapOpt()
+    o.update(p, g)   # creates slot "a"
+    o.update(p, g)   # memoizes names_list = ("a",) for this param set
+    # swap the slot name at equal count; this update still reads the
+    # pre-swap slot set ("a") and renames it inside apply
+    o.slot_name = "b"
+    o.update(p, g)
+    assert list(o.states[id(p)]) == ["b"], o.states[id(p)]
+    # the NEXT update sees slot set {"b"} at equal count: a stale
+    # count-keyed memo would fetch slot "a" (KeyError / wrong slots)
+    o.update(p, g)
+    assert list(o.states[id(p)]) == ["b"], o.states[id(p)]
+    assert np.isfinite(np.array(p.to_numpy())).all()
+
+
+def test_reset_cache_stats_zeroes_counters_keeps_entries():
+    rs = np.random.RandomState(9)
+    x, y = _mk(rs, 8)
+    autograd._DAG_BWD_CACHE.clear()
+    m = _fresh_model(x)
+    m(x, y)
+    assert len(autograd._DAG_BWD_CACHE) == 1
+    stats.reset_cache_stats()
+    snap = stats.cache_stats()
+    assert snap["dag_backward"]["retraces"] == 0
+    assert snap["train_steps"] == 0
+    assert len(autograd._DAG_BWD_CACHE) == 1, (
+        "resetting observability must not force retraces")
+    r0 = snap["dag_backward"]["retraces"]
+    m(x, y)  # still a hit: the executable survived the reset
+    assert stats.cache_stats()["dag_backward"]["retraces"] == r0

@@ -29,9 +29,21 @@ def json_lines(path):
                     pass
 
 
+# A later attempt's startup is recognizable: bench.py's stderr logger
+# stamps every line "[bench HH:MM:SS]" from stage start onward, and an
+# attempt that dies before the logger even starts (import error, early
+# kill) leaves a Python traceback. Plain trailing chatter (PJRT/absl
+# teardown after a SUCCESSFUL result — the logs merge stdout+stderr)
+# matches neither.
+_ATTEMPT_MARKERS = ("[bench ", "Traceback (most recent call last")
+
+
 def last_json(path):
-    """(last result, stale?) — stale when non-blank lines follow it
-    (a later attempt wrote output but never reached its result)."""
+    """(last result, stale?) — stale only when the trailing lines
+    after the last result contain an attempt-start/stage-banner
+    marker (a later attempt wrote output but never reached its
+    result). Post-result teardown noise from the same successful
+    attempt must not flag a good result [STALE]."""
     out, at = None, -1
     for obj, i in json_lines(path):
         out, at = obj, i
@@ -39,7 +51,8 @@ def last_json(path):
         return None, False
     with open(path, errors="replace") as f:
         trailing = [ln for ln in list(f)[at + 1:] if ln.strip()]
-    return out, bool(trailing)
+    stale = any(m in ln for ln in trailing for m in _ATTEMPT_MARKERS)
+    return out, stale
 
 
 def main():

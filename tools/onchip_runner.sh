@@ -91,16 +91,23 @@ for s in "${STAGES[@]}"; do
 done
 
 stage_ok() {
-    # bench.py stages: last JSON line must carry "ok": true.  The two
+    # bench.py stages: LAST JSON line in the attempt file must carry
+    # "ok": true.  Search the whole file, not a tail window — the log
+    # merges stdout+stderr, and JAX/interpreter teardown chatter after
+    # the result line must not turn a successful stage into a counted
+    # on-chip failure (3 of which permanently .skip it).  The two
     # pallas micro/tune scripts print no ok-line; rc==0 suffices there.
     # Parity additionally needs the TPU column: its tool exits 0 on a
     # CPU-only pass (tpu subprocess timeout lands in errors, not diffs),
     # so require the cross-device diff key like bench.py's orchestrator.
+    local last
     case "$1" in
         pallas_*) return 0 ;;
-        parity) tail -5 "$STATE/$1.out" |
-                grep '"ok": true' | grep -q '"cpu_graph_vs_tpu_graph":' ;;
-        *) tail -5 "$STATE/$1.out" | grep -q '"ok": true' ;;
+        parity) last=$(grep -a '^{.*}$' "$STATE/$1.out" | tail -1)
+                echo "$last" | grep '"ok": true' |
+                grep -q '"cpu_graph_vs_tpu_graph":' ;;
+        *) grep -a '^{.*}$' "$STATE/$1.out" | tail -1 |
+           grep -q '"ok": true' ;;
     esac
 }
 
