@@ -82,7 +82,17 @@ def _chip_peak(device_kind: str = ""):
 # ===========================================================================
 # Stages (run in a child process; parent enforces the deadline)
 # ===========================================================================
-def _setup_jax():
+def _setup_jax(xla_profile=None):
+    # XLA flag profiles must land in XLA_FLAGS before the backend
+    # client exists; stages apply them first thing in their subprocess
+    # (singa_tpu.device.set_xla_profile — import alone does not init a
+    # backend).
+    if xla_profile:
+        from singa_tpu import device as _dev
+
+        flags = _dev.set_xla_profile(xla_profile)
+        log(f"xla profile {xla_profile!r}: {' '.join(flags) or '(none)'}")
+
     import jax
 
     # BENCH_PLATFORM=cpu lets the staged bench run on the XLA CPU
@@ -199,7 +209,8 @@ def stage_smoke():
     print(json.dumps({"ok": True, "phases": phases}), flush=True)
 
 
-def stage_resnet(batch, steps, deadline_s, amp=False, remat=False):
+def stage_resnet(batch, steps, deadline_s, amp=False, remat=False,
+                 slot_dtype=None, bn_stats_dtype=None, xla_profile=None):
     """ResNet-50 synthetic throughput at one batch size.
 
     Timing is pipelined: enqueue `steps` train steps back-to-back and
@@ -212,7 +223,7 @@ def stage_resnet(batch, steps, deadline_s, amp=False, remat=False):
     runs in a real input pipeline.
     """
 
-    _setup_jax()
+    _setup_jax(xla_profile)
     sys.path.insert(0, os.path.join(HERE, "examples", "cnn"))
     sys.path.insert(0, os.path.join(HERE, "examples", "cnn", "model"))
     import resnet
@@ -227,6 +238,10 @@ def stage_resnet(batch, steps, deadline_s, amp=False, remat=False):
     tensor.set_matmul_precision("default")
     if amp:
         tensor.set_compute_dtype("bfloat16")
+    if bn_stats_dtype:
+        # byte diet: BN statistics at the compute dtype instead of the
+        # fp32 round-trip (BASELINE.md roofline byte lever)
+        device.set_bn_stats_dtype(bn_stats_dtype)
     if remat:
         # Rematerialize conv activations: ResNet-50 here is HBM-bound
         # (BASELINE.md roofline), so trading FLOPs for activation
@@ -236,7 +251,11 @@ def stage_resnet(batch, steps, deadline_s, amp=False, remat=False):
         _ag.set_remat(True)
 
     m = resnet.create_model(depth=50)
-    m.set_optimizer(opt.SGD(lr=0.1, momentum=0.9))
+    optimizer = opt.SGD(lr=0.1, momentum=0.9)
+    if slot_dtype:
+        # byte diet: half-width momentum storage, fp32 master math
+        optimizer.set_slot_dtype(slot_dtype)
+    m.set_optimizer(optimizer)
     # Synthetic inputs are generated ON the device: pushing the
     # host-numpy batch through the tunnel cost ~10 s at bs256 (154 MB)
     # of a window that historically lasts minutes.  Only the 8-byte
@@ -297,6 +316,11 @@ def stage_resnet(batch, steps, deadline_s, amp=False, remat=False):
            "step_ms": round(1e3 * med, 2),
            "remat": bool(remat),
            "precision": "bf16" if amp else "fp32",
+           # byte-diet matrix columns (tests/test_bench_mechanics.py
+           # pins these names; tools/fold_onchip.py renders them)
+           "slot_dtype": slot_dtype or "fp32",
+           "bn_stats_dtype": bn_stats_dtype or "fp32",
+           "xla_profile": xla_profile or "default",
            "compile_s": round(host_compile + first_step, 1),
            "loss": round(float(loss.to_numpy()), 3)}
     log(f"RESULT {out}")
@@ -404,6 +428,85 @@ def stage_lm(batch, seq, steps, deadline_s):
         "loss": round(float(loss.to_numpy()), 3)}), flush=True)
 
 
+def stage_bert(batch, seq, steps, deadline_s, slot_dtype=None,
+               size="base", xla_profile=None):
+    """BERT-SONNX fine-tune throughput (tokens/s): north-star config
+    #5's chip metric (VERDICT r5 next #3). Builds the in-repo BERT-
+    shaped encoder (examples/onnx/bert.py::build_bert_onnx), imports
+    it through sonnx, and jits one AdamW fine-tune step — AdamW so the
+    `--slot-dtype` matrix exercises the two-slot (m/v) byte diet on
+    the fine-tune path. `--size tiny` keeps the stage CPU-runnable for
+    the mechanics tests."""
+    import numpy as np
+
+    _setup_jax(xla_profile)
+    sys.path.insert(0, os.path.join(HERE, "examples", "onnx"))
+    import jax
+    from bert import build_bert_onnx
+
+    from singa_tpu import device, opt, sonnx, tensor
+
+    hard_stop = time.time() + deadline_s
+    dev = device.create_tpu_device()
+    dev.SetRandSeed(0)
+    tensor.set_matmul_precision("default")
+    dims = {"base": (8192, seq, 512, 8, 8, 4),
+            "tiny": (97, seq, 32, 4, 2, 4)}[size]
+    V, S, D, H, L, C = dims
+    t0 = time.time()
+    mp = build_bert_onnx(V, S, D, H, L, C, seed=3)
+    m = sonnx.SONNXModel(mp)
+    optimizer = opt.AdamW(lr=2e-5, weight_decay=0.01)
+    if slot_dtype:
+        optimizer.set_slot_dtype(slot_dtype)
+    m.set_optimizer(optimizer)
+    rs = np.random.RandomState(0)
+    tx = tensor.from_numpy(rs.randint(0, V, (batch, S))
+                           .astype(np.int32), device=dev)
+    ty = tensor.from_numpy(rs.randint(0, C, batch).astype(np.int32),
+                           device=dev)
+    log(f"bert built (V{V} d{D}h{H}l{L} seq{S}): {time.time() - t0:.1f}s")
+    t0 = time.time()
+    m.compile([tx], is_train=True, use_graph=True)
+    log(f"bert host setup: {time.time() - t0:.1f}s")
+    t0 = time.time()
+    out, loss = m(tx, ty)
+    loss.data.block_until_ready()
+    log(f"bert first step: {time.time() - t0:.1f}s")
+    best = None
+    done = 0
+    while done < steps and time.time() < hard_stop:
+        n = min(8, max(2, steps - done))
+        t0 = time.time()
+        for _ in range(n):
+            out, loss = m(tx, ty)
+        jax.block_until_ready(
+            [p.data for p in m.param_tensors()] + [loss.data])
+        dt = (time.time() - t0) / n
+        done += n
+        log(f"bert {n}-step block: {dt * 1e3:.1f} ms/step "
+            f"({batch * S / dt / 1e3:.1f}k tok/s)")
+        if best is None or dt < best:
+            best = dt
+    if best is None:
+        print(json.dumps({"ok": False, "error": "no steps"}), flush=True)
+        return
+    print(json.dumps({
+        "ok": True, "metric": "bert_finetune_tokens_per_sec",
+        "config": f"V{V} d{D}h{H}l{L} bs{batch} seq{S} {size}",
+        "slot_dtype": slot_dtype or "fp32",
+        "tokens_per_sec": round(batch * S / best, 1),
+        "step_ms": round(best * 1e3, 2),
+        "loss": round(float(loss.to_numpy()), 3)}), flush=True)
+    # The result is flushed; skip interpreter/PJRT teardown. The large
+    # imported-ONNX graph occasionally segfaults the CPU PJRT client's
+    # exit race under load, and a post-result SIGSEGV would fail the
+    # stage contract (rc != 0) with the measurement already on stdout.
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(0)
+
+
 def stage_decode(batch, prompt, new, deadline_s):
     """TransformerLM incremental-decode throughput (tokens/s): the
     KV-cache generate() path, compiled prefill + lax.scan loop —
@@ -480,6 +583,8 @@ def stage_parity(steps, deadline):
     parsed = _last_json(proc.stdout) or {}
     print(json.dumps({"ok": proc.returncode == 0,
                       "diffs": parsed.get("max_rel_diffs", {}),
+                      "at_descent": parsed.get("max_rel_at_descent", {}),
+                      "descent": parsed.get("descent"),
                       "errors": parsed.get("errors", {})}), flush=True)
 
 
@@ -495,6 +600,21 @@ def main():
     p.add_argument("--remat", action="store_true",
                    help="activation remat for the resnet stage "
                    "(HBM-traffic-vs-FLOPs experiment)")
+    # Byte-diet matrix (ISSUE 2): invalid values must die in argparse,
+    # before any jax/tunnel work can measure the wrong thing.
+    p.add_argument("--slot-dtype", choices=["bfloat16", "float16"],
+                   default=None,
+                   help="optimizer-state storage dtype (fp32 master "
+                   "math) for the resnet/bert stages")
+    p.add_argument("--bn-stats-dtype", choices=["bfloat16", "float16"],
+                   default=None,
+                   help="BatchNorm statistics precision floor for the "
+                   "resnet stage")
+    p.add_argument("--xla-profile", choices=["default", "latency"],
+                   default=None,
+                   help="XLA flag profile applied before backend init")
+    p.add_argument("--size", choices=["base", "tiny"], default="base",
+                   help="bert stage model size (tiny = CPU mechanics)")
     p.add_argument("--smoke", action="store_true",
                    help="<=2min chip smoke test only")
     a = p.parse_args()
@@ -505,9 +625,15 @@ def main():
         return stage_smoke()
     if a.stage == "resnet":
         return stage_resnet(a.batch, a.steps, a.deadline, amp=a.amp,
-                            remat=a.remat)
+                            remat=a.remat, slot_dtype=a.slot_dtype,
+                            bn_stats_dtype=a.bn_stats_dtype,
+                            xla_profile=a.xla_profile)
     if a.stage == "lm":
         return stage_lm(a.batch, a.seq, a.steps, a.deadline)
+    if a.stage == "bert":
+        return stage_bert(a.batch, a.seq, a.steps, a.deadline,
+                          slot_dtype=a.slot_dtype, size=a.size,
+                          xla_profile=a.xla_profile)
     if a.stage == "pallas":
         return stage_pallas()
     if a.stage == "decode":
@@ -555,12 +681,13 @@ def main():
     peak, chip = _chip_peak((probe or {}).get("device_kind", ""))
     log(f"chip: {chip} peak {peak / 1e12:.0f} TFLOP/s")
 
-    def run_resnet(batch, steps, dl, amp):
+    def run_resnet(batch, steps, dl, amp, extra=()):
         nonlocal best
         args = ["--batch", str(batch), "--steps", str(steps),
                 "--deadline", str(max(45, min(dl, remaining() - 60)))]
         if amp:
             args.append("--amp")
+        args += list(extra)
         r = run_stage("resnet", args,
                       min(dl + 90, max(60, remaining() - 30)))
         if r and r.get("ok"):
@@ -591,8 +718,12 @@ def main():
         # the Pallas microbench. A tunnel death at any point keeps
         # everything already flushed.
         if remaining() > 150:
-            par_dl = min(420, max(120, remaining() - 90))
-            par = run_stage("parity", ["--steps", "30",
+            # 700 s cap (was 420 at 30 steps): the 80-step descent
+            # regime needs ~2.7x the budget when the recorded CPU
+            # curves can't be reused (config mismatch / corrupt
+            # artifact) — matches tools/onchip_runbook.sh's T=900.
+            par_dl = min(700, max(120, remaining() - 90))
+            par = run_stage("parity", ["--steps", "80",
                                        "--deadline", str(int(par_dl))],
                             par_dl)
             if par is not None:
@@ -608,6 +739,15 @@ def main():
         # Headline config first: bf16 AMP bs128 (best known number).
         if remaining() > 120:
             run_resnet(128, 20, 300, True)
+        # Byte-diet matrix row (ISSUE 2): the same headline config with
+        # bf16 optimizer slots + bf16 BN statistics + latency-hiding
+        # XLA flags — the configuration the refreshed roofline
+        # projects toward the 2760 img/s bandwidth ceiling.
+        if remaining() > 240:
+            run_resnet(128, 20, 300, True,
+                       extra=["--slot-dtype", "bfloat16",
+                              "--bn-stats-dtype", "bfloat16",
+                              "--xla-profile", "latency"])
         if remaining() > 240:
             lm_dl = max(60, min(240, remaining() - 150))
             lm = run_stage("lm", ["--batch", "8", "--seq", "1024",
@@ -624,6 +764,18 @@ def main():
                 result_extra["decode_tokens_per_sec"] = (
                     dec["tokens_per_sec"])
                 result_extra["decode_config"] = dec["config"]
+        # North-star config #5 chip metric (VERDICT r5 next #3): the
+        # BERT-SONNX fine-tune step.
+        if remaining() > 240:
+            bert_dl = max(60, min(300, remaining() - 120))
+            bert = run_stage("bert", ["--batch", "32", "--seq", "128",
+                                      "--steps", "16",
+                                      "--deadline", str(int(bert_dl))],
+                             bert_dl + 90)
+            if bert and bert.get("ok"):
+                result_extra["bert_finetune_tokens_per_sec"] = (
+                    bert["tokens_per_sec"])
+                result_extra["bert_config"] = bert["config"]
         # Rest of the ramp: bf16 bs256 (the possible improvement), then
         # the fp32 reference points.
         for batch, steps, dl, amp in [(256, 20, 300, True),

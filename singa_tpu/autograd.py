@@ -438,7 +438,15 @@ def gradients(y: Tensor, dy=None) -> Dict[Tensor, Tensor]:
 # `set_dag_cache_policy()` apply without rebuild.
 _DAG_BWD_CACHE = stats_mod.TieredLRUCache("dag_backward")
 stats_mod.register_cache("dag_backward", _DAG_BWD_CACHE)
-_DAG_BWD_ENABLED = True
+# True = always record (when structurally safe), False = always walk,
+# "auto" (default) = route per DAG: trace-bound DAGs (small matmul /
+# elementwise chains, where per-op Python dispatch dominates) take the
+# recorded one-dispatch replay; compute-bound DAGs (conv nets — mean
+# estimated FLOPs/op above `device.set_dag_auto_flops_per_op`) take
+# the per-op walk, whose dispatch overhead is noise against the
+# kernel time, skipping the trace cost + cache residency. µ-cuDNN's
+# point (arXiv:1804.04806): route per workload, not globally.
+_DAG_BWD_ENABLED = "auto"
 # Operator machinery attrs: never part of an op's config, never
 # scanned as array state.
 _DAG_MACHINERY = frozenset((
@@ -451,11 +459,88 @@ _DAG_MACHINERY = frozenset((
 _DAG_SPECS: dict = {}
 
 
-def set_dag_backward(flag: bool) -> None:
-    """Toggle the recorded-backward executable (default on). The
-    per-op walk remains the semantics-defining reference path."""
+class _RouteStats:
+    """Recorded-backward routing decisions, surfaced in cache_stats()
+    under "dag_route": per-step counts of each route taken under
+    "auto" mode, plus the live mode/threshold."""
+
+    __slots__ = ("auto_walk", "auto_record")
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        self.auto_walk = 0
+        self.auto_record = 0
+
+    def snapshot(self) -> dict:
+        return {
+            "mode": (_DAG_BWD_ENABLED if isinstance(_DAG_BWD_ENABLED, str)
+                     else ("on" if _DAG_BWD_ENABLED else "off")),
+            "auto_walk": self.auto_walk,
+            "auto_record": self.auto_record,
+            "flops_per_op_threshold": stats_mod.dag_auto_flops_per_op(),
+        }
+
+
+_ROUTE_STATS = _RouteStats()
+stats_mod.register_cache("dag_route", _ROUTE_STATS)
+
+
+def set_dag_backward(flag) -> None:
+    """Recorded-backward executable mode: True = always record (when
+    structurally safe), False = always use the per-op walk, "auto"
+    (the default) = FLOPs-per-op routing — compute-bound conv DAGs
+    walk, trace-bound DAGs record (see _DAG_BWD_ENABLED). The walk
+    remains the semantics-defining reference path in every mode."""
     global _DAG_BWD_ENABLED
+    if flag == "auto":
+        _DAG_BWD_ENABLED = "auto"
+        return
     _DAG_BWD_ENABLED = bool(flag)
+
+
+def _op_flops_est(op) -> float:
+    """Cheap analytic forward-FLOPs estimate for routing (shapes are
+    host-side concrete on the eager path). Accuracy only matters near
+    the threshold: conv/matmul DAGs land orders of magnitude above it,
+    elementwise chains orders below."""
+    out_n = sum(
+        int(np.prod(s)) if s else 1 for s, _ in op._out_shapes)
+    try:
+        if isinstance(op, (_Conv2d, _ConvTranspose2d)):
+            w = op.inputs[1].data.shape  # (O, I/g, kh, kw)
+            return 2.0 * out_n * float(np.prod(w[1:]))
+        if isinstance(op, (Mult, Gemm)):
+            a = op.inputs[0].data.shape
+            k = a[-2] if isinstance(op, Gemm) and op.transA else a[-1]
+            return 2.0 * out_n * k
+        if isinstance(op, Einsum):
+            return 2.0 * out_n * max(
+                (x.data.shape[-1] for x in op.inputs if x.data.ndim),
+                default=1)
+        if isinstance(op, Attention):
+            b, h, s, d = op.inputs[0].data.shape
+            return 4.0 * b * h * s * s * d
+        if isinstance(op, _RNN):
+            hh = op.handle
+            x = op.inputs[0].data.shape  # (B, S, in)
+            gates = {"lstm": 4, "gru": 3}.get(hh.mode, 1)
+            return (2.0 * x[0] * x[1] * gates * hh.hidden_size
+                    * (hh.hidden_size + hh.input_size) * hh.num_layers)
+        if isinstance(op, _Pooling2d):
+            return float(out_n) * float(np.prod(op.handle.kernel_size))
+    except Exception:
+        pass
+    return float(out_n)
+
+
+def _route_records(ops) -> bool:
+    """Auto-route decision for a DAG: True = take the recorded replay.
+    Backward ≈ 2x forward FLOPs, so the 3x factor scores the full
+    train-step cost the walk would dispatch per op."""
+    total = 3.0 * sum(_op_flops_est(op) for op in ops)
+    return total / max(len(ops), 1) < stats_mod.dag_auto_flops_per_op()
 
 
 def _dag_op_entry(op):
@@ -495,12 +580,10 @@ def _dag_op_entry(op):
         (key,) + _policy_key()), ()
 
 
-def _dag_signature(y, dy_arr):
-    """Structural walk. Returns (key, ops_topo, leaves, cap_refs) or
-    None when any reachable op is unsafe. `leaves` are the non-output
-    input Tensors in deterministic discovery order; `cap_refs` are
-    (op_position, attr) pairs for capture arrays."""
-    ops = []           # deterministic post-order: producers first
+def _topo_ops(y):
+    """Deterministic post-order (producers first) op list for y's DAG —
+    the shared traversal of the route estimator and the signature."""
+    ops = []
     pos = {}           # id(op) -> position
     visited = set()
     stack = [(y.creator, False)]
@@ -520,6 +603,16 @@ def _dag_signature(y, dy_arr):
             if src is not None and x.requires_grad and (
                     id(src) not in visited):
                 stack.append((src, False))
+    return ops, pos
+
+
+def _dag_signature(y, dy_arr, topo=None):
+    """Structural walk. Returns (key, ops_topo, leaves, cap_refs) or
+    None when any reachable op is unsafe. `leaves` are the non-output
+    input Tensors in deterministic discovery order; `cap_refs` are
+    (op_position, attr) pairs for capture arrays. `topo` reuses an
+    (ops, pos) pair already collected by the auto-router."""
+    ops, pos = _topo_ops(y) if topo is None else topo
     leaves = []
     leaf_pos = {}
     key_parts = []
@@ -583,7 +676,18 @@ def _dag_backward(y, dy_arr):
         # backward individually, which is what the timing table shows
         return None
     try:
-        sig = _dag_signature(y, dy_arr)
+        topo = _topo_ops(y)
+        if _DAG_BWD_ENABLED == "auto":
+            # FLOPs-per-op routing (VERDICT r5 next #5): compute-bound
+            # DAGs skip the recorded path before any signature/key
+            # work — the walk's dispatch overhead is noise there, and
+            # this pre-key exit keeps the auto overhead to one cheap
+            # traversal per step.
+            if not _route_records(topo[0]):
+                _ROUTE_STATS.auto_walk += 1
+                return None
+            _ROUTE_STATS.auto_record += 1
+        sig = _dag_signature(y, dy_arr, topo)
     except Exception:
         # a config hook choking on an exotic attribute must degrade
         # to the walk, never break backward
@@ -1942,7 +2046,10 @@ def _dag_cfg_dropout(op):
 
 def _dag_cfg_bn(op):
     h = op.handle
-    return (h.factor, h.eps, bool(training))
+    # the BN stats precision floor (device.set_bn_stats_dtype) changes
+    # the traced math: toggling must retrace, not replay stale kernels
+    return (h.factor, h.eps, bool(training),
+            stats_mod.bn_stats_dtype())
 
 
 def _dag_cfg_rnn(op):

@@ -53,6 +53,12 @@ __all__ = [
     "set_dag_cache_policy",
     "set_buffer_donation",
     "get_eager_config",
+    # Byte-diet knobs (ISSUE 2): BN statistics precision, recorded-
+    # backward auto-route threshold, XLA flag profiles.
+    "set_bn_stats_dtype",
+    "set_dag_auto_flops_per_op",
+    "set_xla_profile",
+    "get_xla_profile",
     # Migration aliases (reference names):
     "create_cuda_gpu",
     "create_cuda_gpu_on",
@@ -392,6 +398,102 @@ def get_eager_config() -> dict:
     from . import stats
 
     return stats.get_config()
+
+
+def set_bn_stats_dtype(dt) -> None:
+    """BatchNorm statistics precision floor (byte-diet knob).
+
+    None (default): batch mean/var and the normalization math run in
+    at-least-fp32 — under bf16 AMP this materializes an fp32 copy of
+    the activations that round-trips HBM (the reference-parity
+    behavior). "bfloat16" / "float16": the floor drops, so bf16
+    activations are normalized in bf16 and the fp32 round-trip
+    disappears. Inputs are never DOWNcast: fp32 activations keep fp32
+    statistics under any floor, and the f64 gradient-audit path is
+    untouched. Read at op-dispatch / trace time — recompile graph-mode
+    models (and the recorded-backward cache keys on it) after
+    toggling."""
+    from . import stats
+
+    stats.configure(bn_stats_dtype=dt)
+
+
+def set_dag_auto_flops_per_op(v: float) -> None:
+    """Recorded-backward auto-routing threshold (FLOPs/op): under
+    `autograd.set_dag_backward("auto")` (the default), DAGs whose
+    estimated mean backward FLOPs per op exceed this take the per-op
+    walk (compute-bound: dispatch overhead is noise), the rest take
+    the one-dispatch recorded replay. Routing decisions are surfaced
+    in `cache_stats()['dag_route']`."""
+    from . import stats
+
+    stats.configure(dag_auto_flops_per_op=v)
+
+
+# ---------------------------------------------------------------------------
+# XLA flag profiles. XLA reads XLA_FLAGS at backend-client creation,
+# so profiles must be applied before the first jax.devices() /
+# computation of the process — bench.py's staged subprocesses apply
+# them first thing (BENCH_XLA_PROFILE), which is the supported path.
+# ---------------------------------------------------------------------------
+_XLA_PROFILES = {
+    # no-op baseline: whatever the environment already set
+    "default": (),
+    # The latency-hiding/fusion set used for bench runs (BASELINE.md
+    # roofline: un-overlapped epilogues are part of the residual gap).
+    # Scheduler overlaps collective/async work with compute; the
+    # async-collective fusion flags let it move allgathers off the
+    # critical path on meshed steps.
+    "latency": (
+        "--xla_tpu_enable_latency_hiding_scheduler=true",
+        "--xla_tpu_enable_async_collective_fusion=true",
+        "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true",
+        "--xla_tpu_overlap_compute_collective_tc=true",
+    ),
+}
+_xla_profile_applied: Optional[str] = None
+
+
+def set_xla_profile(name: str = "latency"):
+    """Apply a named XLA flag profile by merging it into XLA_FLAGS.
+
+    Returns the list of flags applied. Idempotent: re-applying a
+    profile (or switching profiles) first strips every flag any
+    profile here owns, so flags never duplicate or linger. Flags are
+    consumed at backend init — if a jax backend already exists in this
+    process, a warning is printed and the profile only affects
+    backends created afterwards (bench.py stage subprocesses apply it
+    before touching jax, which is the supported path)."""
+    global _xla_profile_applied
+    if name not in _XLA_PROFILES:
+        raise ValueError(
+            f"unknown XLA profile {name!r}; known: "
+            f"{sorted(_XLA_PROFILES)}")
+    owned = {f.split("=")[0] for flags in _XLA_PROFILES.values()
+             for f in flags}
+    current = [f for f in os.environ.get("XLA_FLAGS", "").split()
+               if f.split("=")[0] not in owned]
+    flags = list(_XLA_PROFILES[name])
+    os.environ["XLA_FLAGS"] = " ".join(current + flags).strip()
+    _xla_profile_applied = name
+    try:
+        from jax._src import xla_bridge
+
+        if getattr(xla_bridge, "_backends", None):
+            import sys
+
+            print("singa_tpu: set_xla_profile applied after backend "
+                  "init; flags only affect backends created later",
+                  file=sys.stderr)
+    except Exception:
+        pass
+    return flags
+
+
+def get_xla_profile() -> Optional[str]:
+    """Name of the profile applied by set_xla_profile (None if never
+    called in this process)."""
+    return _xla_profile_applied
 
 
 # ---------------------------------------------------------------------------

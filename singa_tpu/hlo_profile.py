@@ -165,6 +165,41 @@ def _instr_bytes(ins: _Instr) -> float:
     return float(_numel(ins.dims)) * _DTYPE_BYTES.get(ins.dtype, 4)
 
 
+def _entry_name(comps: Dict[str, List[_Instr]]) -> str:
+    """The ENTRY computation: jax names it e.g. "main.123"; fall back
+    to the last computation parsed."""
+    entry = None
+    for name in comps:
+        if name.startswith("main"):
+            entry = name
+    return entry if entry is not None else list(comps.keys())[-1]
+
+
+def _module_shapes(comps: Dict[str, List[_Instr]]) -> Dict[str, tuple]:
+    """name -> (dtype, dims) over every instruction in the module."""
+    shapes: Dict[str, tuple] = {}
+    for instrs in comps.values():
+        for ins in instrs:
+            if ins.dims is not None:
+                shapes[ins.name] = (ins.dtype, ins.dims)
+    return shapes
+
+
+def _op_label(ins: _Instr) -> str:
+    """Framework-op attribution for one instruction: the named_scope
+    op_name path (jit prefix stripped), else the HLO value name."""
+    opname = _OPNAME_RE.search(ins.line)
+    label = opname.group(1) if opname else ins.name
+    return re.sub(r"^jit\([^)]*\)/", "", label)
+
+
+def _group_key(label: str, fallback: str) -> str:
+    """Group label: the first two named_scope path segments (how both
+    aggregate() and bytes_accessed() bucket per framework op)."""
+    parts = [p for p in label.split("/") if p]
+    return "/".join(parts[:2]) if parts else fallback
+
+
 def profile_hlo(hlo_text: str) -> List[dict]:
     """Per top-level-instruction cost rows for the ENTRY computation.
 
@@ -175,20 +210,8 @@ def profile_hlo(hlo_text: str) -> List[dict]:
     comps = _parse_computations(hlo_text)
     if not comps:
         return []
-    # ENTRY computation: jax names it e.g. "main.123"; it is the one
-    # whose name starts with "main" or the last parsed.
-    entry = None
-    for name in comps:
-        if name.startswith("main"):
-            entry = name
-    if entry is None:
-        entry = list(comps.keys())[-1]
-
-    shapes: Dict[str, tuple] = {}
-    for instrs in comps.values():
-        for ins in instrs:
-            if ins.dims is not None:
-                shapes[ins.name] = (ins.dtype, ins.dims)
+    entry = _entry_name(comps)
+    shapes = _module_shapes(comps)
 
     # FLOPs per computation (for fusion attribution); resolve nested
     # calls iteratively to a fixed point.
@@ -215,21 +238,70 @@ def profile_hlo(hlo_text: str) -> List[dict]:
             flops = comp_flops.get(cm.group(1), 0.0) if cm else 0.0
         else:
             flops = _instr_flops(ins, shapes)
-        opname = _OPNAME_RE.search(ins.line)
-        label = opname.group(1) if opname else ins.name
-        # Strip the jit(...) prefix; keep the scoped path.
-        label = re.sub(r"^jit\([^)]*\)/", "", label)
-        rows.append({"op": label, "hlo": ins.opcode, "flops": flops,
-                     "out_bytes": _instr_bytes(ins)})
+        rows.append({"op": _op_label(ins), "hlo": ins.opcode,
+                     "flops": flops, "out_bytes": _instr_bytes(ins)})
     return rows
+
+
+def _operand_bytes(ins: _Instr, shapes: Dict[str, tuple]) -> float:
+    """Bytes read by one instruction: sum of operand shapes. Operand
+    tokens in optimized HLO text carry their type (`f32[2,3]{1,0}
+    %name`) — parse it directly; bare `%name` tokens fall back to the
+    module-wide shape map."""
+    m = _OPERANDS_RE.search(ins.line)
+    if not m:
+        return 0.0
+    total = 0.0
+    # split on ", " (the operand separator): dims inside `f32[8,12]`
+    # carry bare commas and must not split
+    for tok in m.group(1).split(", "):
+        tok = tok.strip()
+        sh = _shape_of(tok)
+        if sh is None:
+            name = tok.lstrip("%").split(" ")[0]
+            sh = shapes.get(name)
+        if sh is not None:
+            total += float(_numel(sh[1])) * _DTYPE_BYTES.get(sh[0], 4)
+    return total
+
+
+def bytes_accessed(hlo_text: str) -> dict:
+    """Estimated HBM bytes accessed by the program's ENTRY computation:
+    per top-level instruction, operand bytes (reads) + result bytes
+    (writes). Fusion-internal temporaries don't count — exactly the
+    property that makes this the byte-diet meter: a knob that keeps
+    data half-width ACROSS fusion boundaries (bf16 optimizer slots,
+    bf16 BN statistics) shows up here, CPU-verifiable, no chip needed.
+
+    Returns {"total": float, "reads": float, "writes": float,
+    "by_op": {framework-op-path: bytes}} — `by_op` groups by the same
+    named_scope attribution `aggregate()` uses.
+    """
+    comps = _parse_computations(hlo_text)
+    if not comps:
+        return {"total": 0.0, "reads": 0.0, "writes": 0.0, "by_op": {}}
+    shapes = _module_shapes(comps)
+    reads = writes = 0.0
+    by_op: Dict[str, float] = {}
+    for ins in comps[_entry_name(comps)]:
+        if ins.opcode in ("parameter", "constant", "tuple",
+                          "get-tuple-element", "bitcast"):
+            continue
+        r = _operand_bytes(ins, shapes)
+        w = _instr_bytes(ins)
+        reads += r
+        writes += w
+        key = _group_key(_op_label(ins), ins.opcode)
+        by_op[key] = by_op.get(key, 0.0) + r + w
+    return {"total": reads + writes, "reads": reads, "writes": writes,
+            "by_op": by_op}
 
 
 def aggregate(rows: List[dict], top: int = 0) -> List[dict]:
     """Group rows by framework op (first two named_scope segments)."""
     groups: Dict[str, dict] = {}
     for r in rows:
-        parts = [p for p in r["op"].split("/") if p]
-        key = "/".join(parts[:2]) if parts else r["hlo"]
+        key = _group_key(r["op"], r["hlo"])
         g = groups.setdefault(key, {"op": key, "flops": 0.0,
                                     "out_bytes": 0.0, "count": 0})
         g["flops"] += r["flops"]

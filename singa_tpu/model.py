@@ -307,6 +307,27 @@ class Model(Layer):
         identical DAG structure share executables)."""
         return stats_mod.cache_stats()
 
+    def step_hlo_text(self, *batch) -> str:
+        """Optimized HLO of the whole-step jit program for `batch`
+        (compiled, never executed — model/optimizer arrays are
+        untouched apart from `_ensure_opt_slots` pre-creating missing
+        slot zeros). The input to `hlo_profile.bytes_accessed`/
+        `profile_hlo`: how tests and tools measure a byte-diet knob's
+        effect without a chip. Reuses (or primes) the model's own
+        `_jit_step` executable, so inspecting a training model — or
+        inspecting then training — pays the whole-step XLA compile
+        once, not twice."""
+        if self._jit_step is None:
+            if getattr(self, "_mesh", None) is not None:
+                from .parallel.trainer import ShardedJitStep
+
+                self._jit_step = ShardedJitStep(
+                    self, self._mesh, rules=self._rules,
+                    batch_specs=self._batch_specs)
+            else:
+                self._jit_step = _JitStep(self)
+        return self._jit_step.lowered_text(*batch)
+
     def forward_graph(self, *xs: Tensor):
         """Run `forward` as one compiled XLA program (the eval-path
         analogue of `train_one_batch_graph`; reference eval replays the
@@ -349,7 +370,14 @@ class Model(Layer):
         with zipfile.ZipFile(fpath, "w") as zf:
             for name, arr in states.items():
                 buf = io.BytesIO()
-                np.save(buf, np.asarray(arr))
+                arr = np.asarray(arr)
+                if arr.dtype.name == "bfloat16":
+                    # np.save round-trips ml_dtypes bf16 as raw V2
+                    # void (dtype lost); store the exact values as
+                    # fp32 (bf16 ⊂ fp32) — the slot_dtype policy
+                    # re-quantizes on the first post-restore update.
+                    arr = arr.astype(np.float32)
+                np.save(buf, arr)
                 zf.writestr(name.replace("/", "__SLASH__") + ".npy",
                             buf.getvalue())
             zf.writestr("__meta__.json", json.dumps(meta))
@@ -677,6 +705,12 @@ class _JitStep:
         base = getattr(opt, "opt", opt)  # DistOpt wraps
         from .opt import Adam, AdaGrad, RMSProp, SGD
 
+        def zeros(name, p):
+            # honors the optimizer's slot_dtype policy (byte diet):
+            # half-width slots enter the jit signature half-width
+            return jnp.zeros(p.data.shape,
+                             base.slot_store_dtype(name, p))
+
         for p in self.params:
             st = base.states.setdefault(id(p), {})
             if isinstance(base, SGD) and base.momentum and "momentum_buf" not in st:
@@ -684,14 +718,36 @@ class _JitStep:
                 # first step (buf=g) exactly when dampening==0; with
                 # dampening>0 the first graph-mode step deviates by the
                 # dampening factor (documented limitation).
-                st["momentum_buf"] = jnp.zeros_like(p.data)
+                st["momentum_buf"] = zeros("momentum_buf", p)
             elif isinstance(base, RMSProp) and "running_avg" not in st:
-                st["running_avg"] = jnp.zeros_like(p.data)
+                st["running_avg"] = zeros("running_avg", p)
             elif isinstance(base, AdaGrad) and "history" not in st:
-                st["history"] = jnp.zeros_like(p.data)
+                st["history"] = zeros("history", p)
             elif isinstance(base, Adam):
-                st.setdefault("m", jnp.zeros_like(p.data))
-                st.setdefault("v", jnp.zeros_like(p.data))
+                st.setdefault("m", zeros("m", p))
+                st.setdefault("v", zeros("v", p))
+
+    def lowered_text(self, *batch) -> str:
+        """Optimized HLO text of the compiled train step for these
+        batch shapes (no execution, no donation hazard — .lower() only
+        reads shapes). Feeds `hlo_profile.bytes_accessed`, the
+        CPU-verifiable byte-diet meter."""
+        batch_arrays = tuple(
+            b.data if isinstance(b, Tensor) else b for b in batch
+        )
+        if self._compiled is None:
+            self._compiled = self._build(*batch_arrays)
+        dev = self._device()
+        pvals = [p.data for p in self.params]
+        svals = [s.data for s in self.states]
+        ovals = self._opt_arrays()
+        step = 0 if self.opt is None else self.opt.step_counter
+        pvals, svals, ovals, key, batch_arrays = self._prepare_inputs(
+            pvals, svals, ovals, dev._rng_key, batch_arrays
+        )
+        return self._compiled.lower(
+            pvals, svals, ovals, key, step, batch_arrays
+        ).compile().as_text()
 
     def __call__(self, *batch: Tensor):
         batch_arrays = tuple(

@@ -185,6 +185,20 @@ def _at_least_f32(x):
     return x.astype(jnp.promote_types(x.dtype, jnp.float32))
 
 
+def _bn_stats_cast(x):
+    """BatchNorm statistics precision: promote to the configured floor
+    (`device.set_bn_stats_dtype`). Default floor fp32 reproduces
+    `_at_least_f32`; a bf16 floor keeps bf16-AMP activations bf16
+    through the whole normalization — no fp32 copy round-tripping HBM
+    (the byte-diet lever). Promotion only: fp32/f64 inputs are never
+    downcast, whatever the floor."""
+    from .. import stats as stats_mod
+
+    d = stats_mod.bn_stats_dtype()
+    floor = jnp.float32 if d is None else jnp.dtype(d)
+    return x.astype(jnp.promote_types(x.dtype, floor))
+
+
 def instance_norm(x, scale, bias, eps: float = 1e-5):
     """ONNX InstanceNormalization: per-(N, C) normalization over the
     spatial dims; scale/bias are per-channel. Statistics in
@@ -222,28 +236,37 @@ def batchnorm_training(handle: BatchNormHandle, x, scale, bias, running_mean, ru
     """
     axes = tuple(i for i in range(x.ndim) if i != 1)
     # The normalized output returns to x's dtype so bf16 activations
-    # stay bf16 through BN; stats math happens in _at_least_f32.
-    xf = _at_least_f32(x)
+    # stay bf16 through BN; stats math happens at the configured
+    # precision floor (_bn_stats_cast — fp32 by default, the compute
+    # dtype under the byte-diet policy).
+    xf = _bn_stats_cast(x)
     mean = jnp.mean(xf, axis=axes)
     # cuDNN uses biased variance for normalization.
     var = jnp.var(xf, axis=axes)
     shape = [1, -1] + [1] * (x.ndim - 2)
-    inv = lax.rsqrt(var + handle.eps).reshape(shape)
-    y = ((xf - mean.reshape(shape)) * inv * scale.reshape(shape)
-         + bias.reshape(shape)).astype(x.dtype)
+    inv = lax.rsqrt((var + handle.eps).astype(xf.dtype)).reshape(shape)
+    y = ((xf - mean.reshape(shape).astype(xf.dtype)) * inv
+         * scale.reshape(shape).astype(xf.dtype)
+         + bias.reshape(shape).astype(xf.dtype)).astype(x.dtype)
     f = handle.factor
-    new_rm = (1.0 - f) * running_mean + f * mean
-    new_rv = (1.0 - f) * running_var + f * var
+    # Running-stat STORAGE keeps its existing dtype (C-sized arrays,
+    # negligible bytes) — only the batch-stat math dropped precision.
+    new_rm = ((1.0 - f) * running_mean
+              + f * mean.astype(running_mean.dtype))
+    new_rv = ((1.0 - f) * running_var
+              + f * var.astype(running_var.dtype))
     return y, mean, var, new_rm, new_rv
 
 
 def batchnorm_inference(handle: BatchNormHandle, x, scale, bias, running_mean, running_var):
     """Reference: `GpuBatchNormForwardInference`."""
     shape = [1, -1] + [1] * (x.ndim - 2)
-    inv = lax.rsqrt(running_var + handle.eps).reshape(shape)
-    xf = _at_least_f32(x)
-    y = (xf - running_mean.reshape(shape)) * inv \
-        * scale.reshape(shape) + bias.reshape(shape)
+    xf = _bn_stats_cast(x)
+    inv = lax.rsqrt((running_var + handle.eps).astype(xf.dtype)
+                    ).reshape(shape)
+    y = (xf - running_mean.reshape(shape).astype(xf.dtype)) * inv \
+        * scale.reshape(shape).astype(xf.dtype) \
+        + bias.reshape(shape).astype(xf.dtype)
     return y.astype(x.dtype)
 
 

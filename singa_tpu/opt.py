@@ -115,11 +115,28 @@ class Optimizer:
     jit-ed train step (graph mode) and checkpointed alongside params.
     """
 
+    # Slot names whose math degrades disproportionately in low
+    # precision (subclasses override): set_slot_dtype excludes them by
+    # default, so e.g. AdaGrad's monotone `history` accumulator — bf16
+    # addition of small squares stalls at 8 mantissa bits — stays in
+    # the master dtype unless the caller opts it in explicitly.
+    _fragile_slots: tuple = ()
+
     def __init__(self, lr):
         self.lr = lr if isinstance(lr, DecayScheduler) else Constant(lr)
         self.step_counter = 0
         # id(param) -> {"slot_name": array}; insertion-ordered.
         self.states: Dict[int, Dict[str, jnp.ndarray]] = {}
+        # Low-precision optimizer-state policy (byte diet, ISSUE 2):
+        # None = slots stored in the param dtype (the fp32 default).
+        # "bfloat16"/"float16" = slots STORED half-width — halving the
+        # optimizer-state HBM round-trip per step — while the update
+        # math stays in the param (master) dtype: slots are cast in
+        # before `apply` and cast back out after, inside the same
+        # fused/jitted program (_apply_masterized), so the only
+        # precision loss is the per-step slot quantization.
+        self.slot_dtype: Optional[str] = None
+        self._slot_exclude: tuple = ()
         # Optional global-norm gradient clipping (no reference
         # equivalent; standard for the transformer workloads). Applies
         # in `backward_and_update` — including inside the mesh-mode
@@ -133,6 +150,75 @@ class Optimizer:
         """Clip gradients to `value` by global L2 norm (None = off)."""
         self.clip_norm = value
         return self
+
+    def set_slot_dtype(self, dtype, exclude=None):
+        """Store optimizer state (momentum/variance slots) in `dtype`
+        ("bfloat16"/"float16"; None restores full precision), with
+        fp32-master update math (cast-in/cast-out inside the fused
+        update). `exclude` names slots that keep the master dtype; it
+        defaults to the optimizer's numerically fragile slots
+        (`_fragile_slots` — e.g. AdaGrad's `history`), pass `()` to
+        opt everything in. Existing slots convert lazily on their next
+        update. Chainable."""
+        resolved = None if dtype is None else str(jnp.dtype(dtype))
+        if resolved not in (None, "bfloat16", "float16"):
+            # validate BEFORE mutating: a rejected dtype must leave the
+            # live policy untouched for callers that catch the error
+            raise ValueError(
+                f"slot_dtype must be None/bfloat16/float16, got {dtype!r}")
+        self.slot_dtype = resolved
+        self._slot_exclude = tuple(sorted(
+            self._fragile_slots if exclude is None else exclude))
+        return self
+
+    def slot_store_dtype(self, name: str, param):
+        """Storage dtype for slot `name` of `param` under the current
+        slot_dtype policy (the param/master dtype when the policy is
+        off or the slot is excluded)."""
+        pdt = (param.data if isinstance(param, Tensor) else param).dtype
+        if self.slot_dtype is None or name in self._slot_exclude:
+            return pdt
+        return jnp.dtype(self.slot_dtype)
+
+    def _store_slot(self, st, name, value, master):
+        """Write slot `name` at its storage dtype and return the value
+        the rest of the update should consume: the STORED (quantized)
+        slot, upcast to master. Consuming the quantized value — not
+        the pre-quantization fp32 intermediate — keeps the XLA
+        dataflow single-source, so the param-update fusion reads the
+        half-width slot instead of re-deriving the fp32 chain (which
+        would re-read the gradient and erase the byte saving)."""
+        sd = self.slot_store_dtype(name, value)
+        if value.dtype != sd:
+            value = value.astype(sd)
+        st[name] = value
+        return value.astype(master) if value.dtype != master else value
+
+    def _apply_masterized(self, param, value, grad):
+        """`apply` with master-precision slot math: cast this param's
+        slots up to the master (param) dtype, run the subclass's
+        update (whose `_store_slot` writes quantize back to the
+        storage dtype), then sweep any remaining slots a custom
+        subclass stored without `_store_slot` down to storage. A no-op
+        when slot_dtype is off — and inside a traced program (fused
+        eager update, graph-mode step) the casts fuse into the
+        surrounding XLA program, so half-width slots halve the state
+        bytes moved without a separate pass."""
+        pid = id(param)
+        st = self.states.get(pid)
+        master = value.dtype
+        if st:
+            for k, a in st.items():
+                if a.dtype != master:
+                    st[k] = a.astype(master)
+        new_value = self.apply(param, value, grad)
+        st = self.states.get(pid)
+        if st is not None and self.slot_dtype is not None:
+            for k, a in st.items():
+                sd = self.slot_store_dtype(k, param)
+                if a.dtype != sd:
+                    st[k] = a.astype(sd)
+        return new_value
 
     @property
     def lr_value(self):
@@ -148,7 +234,7 @@ class Optimizer:
                 g, jax.core.Tracer):
             # graph mode: the whole step is one traced program; the
             # plain expressions fuse there anyway
-            param.data = self.apply(param, param.data, g)
+            param.data = self._apply_masterized(param, param.data, g)
         else:
             self._fused_eager_update_all([(param, g)])
 
@@ -309,7 +395,7 @@ class Optimizer:
                             params, pids, names_list, values, gs,
                             slots):
                         self.states[pid] = dict(zip(nm, sl))
-                        new_values.append(self.apply(p, v, g))
+                        new_values.append(self._apply_masterized(p, v, g))
                         st = self.states[pid]
                         onm = tuple(sorted(st))
                         out_names.append(onm)
@@ -453,7 +539,7 @@ class SGD(Optimizer):
                 buf = grad
             else:
                 buf = self.momentum * buf + (1.0 - self.dampening) * grad
-            st["momentum_buf"] = buf
+            buf = self._store_slot(st, "momentum_buf", buf, value.dtype)
             grad = grad + self.momentum * buf if self.nesterov else buf
         return value - lr * grad
 
@@ -473,12 +559,18 @@ class RMSProp(Optimizer):
         st = self.states.setdefault(id(param), {})
         r = st.get("running_avg", jnp.zeros_like(value))
         r = self.rho * r + (1.0 - self.rho) * jnp.square(grad)
-        st["running_avg"] = r
+        r = self._store_slot(st, "running_avg", r, value.dtype)
         return value - self.lr_value * grad / jnp.sqrt(r + self.epsilon)
 
 
 class AdaGrad(Optimizer):
     """Reference: `opt.AdaGrad(lr, epsilon)`."""
+
+    # `history` is a monotone sum of squares: at bf16's 8 mantissa
+    # bits, h + g**2 == h as soon as h outgrows the per-step increment
+    # by ~256x, silently freezing the effective lr. Excluded from
+    # slot_dtype by default.
+    _fragile_slots = ("history",)
 
     def __init__(self, lr=0.1, epsilon=1e-8, weight_decay=0.0):
         super().__init__(lr)
@@ -491,7 +583,7 @@ class AdaGrad(Optimizer):
         st = self.states.setdefault(id(param), {})
         h = st.get("history", jnp.zeros_like(value))
         h = h + jnp.square(grad)
-        st["history"] = h
+        h = self._store_slot(st, "history", h, value.dtype)
         return value - self.lr_value * grad / jnp.sqrt(h + self.epsilon)
 
 
@@ -514,7 +606,8 @@ class Adam(Optimizer):
         v = st.get("v", jnp.zeros_like(value))
         m = self.beta_1 * m + (1.0 - self.beta_1) * grad
         v = self.beta_2 * v + (1.0 - self.beta_2) * jnp.square(grad)
-        st["m"], st["v"] = m, v
+        m = self._store_slot(st, "m", m, value.dtype)
+        v = self._store_slot(st, "v", v, value.dtype)
         t = self.step_counter + 1
         mhat = m / (1.0 - self.beta_1 ** t)
         vhat = v / (1.0 - self.beta_2 ** t)
@@ -590,6 +683,14 @@ class DistOpt(Optimizer):
 
     def apply(self, param, value, grad):
         return self.opt.apply(param, value, grad)
+
+    def set_slot_dtype(self, dtype, exclude=None):
+        """Delegates to the wrapped optimizer (slots live there)."""
+        self.opt.set_slot_dtype(dtype, exclude=exclude)
+        return self
+
+    def slot_store_dtype(self, name, param):
+        return self.opt.slot_store_dtype(name, param)
 
     def step(self):
         self.opt.step()

@@ -42,6 +42,8 @@ __all__ = [
     "configure",
     "get_config",
     "donation_enabled",
+    "bn_stats_dtype",
+    "dag_auto_flops_per_op",
     "count_train_step",
 ]
 
@@ -61,6 +63,20 @@ _CONFIG: Dict = {
     # update (and the graph-mode step): XLA reuses the memory in place
     # instead of round-tripping fresh allocations.
     "buffer_donation": True,
+    # BatchNorm statistics precision floor (the byte-diet knob).
+    # None = promote to at-least-fp32 (the reference-parity default);
+    # "bfloat16"/"float16" lower the floor so bf16-AMP activations are
+    # normalized WITHOUT materializing an fp32 copy that round-trips
+    # HBM (BASELINE.md roofline: BN stat traffic is a named byte
+    # lever). Inputs are never DOWNcast — fp32 activations keep fp32
+    # stats under any floor. Setter: device.set_bn_stats_dtype.
+    "bn_stats_dtype": None,
+    # Recorded-backward auto-routing threshold: DAGs whose estimated
+    # mean FLOPs/op exceed this are compute-bound (conv nets) — the
+    # per-op walk's dispatch overhead is noise there, so they skip the
+    # recorded path's trace + cache residency. Trace-bound DAGs (small
+    # matmul/elementwise chains) stay on the one-dispatch replay.
+    "dag_auto_flops_per_op": 2e7,
 }
 
 
@@ -77,6 +93,17 @@ def configure(**kw) -> Dict:
         elif k == "dag_cache_policy":
             if v not in ("lru", "fifo"):
                 raise ValueError("dag_cache_policy must be 'lru' or 'fifo'")
+        elif k == "bn_stats_dtype":
+            if v is not None:
+                v = str(v)
+                if v not in ("bfloat16", "float16"):
+                    raise ValueError(
+                        "bn_stats_dtype must be None, 'bfloat16' or "
+                        "'float16'")
+        elif k == "dag_auto_flops_per_op":
+            v = float(v)
+            if v <= 0:
+                raise ValueError("dag_auto_flops_per_op must be > 0")
         else:
             v = bool(v)
         _CONFIG[k] = v
@@ -93,6 +120,17 @@ def get_config() -> Dict:
 
 def donation_enabled() -> bool:
     return _CONFIG["buffer_donation"]
+
+
+def bn_stats_dtype():
+    """BN statistics precision floor (None = at-least-fp32)."""
+    return _CONFIG["bn_stats_dtype"]
+
+
+def dag_auto_flops_per_op() -> float:
+    """Auto-routing threshold: mean estimated FLOPs/op above which a
+    DAG is compute-bound and takes the per-op walk."""
+    return _CONFIG["dag_auto_flops_per_op"]
 
 
 class CacheStats:
@@ -299,7 +337,7 @@ def reset_cache_stats() -> None:
     must not force retraces)."""
     for c in _CACHES.values():
         st = c.stats if isinstance(c, TieredLRUCache) else c
-        if isinstance(st, CacheStats):
+        if hasattr(st, "reset"):
             st.reset()
     for k in _COUNTERS:
         _COUNTERS[k] = 0

@@ -43,6 +43,34 @@ def test_unknown_flag_is_loud():
         "unknown flags must fail loudly, not measure the wrong thing")
 
 
+def test_bert_stage_contract_and_slot_dtype_matrix():
+    """The BERT-SONNX fine-tune stage (north-star config #5's chip
+    metric): one result-JSON line with the pinned metric name, and the
+    `--slot-dtype` matrix column carried in the result so
+    tools/fold_onchip.py folds matrix rows without format drift."""
+    proc, result = _run_stage(
+        ["--stage", "bert", "--size", "tiny", "--batch", "2",
+         "--seq", "16", "--steps", "2", "--deadline", "150",
+         "--slot-dtype", "bfloat16"], timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert result is not None, "no JSON result line on stdout"
+    assert result["ok"] is True
+    assert result["metric"] == "bert_finetune_tokens_per_sec"
+    assert result["tokens_per_sec"] > 0
+    assert result["step_ms"] > 0
+    assert result["slot_dtype"] == "bfloat16"
+
+
+def test_byte_diet_matrix_flags_validate_in_argparse():
+    """An invalid --slot-dtype/--bn-stats-dtype must die in argparse,
+    before any jax/tunnel work can measure the wrong thing (the same
+    loud-failure contract as unknown flags)."""
+    for flag in ("--slot-dtype", "--bn-stats-dtype"):
+        proc, _ = _run_stage(["--stage", "resnet", flag, "fp8"],
+                             timeout=60)
+        assert proc.returncode != 0, f"{flag}=fp8 accepted"
+
+
 def test_unknown_stage_is_loud():
     # A typo'd stage must not silently fall through into the full
     # multi-stage driver flow (23-minute default deadline).

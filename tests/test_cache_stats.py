@@ -21,7 +21,7 @@ def _restore_eager_config():
     saved = device.get_eager_config()
     yield
     stats.configure(**saved)
-    autograd.set_dag_backward(True)
+    autograd.set_dag_backward("auto")
 
 
 # ---------------------------------------------------------------------------

@@ -50,7 +50,7 @@ def test_recorded_backward_bit_exact_vs_walk():
         walk = _train(False)
         rec = _train(True)
     finally:
-        autograd.set_dag_backward(True)
+        autograd.set_dag_backward("auto")
     assert walk == rec, f"recorded path diverged: {walk} vs {rec}"
     assert walk[-1] < walk[0]
 
@@ -63,7 +63,7 @@ def test_recorded_backward_engages_and_caches():
         assert len(autograd._DAG_BWD_CACHE) == 1, (
             "expected one cached executable for a fixed-shape loop")
     finally:
-        autograd.set_dag_backward(True)
+        autograd.set_dag_backward("auto")
 
 
 class _Drop(model.Model):
@@ -87,7 +87,7 @@ def test_layer_dropout_records_exactly():
         assert len(autograd._DAG_BWD_CACHE) == 1, (
             "keyed dropout DAG must record")
     finally:
-        autograd.set_dag_backward(True)
+        autograd.set_dag_backward("auto")
     for a, b in zip(walk, rec):
         assert abs(a - b) <= 1e-6 * max(1.0, abs(a)), (walk, rec)
     # randomness across steps is preserved (different keys -> the
@@ -144,7 +144,7 @@ def test_batchnorm_graph_records_and_matches_walk():
         rec = _train(True, steps=4, model_cls=_BN, mkin=_bn_in)
         n = len(autograd._DAG_BWD_CACHE)
     finally:
-        autograd.set_dag_backward(True)
+        autograd.set_dag_backward("auto")
     assert n == 1, "conv+BN DAG must record"
     for a, b in zip(walk, rec):
         assert abs(a - b) <= 1e-5 * max(1.0, abs(a)), (walk, rec)
@@ -182,7 +182,7 @@ def test_policy_change_retraces():
         n2 = len(autograd._DAG_BWD_CACHE)
     finally:
         tensor.set_matmul_precision("highest")
-        autograd.set_dag_backward(True)
+        autograd.set_dag_backward("auto")
     assert n1 == 1 and n2 == 2
 
 
@@ -282,7 +282,7 @@ def test_transformer_dag_records_within_tolerance():
         rec = run(True)
         assert len(autograd._DAG_BWD_CACHE) == 1, "must record"
     finally:
-        autograd.set_dag_backward(True)
+        autograd.set_dag_backward("auto")
     for a, b in zip(walk, rec):
         assert abs(a - b) <= 1e-5 * max(1.0, abs(a)), (walk, rec)
 
@@ -336,7 +336,7 @@ def test_rnn_graph_records():
         rec = _train(True, steps=3, model_cls=_CharRNN, mkin=_rnn_in)
         n = len(autograd._DAG_BWD_CACHE)
     finally:
-        autograd.set_dag_backward(True)
+        autograd.set_dag_backward("auto")
     assert n == 1, "RNN DAG must record"
     assert np.isfinite(rec).all() and rec[-1] < rec[0]
 
@@ -348,7 +348,7 @@ def test_rnn_graph_matches_walk():
         walk = _train(False, steps=5, model_cls=_CharRNN, mkin=_rnn_in)
         rec = _train(True, steps=5, model_cls=_CharRNN, mkin=_rnn_in)
     finally:
-        autograd.set_dag_backward(True)
+        autograd.set_dag_backward("auto")
     for a, b in zip(walk, rec):
         assert abs(a - b) <= 1e-5 * max(1.0, abs(a)), (walk, rec)
 
@@ -373,7 +373,7 @@ def test_multilayer_dropout_rnn_falls_back():
         losses = _train(True, steps=2, model_cls=_Deep, mkin=_rnn_in)
         n = len(autograd._DAG_BWD_CACHE)
     finally:
-        autograd.set_dag_backward(True)
+        autograd.set_dag_backward("auto")
     assert n == 0, "inter-layer-dropout RNN must fall back"
     assert np.isfinite(losses).all()
 
@@ -444,7 +444,7 @@ def test_cast_and_amp_graphs_record():
         rec = _train(True, steps=4)
     finally:
         tensor.set_compute_dtype(None)
-        autograd.set_dag_backward(True)
+        autograd.set_dag_backward("auto")
     assert len(autograd._DAG_BWD_CACHE) == 1, "AMP DAG must record"
     for a, b in zip(walk, rec):
         assert abs(a - b) <= 1e-5 * max(1.0, abs(a)), (walk, rec)

@@ -48,6 +48,24 @@ def test_committed_artifact_is_valid():
             "missing TPU curve must be explained"
 
 
+def test_committed_artifact_descends_below_plateau():
+    """VERDICT r5 next #4: the compared trajectory must be a real
+    descent — the CPU curve ends >=0.5 below the ln(10) plateau, and
+    the pairwise max_rel is reported (and within tolerance) at the
+    steepest-descent region, where divergence would actually show."""
+    path = os.path.join(_ROOT, "PARITY_cifar10.json")
+    with open(path) as f:
+        art = json.load(f)
+    d = art.get("descent")
+    assert d, "artifact missing descent metrics"
+    assert d["descended"] is True
+    assert d["min_loss"] <= d["plateau"] - 0.5
+    tol = art["config"]["tolerance_rel"]
+    at_descent = art.get("max_rel_at_descent", {})
+    assert "cpu_eager_vs_cpu_graph" in at_descent
+    assert all(v <= tol for v in at_descent.values()), at_descent
+
+
 def test_failed_tpu_attempt_never_erases_recorded_column(tmp_path):
     """A parity run whose TPU curve fails (half-open tunnel window)
     must keep the recorded on-chip artifact intact — the acceptance

@@ -84,13 +84,22 @@ def main():
             rows.append((stage, f"FAILED: {r.get('error', r)}" + mark))
             continue
         if "ips" in r:
+            # byte-diet matrix columns render only when non-default,
+            # so pre-matrix logs fold unchanged
+            diet = "".join(
+                f", {k}={r[k]}" for k in ("slot_dtype", "bn_stats_dtype",
+                                          "xla_profile")
+                if r.get(k) not in (None, "fp32", "default"))
             rows.append((stage,
                          f"{r['ips']:.1f} img/s  ({r['step_ms']:.1f} "
                          f"ms/step, bs{r['batch']}, {r.get('precision')}"
-                         f"{', remat' if r.get('remat') else ''})" + mark))
+                         f"{', remat' if r.get('remat') else ''}"
+                         f"{diet})" + mark))
         elif "tokens_per_sec" in r:
+            diet = ("" if r.get("slot_dtype") in (None, "fp32")
+                    else f", slot_dtype={r['slot_dtype']}")
             rows.append((stage, f"{r['tokens_per_sec']:.0f} tok/s  "
-                                f"({r.get('config')})" + mark))
+                                f"({r.get('config')}{diet})" + mark))
         elif "diffs" in r:
             d = r["diffs"].get("cpu_graph_vs_tpu_graph")
             rows.append((stage, "parity max rel "

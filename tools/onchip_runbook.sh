@@ -21,8 +21,8 @@ run() {
 T=180 run python bench.py --stage probe || exit 1
 
 # 2) the acceptance gate: CIFAR-10 TPU loss parity (fast --tpu-only
-#    path; writes PARITY_cifar10.json)
-T=600 run python bench.py --stage parity --steps 30 --deadline 540
+#    path; writes PARITY_cifar10.json — descent regime, 80 steps)
+T=900 run python bench.py --stage parity --steps 80 --deadline 700
 
 # 3) headline throughput: bf16 AMP bs128 (updates BENCH_partial +
 #    BENCH_LASTGOOD via the parent flow; standalone stage here)
@@ -31,16 +31,22 @@ T=600 run python bench.py --stage resnet --batch 128 --steps 20 \
 
 [ "${1:-}" = quick ] && exit 0
 
-# 4) roofline levers: bs256 and activation remat (BASELINE.md table)
+# 4) roofline levers: byte-diet matrix row, bs256, activation remat
+#    (BASELINE.md table + projected-savings section)
+T=700 run python bench.py --stage resnet --batch 128 --steps 20 \
+    --deadline 600 --amp --slot-dtype bfloat16 \
+    --bn-stats-dtype bfloat16 --xla-profile latency
 T=700 run python bench.py --stage resnet --batch 256 --steps 20 \
     --deadline 600 --amp
 T=700 run python bench.py --stage resnet --batch 128 --steps 20 \
     --deadline 600 --amp --remat
 
-# 5) lm + decode tokens/sec
+# 5) lm + decode + bert fine-tune tokens/sec
 T=600 run python bench.py --stage lm --batch 8 --seq 1024 --steps 16 \
     --deadline 480
 T=600 run python bench.py --stage decode --batch 8 --deadline 480
+T=600 run python bench.py --stage bert --batch 32 --seq 128 \
+    --steps 16 --deadline 480
 
 # 6) Pallas: refresh PALLAS_BENCH.md, then sweep the tiling knobs
 T=900 run python benchmarks/pallas_micro.py

@@ -74,12 +74,14 @@ import jax.numpy as jnp
 
 # name|timeout|command  (value order: acceptance gate, headline, levers)
 STAGES=(
- "parity|700|python bench.py --stage parity --steps 30 --deadline 540"
+ "parity|900|python bench.py --stage parity --steps 80 --deadline 700"
  "bs128|700|python bench.py --stage resnet --batch 128 --steps 20 --deadline 480 --amp"
+ "bytediet|700|python bench.py --stage resnet --batch 128 --steps 20 --deadline 600 --amp --slot-dtype bfloat16 --bn-stats-dtype bfloat16 --xla-profile latency"
  "remat|700|python bench.py --stage resnet --batch 128 --steps 20 --deadline 600 --amp --remat"
  "bs256|800|python bench.py --stage resnet --batch 256 --steps 20 --deadline 700 --amp"
  "lm|700|python bench.py --stage lm --batch 8 --seq 1024 --steps 16 --deadline 600"
  "decode|700|python bench.py --stage decode --batch 8 --deadline 600"
+ "bert|700|python bench.py --stage bert --batch 32 --seq 128 --steps 16 --deadline 600"
  "pallas_micro|1200|python benchmarks/pallas_micro.py"
  "pallas_tune|2400|python benchmarks/pallas_tune.py"
 )
