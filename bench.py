@@ -341,8 +341,10 @@ def _last_json(text):
     return None
 
 
-def run_stage(name, args, deadline):
-    """Run one stage in a child process; returns parsed JSON or None."""
+def run_stage_status(name, args, deadline):
+    """Run one stage in a child process. Returns (parsed JSON or None,
+    timed_out) — the probe escalation logic needs to tell a deadline
+    kill apart from a fast failure."""
     cmd = [sys.executable, "-u", os.path.abspath(__file__),
            "--stage", name] + args
     log(f"stage {name} (deadline {deadline:.0f}s)")
@@ -359,9 +361,14 @@ def run_stage(name, args, deadline):
         except OSError:
             pass
         proc.wait()
-        return None
+        return None, True
     log(f"stage {name} rc={proc.returncode} in {time.time() - t0:.0f}s")
-    return _last_json(out)
+    return _last_json(out), False
+
+
+def run_stage(name, args, deadline):
+    """Run one stage in a child process; returns parsed JSON or None."""
+    return run_stage_status(name, args, deadline)[0]
 
 
 def stage_lm(batch, seq, steps, deadline_s):
@@ -664,20 +671,59 @@ def main():
 
     best = None
     result_extra = {}
-    # Persistent probe: keep retrying for the whole window (VERDICT r3
-    # Weak #6 — a flaky tunnel early must not forfeit the round). Each
-    # attempt is a fresh subprocess (a wedged PJRT dial never recovers
-    # in-process); short attempts first so a healthy chip costs ~30 s.
-    probe, attempt = None, 0
+    # Persistent probe with deadline ESCALATION (BENCH_r05 burned the
+    # whole 25-minute window on five identical 240 s probe timeouts):
+    # a short first attempt so a healthy chip costs ~30 s, then
+    # 240 s -> 360 s -> 480 s — a slow-but-alive tunnel gets more rope
+    # each try instead of the same doomed deadline. Two timeouts at
+    # the SAME deadline are identical failures: escalation is
+    # exhausted, fail the stage fast and leave the window for the
+    # carried-forward table. Non-timeout failures (fast error exits)
+    # keep retrying as before. The timeout count is published as
+    # `probe_timeouts` in the result JSON.
+    probe, attempt, probe_timeouts = None, 0, 0
+    _ESCALATION = (240, 360, 480)
+    timeouts_at_rung = {}
     while remaining() > 150:
         attempt += 1
-        dl = min(90 if attempt == 1 else 240, max(30, remaining() - 120))
-        probe = run_stage("probe", [], dl)
+        if attempt == 1:
+            rung = None  # short bootstrap probe, not an escalation rung
+            dl = min(90, max(30, remaining() - 120))
+        else:
+            rung = min(attempt - 2, len(_ESCALATION) - 1)
+            dl = min(_ESCALATION[rung], max(30, remaining() - 120))
+        probe, timed_out = run_stage_status("probe", [], dl)
         if probe and probe.get("ok"):
             break
-        log(f"probe attempt {attempt} failed; "
+        if timed_out:
+            probe_timeouts += 1
+            # Identical = same escalation RUNG, not the window-clamped
+            # wall deadline (clamping would let two honest top-rung
+            # timeouts register as different, or alias a clamped rung
+            # onto the bootstrap). Only the capped last rung repeats,
+            # so this trips after the second full-length 480 s kill.
+            if rung is not None:
+                timeouts_at_rung[rung] = timeouts_at_rung.get(rung, 0) + 1
+                if timeouts_at_rung[rung] >= 2:
+                    log(f"probe: 2 identical timeouts at the "
+                        f"{_ESCALATION[rung]}s rung; failing the "
+                        "probe stage fast")
+                    break
+                if dl < _ESCALATION[rung]:
+                    # the window already clamped this rung below its
+                    # full deadline and the tunnel STILL hung:
+                    # escalation cannot go further here, and retrying
+                    # with even less rope is hopeless — stop burning
+                    # the tail of the window
+                    log(f"probe: timeout at a window-clamped {dl:.0f}s "
+                        "attempt; cannot escalate further, failing "
+                        "the probe stage fast")
+                    break
+        log(f"probe attempt {attempt} failed "
+            f"({'timeout' if timed_out else 'error'}); "
             f"{remaining():.0f}s left in window")
         time.sleep(min(30, max(0, remaining() - 120)))
+    result_extra["probe_timeouts"] = probe_timeouts
     peak, chip = _chip_peak((probe or {}).get("device_kind", ""))
     log(f"chip: {chip} peak {peak / 1e12:.0f} TFLOP/s")
 
@@ -695,8 +741,10 @@ def main():
                 best = r
             # Flush the best-so-far immediately: if the outer driver
             # kills this parent mid-ramp, the measured result survives
-            # on disk — and becomes the new last-known-good.
-            partial = _final_json(best, peak, chip, {})
+            # on disk — and becomes the new last-known-good. Carries
+            # everything already in result_extra (probe_timeouts,
+            # parity...) so the kill-mid-ramp artifact stays complete.
+            partial = _final_json(best, peak, chip, result_extra)
             paths = ["BENCH_partial.json"]
             if not os.environ.get("BENCH_PLATFORM"):
                 # last-known-good only tracks real-chip measurements;

@@ -12,6 +12,8 @@ Layer map (mirrors SURVEY.md §1, re-designed TPU-first):
     parallel/            mesh, DP/TP/SP/PP/EP shardings, ring attention
     models/              native flagship models (TransformerLM + decode)
     checkpoint           async checkpoint writer + keep-N rotation
+    resilience           step guard, dynamic loss scaling, fault
+                         injection, crash-consistent auto-resume
     converter            Caffe prototxt importer
     io/ + native/        record IO, snapshot, C++ runtime pieces
 """
@@ -29,6 +31,7 @@ from . import loss  # noqa: F401
 from . import metric  # noqa: F401
 from . import model  # noqa: F401
 from . import opt  # noqa: F401
+from . import resilience  # noqa: F401
 from . import rnn  # noqa: F401
 from . import snapshot  # noqa: F401
 from . import sonnx  # noqa: F401

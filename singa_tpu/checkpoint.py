@@ -27,22 +27,43 @@ wait-before-save discipline orbax uses — bounding extra HBM to
     ...training continues...
     h.wait()            # or ckpt.wait_all() before exit
 
-`CheckpointManager` adds step-numbered rotation on top:
+`CheckpointManager` adds step-numbered rotation on top, with
+crash-consistent restore (ISSUE 3): every published checkpoint gets a
+content-digest manifest sidecar (`step_N.zip.digest.json`: sha256 +
+size, written atomically AFTER the zip publish), and `restore_latest`
+validates newest-first — a truncated or bit-rotted newest checkpoint
+is skipped (recorded in `skipped_on_restore`), not fatal:
 
     mgr = CheckpointManager("ckpts/", keep=3)
     mgr.save(model, step=100)            # async; prunes old steps
     step, aux = mgr.restore_latest(model)  # -> (100, aux) or (None, {})
+
+The resumable training loop over this manager lives in
+`singa_tpu.resilience.run_resumable` / `Model.fit_resumable`.
 """
 from __future__ import annotations
 
+import hashlib
+import json
 import os
 import re
+import sys
 import threading
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from .model import Model
 
 __all__ = ["AsyncCheckpointer", "CheckpointManager"]
+
+
+def _note_path(e: BaseException, fpath: str) -> None:
+    """Attach the failed checkpoint path to an exception so the
+    re-raise at `wait()`/`wait_all()` — far from the save site —
+    names the file (type and existing args survive: `except OSError`
+    handlers keep working)."""
+    from .resilience import annotate_exception
+
+    annotate_exception(e, f"[while writing checkpoint {fpath!r}]")
 
 
 class SaveHandle:
@@ -120,6 +141,11 @@ class AsyncCheckpointer:
                     if _after_publish is not None:
                         _after_publish()
                 except BaseException as e:  # surfaced via wait()
+                    # never swallowed: the handle re-raises on wait(),
+                    # and _drain_to retains failed handles so
+                    # wait_all() surfaces errors whose handle the
+                    # caller discarded — with the failed path attached
+                    _note_path(e, fpath)
                     handle.error = e
                     try:
                         os.remove(fpath + ".tmp")
@@ -136,10 +162,18 @@ class AsyncCheckpointer:
 
     def wait_all(self, timeout: Optional[float] = None):
         """Block until every issued save is durable (call before
-        process exit — writers are daemon threads)."""
+        process exit — writers are daemon threads). A writer failure
+        re-raises here ONCE: every handle is waited first, completed
+        handles (failed ones included) are pruned, then the first
+        error surfaces — so one bad save cannot poison every later
+        `wait_all`/`restore_latest` forever."""
+        errors = []
         for h in list(self._handles):
-            h.wait(timeout)
+            if h._done.wait(timeout) and h.error is not None:
+                errors.append(h.error)
         self._handles = [h for h in self._handles if not h.done]
+        if errors:
+            raise errors[0]
 
     def __enter__(self):
         return self
@@ -150,12 +184,20 @@ class AsyncCheckpointer:
 
 
 class CheckpointManager:
-    """Step-numbered async checkpoints with keep-N rotation. Pruning
-    runs in the writer thread after each atomic publish, so rotation
-    only ever counts fully-written checkpoints and cannot race an
-    in-flight save."""
+    """Step-numbered async checkpoints with keep-N rotation and
+    crash-consistent restore. Pruning runs in the writer thread after
+    each atomic publish, so rotation only ever counts fully-written
+    checkpoints and cannot race an in-flight save.
+
+    Each publish is followed (same writer thread) by an atomic
+    content-digest manifest sidecar (`<zip>.digest.json`: sha256 +
+    byte size). `restore_latest` verifies the newest checkpoint
+    against its manifest before loading and falls back past corrupt /
+    truncated ones — a kill mid-write (or bit-rot the filesystem
+    never reports) costs one checkpoint interval, never the run."""
 
     _PAT = re.compile(r"^step_(\d+)\.zip$")
+    DIGEST_SUFFIX = ".digest.json"
 
     def __init__(self, directory: str, keep: int = 3,
                  max_pending: int = 1):
@@ -163,9 +205,23 @@ class CheckpointManager:
         self.keep = keep
         os.makedirs(directory, exist_ok=True)
         self._ckpt = AsyncCheckpointer(max_pending=max_pending)
+        # (step, reason) entries recorded by the last restore_latest
+        # for every newest-but-invalid checkpoint it skipped past.
+        self.skipped_on_restore: List[Tuple[int, str]] = []
 
     def _path(self, step: int) -> str:
         return os.path.join(self.directory, f"step_{step}.zip")
+
+    def _digest_path(self, step: int) -> str:
+        return self._path(step) + self.DIGEST_SUFFIX
+
+    @staticmethod
+    def _file_digest(path: str) -> str:
+        h = hashlib.sha256()
+        with open(path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        return h.hexdigest()
 
     def steps(self):
         """Completed checkpoint steps, ascending."""
@@ -178,26 +234,143 @@ class CheckpointManager:
 
     def save(self, model: Model, step: int,
              aux_states: Optional[Dict] = None) -> SaveHandle:
-        def prune():  # runs in the writer thread, post-publish
-            done = self.steps()
-            for s in done[:max(0, len(done) - self.keep)]:
+        path = self._path(step)
+
+        def seal_and_prune():  # runs in the writer thread, post-publish
+            # Manifest AFTER the zip publish (both atomic renames): a
+            # kill between them leaves a zip without a manifest, which
+            # restore treats as unverified-legacy — still loadable,
+            # still protected by the zip's own CRC on read. The digest
+            # re-reads the just-written file: hashing a stream while
+            # zipfile writes would be wrong (zip writing seeks back to
+            # patch headers), and the re-read hits the still-warm page
+            # cache in the background writer thread. A manifest-write
+            # failure must NOT fail the save (the zip is already
+            # durable): report it and leave the checkpoint in the
+            # valid manifest-less legacy state.
+            tmp = path + self.DIGEST_SUFFIX + ".tmp"
+            try:
+                man = {"step": step,
+                       "sha256": self._file_digest(path),
+                       "size": os.path.getsize(path)}
+                with open(tmp, "w") as f:
+                    json.dump(man, f)
+                os.replace(tmp, path + self.DIGEST_SUFFIX)
+            except Exception as e:
                 try:
-                    os.remove(self._path(s))
+                    os.remove(tmp)
                 except OSError:
                     pass
+                print(f"singa_tpu: digest manifest write failed for "
+                      f"{path!r} ({e}); checkpoint is durable but "
+                      "unverified", file=sys.stderr)
+            done = self.steps()
+            for s in done[:max(0, len(done) - self.keep)]:
+                for victim in (self._path(s), self._digest_path(s)):
+                    try:
+                        os.remove(victim)
+                    except OSError:
+                        pass
 
-        return self._ckpt.save(model, self._path(step), aux_states,
-                               _after_publish=prune)
+        return self._ckpt.save(model, path, aux_states,
+                               _after_publish=seal_and_prune)
+
+    def _validate(self, step: int) -> Optional[str]:
+        """None when the checkpoint passes its manifest check (or has
+        no manifest — pre-manifest legacy, validated by the load
+        itself); otherwise the reason it must be skipped."""
+        path, dpath = self._path(step), self._digest_path(step)
+        if not os.path.exists(dpath):
+            return None
+        try:
+            with open(dpath) as f:
+                man = json.load(f)
+        except (OSError, ValueError) as e:
+            return f"unreadable digest manifest: {e}"
+        size = os.path.getsize(path)
+        if size != man.get("size"):
+            return (f"size mismatch (manifest {man.get('size')}, "
+                    f"on disk {size} — truncated write?)")
+        if self._file_digest(path) != man.get("sha256"):
+            return "content digest mismatch (corrupt checkpoint)"
+        return None
+
+    @staticmethod
+    def _state_backup(model: Model):
+        """By-reference snapshot of model + optimizer state (jax
+        arrays are immutable, so holding the refs is enough). Taken
+        before a load attempt: `Model.load_states` mutates tensors
+        layer-by-layer, so a mid-load failure (e.g. a digest-valid but
+        shape-incompatible checkpoint) would otherwise leave a
+        half-restored model that the fall-through then trains from."""
+        tensors = dict(model.get_states())
+        data = {k: t.data for k, t in tensors.items()}
+        o = model._optimizer
+        opt_bk = None if o is None else (
+            o.step_counter,
+            {pid: dict(st) for pid, st in o.states.items()})
+        return tensors, data, opt_bk
+
+    @staticmethod
+    def _state_rollback(model: Model, backup) -> None:
+        tensors, data, opt_bk = backup
+        for k, t in tensors.items():
+            t.data = data[k]
+        o = model._optimizer
+        if o is not None and opt_bk is not None:
+            o.step_counter = opt_bk[0]
+            o.states.clear()
+            o.states.update(
+                {pid: dict(st) for pid, st in opt_bk[1].items()})
 
     def restore_latest(self, model: Model):
-        """Load the newest completed checkpoint; returns (step, aux)
-        or (None, {}) when the directory is empty."""
-        self._ckpt.wait_all()
-        steps = self.steps()
-        if not steps:
-            return None, {}
-        aux = model.load_states(self._path(steps[-1]))
-        return steps[-1], aux
+        """Load the newest VALID checkpoint; returns (step, aux) or
+        (None, {}) when nothing restorable exists. Newest-first:
+        checkpoints failing manifest validation or the load itself
+        are skipped (recorded in `skipped_on_restore`, reported on
+        stderr) and the next-older one is tried — a corrupt newest
+        checkpoint is a degraded restore, not a fatal error. The same
+        contract covers an earlier FAILED async save: its error is
+        reported, not re-raised — restore works with what is durably
+        on disk."""
+        try:
+            self._ckpt.wait_all()
+        except Exception as e:
+            print(f"singa_tpu: a pending checkpoint write had failed "
+                  f"({e}); restoring from what is on disk",
+                  file=sys.stderr)
+        self.skipped_on_restore = []
+        for step in reversed(self.steps()):
+            reason = self._validate(step)
+            if reason is None:
+                backup = self._state_backup(model)
+                try:
+                    aux = model.load_states(self._path(step))
+                except Exception as e:
+                    # load_states mutates layer-by-layer: roll the
+                    # model back so the fall-through (older checkpoint
+                    # or fresh start) never trains from a half-loaded
+                    # mix of states
+                    self._state_rollback(model, backup)
+                    reason = f"load failed: {type(e).__name__}: {e}"
+                else:
+                    if self.skipped_on_restore:
+                        print(f"singa_tpu: restore_latest skipped "
+                              f"{self._skip_report()}; restored step "
+                              f"{step}", file=sys.stderr)
+                    return step, aux
+            self.skipped_on_restore.append((step, reason))
+        if self.skipped_on_restore:
+            # EVERY checkpoint failed validation/load: the caller will
+            # start from scratch — that must be loud, not silent
+            print("singa_tpu: restore_latest found NO valid "
+                  f"checkpoint — skipped {self._skip_report()}; "
+                  "training will start from step 0", file=sys.stderr)
+        return None, {}
+
+    def _skip_report(self) -> str:
+        return ", ".join(f"step {s} ({r})"
+                         for s, r in self.skipped_on_restore)
 
     def wait_all(self):
         self._ckpt.wait_all()

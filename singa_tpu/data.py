@@ -54,7 +54,14 @@ class BatchIter:
                         return
                 put(_END)
             except BaseException as e:  # noqa: BLE001 — re-raised in consumer
-                put((_END, e))
+                # Poison pill with the ORIGINAL exception + its
+                # formatted worker traceback: the consumer re-raises
+                # on its next __next__ instead of ending the epoch
+                # silently, and the message still points at the
+                # worker frame that actually failed.
+                import traceback
+
+                put((_END, e, traceback.format_exc()))
 
         t = threading.Thread(target=worker, daemon=True)
         t.start()
@@ -63,9 +70,16 @@ class BatchIter:
                 item = q.get()
                 if item is _END:
                     break
-                if isinstance(item, tuple) and len(item) == 2 \
+                if isinstance(item, tuple) and len(item) >= 2 \
                         and item[0] is _END:
-                    raise item[1]
+                    e = item[1]
+                    if len(item) == 3:
+                        from .resilience import annotate_exception
+
+                        annotate_exception(
+                            e, "prefetch worker failed; original "
+                               "traceback:\n" + item[2])
+                    raise e
                 yield item
         finally:
             closed.set()

@@ -59,6 +59,10 @@ __all__ = [
     "set_dag_auto_flops_per_op",
     "set_xla_profile",
     "get_xla_profile",
+    # Resilience knobs (ISSUE 3): step guard + dynamic loss scaling
+    # (singa_tpu.resilience owns the state/counters).
+    "set_step_guard",
+    "set_loss_scaling",
     # Migration aliases (reference names):
     "create_cuda_gpu",
     "create_cuda_gpu_on",
@@ -416,6 +420,55 @@ def set_bn_stats_dtype(dt) -> None:
     from . import stats
 
     stats.configure(bn_stats_dtype=dt)
+
+
+def set_step_guard(flag: bool) -> None:
+    """Fold an all-finite check on loss + gradients into the compiled
+    train step (default off). A non-finite step leaves params and
+    optimizer slots bit-identical to their pre-step values via
+    on-device selects — no host round-trip on the hot path — and
+    increments the counters in `cache_stats()["resilience"]`. On a
+    device mesh the finite bit is reduced over the global gradients
+    inside the one SPMD program, so every rank skips identically.
+    Read at executable build time: re-`compile()` an already-compiled
+    graph-mode model after toggling (same contract as
+    `set_buffer_donation`)."""
+    from . import stats
+
+    stats.configure(step_guard=flag)
+
+
+def set_loss_scaling(init_scale=2.0 ** 15, growth_factor: float = 2.0,
+                     backoff_factor: float = 0.5,
+                     growth_interval: int = 2000,
+                     min_scale: float = 1.0,
+                     max_scale: float = 2.0 ** 24) -> None:
+    """Dynamic loss scaling for the AMP path (implies the step guard).
+
+    The backward seed is multiplied by a running scale; gradients are
+    unscaled inside the fused/jitted update. After `growth_interval`
+    consecutive finite steps the scale grows ×`growth_factor` (capped
+    at `max_scale` — an uncapped scale overflows to inf under all-zero
+    grads and backoff could never recover); an overflowed (non-finite)
+    step skips the update and backs the scale off ×`backoff_factor`
+    (floored at `min_scale`). Keep the factors powers of two and the
+    scale/unscale round trip is bit-exact. `set_loss_scaling(None)`
+    disables. Resets the live scale state; re-`compile()` graph-mode
+    models after toggling."""
+    from . import resilience, stats
+
+    if init_scale is None:
+        stats.configure(loss_scaling=None)
+    else:
+        stats.configure(loss_scaling={
+            "init_scale": init_scale,
+            "growth_factor": growth_factor,
+            "backoff_factor": backoff_factor,
+            "growth_interval": growth_interval,
+            "min_scale": min_scale,
+            "max_scale": max_scale,
+        })
+    resilience.reset_state()
 
 
 def set_dag_auto_flops_per_op(v: float) -> None:

@@ -359,6 +359,12 @@ class Model(Layer):
                     continue
                 for slot, arr in slots.items():
                     states[f"__opt__/{pname}/{slot}"] = arr
+        from . import resilience
+
+        if resilience.guard_active():
+            # scale/backoff history resumes with the weights — a
+            # restart must not restart the loss scale from init
+            opt_meta["resilience"] = resilience.export_host_state()
         meta = {"aux": _jsonable(aux_states or {}), "opt": opt_meta,
                 "names": list(states.keys())}
         return states, meta
@@ -413,9 +419,30 @@ class Model(Layer):
                 t = tensor_of.get(pname)
                 if t is not None:
                     self._optimizer.states.setdefault(id(t), {})[slot] = jnp.asarray(arr)
+        if meta.get("opt", {}).get("resilience"):
+            from . import resilience
+
+            resilience.import_host_state(meta["opt"]["resilience"])
         self._jit_step = None  # state changed: force retrace
         self._jit_fwd = None
         return meta.get("aux", {})
+
+    def fit_resumable(self, manager, batch_fn, total_steps: int,
+                      save_every: int = 10):
+        """Crash-consistent training loop: restore the latest VALID
+        checkpoint from `manager` (a `checkpoint.CheckpointManager` —
+        corrupt/truncated newest checkpoints are skipped via their
+        content-digest manifests), then train to `total_steps`,
+        checkpointing every `save_every` steps. `batch_fn(step)` must
+        deterministically produce that step's (x, y) batch so a
+        resumed run's loss trajectory matches the uninterrupted one.
+        Returns {step: loss} for the steps this call ran. See
+        `singa_tpu.resilience.run_resumable`."""
+        from . import resilience
+
+        return resilience.run_resumable(self, manager, batch_fn,
+                                        total_steps,
+                                        save_every=save_every)
 
 
 def _lazy_snapshot(root: Layer):
@@ -621,20 +648,40 @@ class _JitStep:
     """
 
     def __init__(self, model: Model):
+        from . import resilience
+
         self.model = model
         self.params: List[Tensor] = model.param_tensors()
         self.states: List[Tensor] = model.state_tensors()
         self.opt = model._optimizer
         self._compiled = None
         self._hlo_rows = None  # graph-profile cache (hlo_profile.py)
+        # Step-guard state (loss scale + counters) rides the flattened
+        # opt-state slot of the jit signature, so the guard's skip /
+        # backoff math updates on device with no extra program inputs.
+        # Fixed at build time (like donation): toggling the guard
+        # requires re-compile().
+        self._guard_n = (len(resilience.state_arrays())
+                         if resilience.guard_active() else 0)
 
     # ---- optimizer state flattening -------------------------------------
     def _opt_arrays(self):
-        return [] if self.opt is None else list(self.opt.state_arrays())
+        out = [] if self.opt is None else list(self.opt.state_arrays())
+        if self._guard_n:
+            from . import resilience
+
+            out += resilience.state_arrays()
+        return out
 
     def _bind_opt_arrays(self, arrays):
+        arrays = list(arrays)
+        if self._guard_n:
+            from . import resilience
+
+            resilience.bind_state_arrays(arrays[-self._guard_n:])
+            arrays = arrays[:-self._guard_n]
         if self.opt is not None:
-            self.opt.set_state_arrays(list(arrays))
+            self.opt.set_state_arrays(arrays)
 
     def _device(self):
         if self.params:

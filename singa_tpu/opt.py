@@ -20,8 +20,20 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import autograd, stats as stats_mod, tensor as tensor_mod
+from . import autograd, resilience, stats as stats_mod, tensor as tensor_mod
 from .tensor import Tensor
+
+# _DONATION_FILTER: donated-but-unaliased buffers are deliberate
+# throughout this module (grads outnumber outputs — donation still
+# frees them early) and also arise on replay when a caller rebinds
+# host-numpy params (post-restore: numpy inputs cannot be donated).
+# Installed ONCE at import: a per-call warnings.catch_warnings() on
+# the fused hot path would copy/restore the process-global filter
+# list every step and race other threads.
+import warnings as _warnings
+
+_warnings.filterwarnings(
+    "ignore", message=".*[Ss]ome donated buffers were not usable.*")
 
 # Shared counters over every optimizer instance's fused-update cache
 # (the caches themselves are per-instance; the observability question
@@ -273,13 +285,21 @@ class Optimizer:
 
         return snap(self)
 
-    def _fused_eager_update_all(self, pairs, clip=False) -> None:
+    def _fused_eager_update_all(self, pairs, clip=False,
+                                loss=None) -> None:
         """Whole-step eager optimizer fusion: every (param, grad)
         pair's update — slot math included — runs as ONE jitted
         executable, traced from the subclass's own `apply` by threading
         the state dict and step counter through as traced arguments —
         the update math stays in exactly one place, and an N-param
-        model pays one dispatch instead of N."""
+        model pays one dispatch instead of N.
+
+        When the step guard is on and `loss` is provided (the
+        whole-step path from `backward_and_update`), the same
+        executable also: unscales grads by the live loss scale,
+        computes the all-finite bit over loss + grads, SELECTS the
+        pre-step param/slot values when non-finite, and advances the
+        guard counters/scale — still one dispatch, no host sync."""
         prepared = []
         for p, g in pairs:
             g = g.data if isinstance(g, Tensor) else g
@@ -341,8 +361,15 @@ class Optimizer:
         donate_grads = donate and clip and all(
             isinstance(g, Tensor) and getattr(g, "_donatable", False)
             for _, g in pairs)
+        # Step guard rides only the whole-step path (loss provided):
+        # per-param streaming calls (DistOpt update()) must not advance
+        # the guard counters once per PARAM. Guard config is part of
+        # the cache key — toggling retraces instead of reusing a
+        # program with the old policy baked in.
+        guard = loss is not None and resilience.guard_active()
+        gkey = resilience.config_key() if guard else None
         key = (self._hyper_key(), donate, donate_grads, do_clip,
-               stat_key)
+               stat_key, gkey)
         cache = self.__dict__.setdefault("_fused_cache", {})
         ent = cache.get(key)
         created = ent is None
@@ -377,7 +404,7 @@ class Optimizer:
             pids = [id(p) for p in params]
             meta = {}
 
-            def pure(values, gs, step, slots):
+            def core(values, gs, step, slots):
                 saved = {pid: self.states.get(pid) for pid in pids}
                 saved_step = self.step_counter
                 self.step_counter = step
@@ -410,6 +437,96 @@ class Optimizer:
                         else:
                             self.states[pid] = saved[pid]
 
+            if guard:
+                # KEEP IN LOCKSTEP with _guarded_traced_update: same
+                # finite-bit definition (resilience.all_finite over
+                # loss+grads), same unscale-in-apply-branch, same
+                # cond-apply/skip with where-select fallback, same
+                # resilience.advance_state. The POLICY math lives in
+                # resilience; only the orchestration differs (cached
+                # standalone executable here vs in-trace mutation
+                # there).
+                scfg = resilience.scaling_config()
+                # Probe the update's OUT slot structure once per cache
+                # entry (host-side abstract trace): in steady state
+                # (slot names unchanged by `apply`) the guard is a
+                # `lax.cond` — the finite bit is computed from the raw
+                # grads first, then ONLY the taken branch executes, so
+                # a skip costs nothing, the apply path pays just the
+                # grads-read of the finite check, and param/slot
+                # donation stays fully in place (an output-side
+                # where-select would pin the old buffers to program
+                # end and break in-place reuse — measured ~25% on the
+                # fused update). Slot-CREATING entries (step 1: cond
+                # branches couldn't return matching structures) take
+                # the where-select fallback; that entry is superseded
+                # at step 2 anyway.
+                try:
+                    jax.eval_shape(core, values, gs, 0, slots)
+                    stable = tuple(meta["names"]) == tuple(names_list)
+                except Exception:
+                    stable = False
+
+                def _advanced(finite, gstate):
+                    scale, counters = gstate
+                    return resilience.advance_state(finite, scale,
+                                                    counters)
+
+                def _unscale(gs, scale):
+                    if scfg is None:
+                        return gs
+                    # finite(g) == finite(g/s) for finite s>0, so the
+                    # check ran on the raw scaled grads and only the
+                    # apply path pays the unscale
+                    inv = 1.0 / scale
+                    return [g * inv.astype(g.dtype) for g in gs]
+
+                if stable:
+                    def pure(values, gs, step, slots, gstate,
+                             loss_arr):
+                        scale, _ = gstate
+                        finite = resilience.all_finite(
+                            [loss_arr] + gs)
+
+                        def apply_branch(op):
+                            v, g, sl = op
+                            return core(v, _unscale(g, scale), step,
+                                        sl)
+
+                        def skip_branch(op):
+                            v, g, sl = op
+                            return list(v), [list(s) for s in sl]
+
+                        new_values, new_slots = jax.lax.cond(
+                            finite, apply_branch, skip_branch,
+                            (values, gs, slots))
+                        return (new_values, new_slots,
+                                _advanced(finite, gstate))
+                else:
+                    def pure(values, gs, step, slots, gstate,
+                             loss_arr):
+                        scale, _ = gstate
+                        finite = resilience.all_finite(
+                            [loss_arr] + gs)
+                        new_values, new_slots = core(
+                            values, _unscale(gs, scale), step, slots)
+                        new_values = [jnp.where(finite, nv, v)
+                                      for nv, v in zip(new_values,
+                                                       values)]
+                        sel = []
+                        for nm_in, sl_in, onm, sl_out in zip(
+                                names_list, slots, meta["names"],
+                                new_slots):
+                            old = dict(zip(nm_in, sl_in))
+                            sel.append([
+                                jnp.where(finite, a,
+                                          old.get(n,
+                                                  jnp.zeros_like(a)))
+                                for n, a in zip(onm, sl_out)])
+                        return new_values, sel, _advanced(finite,
+                                                          gstate)
+            else:
+                pure = core
             # Donate the param/slot buffers (same contract as the
             # graph-mode _JitStep) — plus the grad buffers on the
             # flagged whole-step path: XLA updates them in place,
@@ -424,24 +541,24 @@ class Optimizer:
         else:
             _FUSED_STATS.hits += 1
         fn, meta, _ = ent
+        call_args = (values, gs, self.step_counter, slots)
+        if guard:
+            loss_arr = loss.data if isinstance(loss, Tensor) else loss
+            call_args += (tuple(resilience.state_arrays()), loss_arr)
         if created:
             # First invocation = the trace+compile; steady-state hits
-            # replay the executable. Donated-but-unaliased buffers are
-            # deliberate here (grads outnumber outputs; donation still
-            # frees them early), so jax's lowering warning about them
-            # is noise.
-            import warnings
-
+            # replay the executable (the donated-buffers lowering
+            # warning is suppressed module-wide, see _DONATION_FILTER).
             t0 = time.perf_counter()
-            with warnings.catch_warnings():
-                warnings.filterwarnings(
-                    "ignore", message=".*donated buffers were not usable.*")
-                new_values, new_slots = fn(values, gs,
-                                           self.step_counter, slots)
+            out = fn(*call_args)
             _FUSED_STATS.record_trace(time.perf_counter() - t0)
         else:
-            new_values, new_slots = fn(values, gs, self.step_counter,
-                                       slots)
+            out = fn(*call_args)
+        if guard:
+            new_values, new_slots, new_gstate = out
+            resilience.bind_state_arrays(new_gstate)
+        else:
+            new_values, new_slots = out
         for (p, _), onm, nv, ns in zip(prepared, meta["names"],
                                        new_values, new_slots):
             p.data = nv
@@ -462,10 +579,20 @@ class Optimizer:
         """Reference: `opt.SGD.backward_and_update` — run autograd and
         apply updates per (param, grad) pair in emission order (with
         optional global-norm clipping, which buffers the pairs first
-        but preserves the deterministic update order)."""
+        but preserves the deterministic update order).
+
+        Resilience hooks (singa_tpu.resilience): under dynamic loss
+        scaling the backward seed is the live scale instead of ones;
+        under the step guard the fused eager update (or, traced inside
+        a graph-mode step, `_guarded_traced_update`) folds the
+        all-finite check + skip-select into the compiled program."""
+        guard = resilience.guard_active()
+        dy = None
+        if guard and resilience.scaler_active():
+            dy = resilience.scaled_seed(loss.data)
         pairs = []
         eager = True
-        for p, g in autograd.iter_backward(loss):
+        for p, g in autograd.iter_backward(loss, dy):
             pairs.append((p, g))
             if (isinstance(p.data, jax.core.Tracer)
                     or isinstance(
@@ -478,7 +605,14 @@ class Optimizer:
             # clipping happens INSIDE the same program (the fused
             # trace reads self.clip_norm, which is part of the cache
             # key)
-            self._fused_eager_update_all(pairs, clip=True)
+            self._fused_eager_update_all(pairs, clip=True,
+                                         loss=loss if guard else None)
+            self.step()
+            return loss
+        if guard and pairs:
+            # graph mode: train_one_batch is being traced — fold the
+            # guard into the surrounding jit program directly
+            self._guarded_traced_update(loss, pairs)
             self.step()
             return loss
         if self.clip_norm is None:
@@ -494,6 +628,119 @@ class Optimizer:
             self.update(p, (g.astype(jnp.float32) * scale).astype(g.dtype))
         self.step()
         return loss
+
+    def _guarded_traced_update(self, loss: Tensor, pairs) -> None:
+        """Step-guarded updates for the traced (graph-mode) path: the
+        caller is already inside the whole-step jit trace, so the
+        finite-check → `lax.cond(apply, skip)` sequence written here
+        compiles into that one program — the skip branch is free, the
+        unscale/clip work lives only in the apply branch, and the
+        param/slot donation of `_JitStep` stays intact (an output-side
+        where-select would pin every pre-step buffer to program end).
+        `_JitStep` threads the guard state (scale + counters) through
+        the program as traced arrays alongside the optimizer slots.
+        Under GSPMD the finite bit reduces over the GLOBAL gradient
+        values, so the replicated predicate is identical on every
+        rank. Falls back to where-selects when `apply` changes the
+        slot structure mid-trace (no `_ensure_opt_slots` ran).
+
+        KEEP IN LOCKSTEP with the guarded `pure` in
+        `_fused_eager_update_all`: identical finite-bit/unscale/
+        cond/fallback/advance semantics — the policy math is shared
+        via `resilience.all_finite`/`advance_state`, only the
+        orchestration differs."""
+        prepared = []
+        for p, g in pairs:
+            g = g.data if isinstance(g, Tensor) else g
+            if g.dtype != p.data.dtype:
+                g = g.astype(p.data.dtype)
+            prepared.append((p, g))
+        scale, counters = resilience.state_arrays()
+        scaler = resilience.scaler_active()
+        gs_raw = [g for _, g in prepared]
+        finite = resilience.all_finite([loss.data] + gs_raw)
+        pids = [id(p) for p, _ in prepared]
+        names = [tuple(sorted(self.states.get(pid, ())))
+                 for pid in pids]
+        vals_in = [p.data for p, _ in prepared]
+        slots_in = [[self.states[pid][n] for n in nm] if nm else []
+                    for pid, nm in zip(pids, names)]
+
+        def _prep_gs(gs):
+            if scaler:
+                # finite(g) == finite(g/s): checked on raw grads, only
+                # the apply path pays the unscale
+                inv = 1.0 / scale
+                gs = [g * inv.astype(g.dtype) for g in gs]
+            if self.clip_norm is not None:
+                cs = _global_clip_scale(self.clip_norm, gs)
+                gs = [(g.astype(jnp.float32) * cs).astype(g.dtype)
+                      for g in gs]
+            return gs
+
+        def apply_branch(op):
+            vals, gs, slots = op
+            gs = _prep_gs(gs)
+            saved = {pid: self.states.get(pid) for pid in pids}
+            try:
+                new_vals, new_slots = [], []
+                for (p, _), pid, nm, v, g, sl in zip(
+                        prepared, pids, names, vals, gs, slots):
+                    self.states[pid] = dict(zip(nm, sl))
+                    new_vals.append(self._apply_masterized(p, v, g))
+                    st = self.states[pid]
+                    new_slots.append([st[n] for n in sorted(st)])
+                return new_vals, new_slots
+            finally:
+                for pid in pids:
+                    if saved[pid] is None:
+                        self.states.pop(pid, None)
+                    else:
+                        self.states[pid] = saved[pid]
+
+        def skip_branch(op):
+            vals, gs, slots = op
+            return list(vals), [list(sl) for sl in slots]
+
+        try:
+            new_vals, new_slots = jax.lax.cond(
+                finite, apply_branch, skip_branch,
+                (vals_in, gs_raw, slots_in))
+        except (TypeError, ValueError):
+            # apply created/renamed slots mid-trace: branch structures
+            # can't match — run the update and select outputs instead
+            gs = _prep_gs(gs_raw)
+            old_slots = {pid: dict(self.states.get(pid, ()))
+                         for pid in pids}
+            for (p, _), g in zip(prepared, gs):
+                p.data = self._apply_masterized(p, p.data, g)
+            for (p, _), old in zip(prepared, vals_in):
+                p.data = jnp.where(finite, p.data, old)
+            for pid in pids:
+                st = self.states.get(pid)
+                if not st:
+                    continue
+                old = old_slots[pid]
+                for name in list(st):
+                    st[name] = jnp.where(
+                        finite, st[name],
+                        old.get(name, jnp.zeros_like(st[name])))
+        else:
+            for (p, _), v in zip(prepared, new_vals):
+                p.data = v
+            for pid, nm, ns in zip(pids, names, new_slots):
+                if nm:
+                    self.states[pid] = dict(zip(nm, ns))
+        # Guard state advances inside the trace — but only when the
+        # state arrays ARE part of it (bound by _JitStep). Guard
+        # enabled after compile leaves them concrete: advancing would
+        # leak tracers into host state, so freeze + warn instead.
+        if (isinstance(finite, jax.core.Tracer)
+                and not isinstance(scale, jax.core.Tracer)):
+            resilience.warn_frozen_guard_state()
+            return
+        resilience.bind_state_arrays(
+            resilience.advance_state(finite, scale, counters))
 
     # -- state I/O for checkpointing ---------------------------------------
     def state_arrays(self) -> List:
@@ -734,10 +981,39 @@ class DistOpt(Optimizer):
         for p, g in pairs:
             g.data = g.data * inv
         self._clip_pairs(pairs)
+        if self._guard_skip(loss, pairs):
+            self.opt.step()
+            return loss
         for p, g in pairs:
             self.opt.update(p, g)
         self.opt.step()
         return loss
+
+    def _guard_skip(self, loss, pairs) -> bool:
+        """Driver-regime step guard (singa_tpu.resilience): the
+        allreduced grads are identical on every rank, so a HOST-side
+        finite check makes the same skip decision everywhere — one
+        sync per step, which is already this regime's execution model.
+        Dynamic loss scaling does not apply here (the seed is not
+        scaled on the DistOpt paths); the partial/sparse variants
+        bypass the guard like they bypass clipping (per-grad streaming
+        by design). Returns True when the step must skip."""
+        if not pairs or not resilience.guard_active():
+            return False
+        if resilience.scaler_active():
+            resilience.warn_distopt_scaler()
+        # Grads ONLY, not the loss: the grads are post-allreduce and
+        # identical on every rank, but the loss is rank-LOCAL here —
+        # a rank whose local loss overflowed while the reduced grads
+        # stayed finite would skip alone and diverge the replicas.
+        finite = resilience.host_all_finite(
+            [g.data if isinstance(g, Tensor) else g
+             for _, g in pairs])
+        # with_scaler=False: this path never scaled the backward seed,
+        # so growing/backing off the scale here would drift it away
+        # from the gradients it protects on the scaled paths
+        resilience.host_step_update(finite, with_scaler=False)
+        return not finite
 
     def _clip_pairs(self, pairs):
         """Global-norm clip AFTER the allreduce (reduced grads are
@@ -763,6 +1039,9 @@ class DistOpt(Optimizer):
         for (p, g), r in zip(pairs, reduced):
             g.data = r.astype(p.data.dtype) * inv
         self._clip_pairs(pairs)
+        if self._guard_skip(loss, pairs):
+            self.opt.step()
+            return loss
         for p, g in pairs:
             self.opt.update(p, g)
         self.opt.step()

@@ -80,15 +80,19 @@ class ShardedJitStep(_JitStep):
 
     def _opt_shardings(self) -> List:
         """Optimizer slots inherit their param's layout (slot arrays
-        are elementwise companions of the param)."""
-        if self.opt is None:
-            return []
-        by_id = {id(p): s for p, s in zip(self.params,
-                                          self._param_shardings())}
+        are elementwise companions of the param). The step-guard state
+        scalars riding the opt-state slot (`_JitStep._opt_arrays`) are
+        replicated — every rank holds the same scale/counters, which
+        is exactly the ranks-never-diverge contract."""
         out = []
-        for pid, pstate in self.opt.states.items():
-            sh = by_id.get(pid, replicated(self.mesh))
-            out.extend(sh for _ in sorted(pstate))
+        if self.opt is not None:
+            by_id = {id(p): s for p, s in zip(self.params,
+                                              self._param_shardings())}
+            for pid, pstate in self.opt.states.items():
+                sh = by_id.get(pid, replicated(self.mesh))
+                out.extend(sh for _ in sorted(pstate))
+        out.extend(replicated(self.mesh)
+                   for _ in range(getattr(self, "_guard_n", 0)))
         return out
 
     def _batch_shardings(self, batch_arrays) -> tuple:

@@ -77,6 +77,28 @@ _CONFIG: Dict = {
     # recorded path's trace + cache residency. Trace-bound DAGs (small
     # matmul/elementwise chains) stay on the one-dispatch replay.
     "dag_auto_flops_per_op": 2e7,
+    # Resilience (singa_tpu.resilience): fold an all-finite check on
+    # loss+grads into the compiled step; a non-finite step skips the
+    # param/slot update via on-device selects (no host round-trip).
+    # Setter: device.set_step_guard. Loss scaling below implies it.
+    "step_guard": False,
+    # Dynamic loss scaling for the AMP path: None = off, else a dict
+    # {init_scale, growth_factor, backoff_factor, growth_interval,
+    # min_scale} (normalized by configure). Setter:
+    # device.set_loss_scaling.
+    "loss_scaling": None,
+}
+
+_LOSS_SCALING_DEFAULTS = {
+    "init_scale": 2.0 ** 15,
+    "growth_factor": 2.0,
+    "backoff_factor": 0.5,
+    "growth_interval": 2000,
+    "min_scale": 1.0,
+    # Growth ceiling: all-zero grads keep the streak clean forever,
+    # and an uncapped scale overflows f32 to inf, from which backoff
+    # can never recover (inf * 0.5 == inf).
+    "max_scale": 2.0 ** 24,
 }
 
 
@@ -104,6 +126,34 @@ def configure(**kw) -> Dict:
             v = float(v)
             if v <= 0:
                 raise ValueError("dag_auto_flops_per_op must be > 0")
+        elif k == "loss_scaling":
+            if v is not None:
+                if not isinstance(v, dict):
+                    raise ValueError(
+                        "loss_scaling must be None or a dict of "
+                        f"{sorted(_LOSS_SCALING_DEFAULTS)}")
+                unknown = set(v) - set(_LOSS_SCALING_DEFAULTS)
+                if unknown:
+                    raise ValueError(
+                        f"unknown loss_scaling keys {sorted(unknown)}")
+                v = {**_LOSS_SCALING_DEFAULTS, **v}
+                v["growth_interval"] = int(v["growth_interval"])
+                for fk in ("init_scale", "growth_factor",
+                           "backoff_factor", "min_scale",
+                           "max_scale"):
+                    v[fk] = float(v[fk])
+                if v["init_scale"] <= 0 or v["min_scale"] <= 0:
+                    raise ValueError("loss scales must be > 0")
+                if not (v["min_scale"] <= v["init_scale"]
+                        <= v["max_scale"]):
+                    raise ValueError(
+                        "need min_scale <= init_scale <= max_scale")
+                if v["growth_factor"] < 1.0:
+                    raise ValueError("growth_factor must be >= 1")
+                if not 0.0 < v["backoff_factor"] <= 1.0:
+                    raise ValueError("backoff_factor must be in (0,1]")
+                if v["growth_interval"] < 0:
+                    raise ValueError("growth_interval must be >= 0")
         else:
             v = bool(v)
         _CONFIG[k] = v

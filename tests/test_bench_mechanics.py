@@ -29,6 +29,59 @@ def _run_stage(args, timeout=240):
     return proc, last
 
 
+def _load_module(name, relpath):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_ROOT, relpath))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_run_stage_status_distinguishes_timeout():
+    """Probe escalation (ISSUE 3) keys on timeout-vs-error: a deadline
+    kill must report timed_out=True so two identical timeouts fail
+    the stage fast instead of eating the window."""
+    bench = _load_module("bench_for_test", "bench.py")
+    result, timed_out = bench.run_stage_status("probe", [], 0.2)
+    assert result is None and timed_out is True
+
+
+def test_probe_escalation_ladder_is_pinned():
+    """The per-attempt probe deadlines escalate 240→360→480 (BENCH_r05
+    burned its window on five identical 240 s timeouts), and the
+    identical-timeout fail-fast keys on the escalation RUNG, not the
+    window-clamped wall deadline (clamping would alias rungs)."""
+    src = open(os.path.join(_ROOT, "bench.py")).read()
+    assert "_ESCALATION = (240, 360, 480)" in src
+    assert "probe_timeouts" in src
+    assert "timeouts_at_rung" in src
+
+
+def test_fold_onchip_renders_probe_timeouts(tmp_path, capsys,
+                                            monkeypatch):
+    """tools/fold_onchip.py surfaces the new `probe_timeouts` field on
+    driver-table and failure rows."""
+    fold = _load_module("fold_onchip_for_test", "tools/fold_onchip.py")
+    logs = tmp_path / "onchip_logs"
+    logs.mkdir()
+    (logs / "driver.log").write_text(json.dumps(
+        {"metric": "resnet50_images_per_sec_chip", "value": 123.4,
+         "unit": "img/s", "provenance": "driver-fresh",
+         "probe_timeouts": 3}) + "\n")
+    (logs / "dead.log").write_text(json.dumps(
+        {"metric": "resnet50_images_per_sec_chip", "value": 0.0,
+         "unit": "img/s", "error": "tpu_unreachable",
+         "probe_timeouts": 5}) + "\n")
+    monkeypatch.setattr(fold, "LOGS", str(logs))
+    assert fold.main() == 0
+    out = capsys.readouterr().out
+    assert "probe_timeouts=3" in out
+    assert "probe_timeouts=5" in out and "tpu_unreachable" in out
+    assert "123.4 img/s" in out
+
+
 def test_probe_stage_contract():
     proc, result = _run_stage(["--stage", "probe"])
     assert proc.returncode == 0, proc.stderr[-2000:]

@@ -80,10 +80,27 @@ def main():
             else:
                 rows.append((stage, "no result line"))
             continue
-        if not r.get("ok", False):
-            rows.append((stage, f"FAILED: {r.get('error', r)}" + mark))
+        # Probe-escalation observability (ISSUE 3): the driver counts
+        # probe deadline kills into the result JSON; surface them on
+        # whichever row carries them (notably the final driver table
+        # and the tpu_unreachable failure row).
+        pt = (f", probe_timeouts={r['probe_timeouts']}"
+              if "probe_timeouts" in r else "")
+        if not r.get("ok", False) and "value" not in r:
+            rows.append((stage, f"FAILED: {r.get('error', r)}"
+                         + pt + mark))
             continue
-        if "ips" in r:
+        if "metric" in r and "value" in r:
+            # driver-level result table (bench.py _final_json)
+            rows.append((stage,
+                         f"{r['value']} {r.get('unit', '')}".strip()
+                         + f"  ({r['metric']}"
+                         + (f", {r['provenance']}"
+                            if r.get("provenance") else "")
+                         + (f", ERROR: {r['error']}"
+                            if r.get("error") else "")
+                         + f"{pt})" + mark))
+        elif "ips" in r:
             # byte-diet matrix columns render only when non-default,
             # so pre-matrix logs fold unchanged
             diet = "".join(
@@ -106,7 +123,7 @@ def main():
                          + (f"{d:.4f}" if d is not None
                             else "NO TPU COLUMN") + mark))
         else:
-            rows.append((stage, json.dumps(r)[:100] + mark))
+            rows.append((stage, json.dumps(r)[:100] + pt + mark))
     width = max((len(s) for s, _ in rows), default=8)
     for stage, desc in rows:
         print(f"  {stage:<{width}}  {desc}")
