@@ -100,6 +100,111 @@ def minibatches(x: np.ndarray, y: np.ndarray, batch_size: int,
         yield x[j], y[j]
 
 
+def microbatches(batch, n: int, pad: bool = False):
+    """Split `batch` — one array, or any pytree of arrays / singa_tpu
+    Tensors — into `n` equal microbatches along dim 0, as a list of n
+    sub-pytrees with the original structure. The feeding-side
+    companion of gradient accumulation (`device.set_grad_accum`): a
+    `BatchIter` source can yield full effective batches and the train
+    loop (or the compiled accum step itself, which does the same
+    reshape in-program) never hand-slices.
+
+    Every array leaf must share the same leading dimension, and it
+    must divide by `n` — an indivisible batch raises a ValueError
+    naming the offending size (silently dropping or duplicating
+    samples would skew the gradient mean). Pass `pad=True` to instead
+    right-pad every leaf by REPEATING its final sample up to the next
+    multiple of n; padding changes the gradient weighting (the padded
+    samples are real contributions), so it is opt-in and meant for
+    tail batches where approximate weighting is acceptable.
+
+    Tensor leaves are sliced on their device and wrapped back as
+    Tensors; numpy/jax array leaves come back as views/slices of the
+    same kind.
+    """
+    import jax
+
+    from .tensor import Tensor
+
+    if n < 1:
+        raise ValueError(f"microbatches: n must be >= 1, got {n}")
+
+    def is_tensor(x):
+        return isinstance(x, Tensor)
+
+    leaves, treedef = jax.tree_util.tree_flatten(
+        batch, is_leaf=is_tensor)
+    if not leaves:
+        raise ValueError("microbatches: empty batch pytree")
+
+    def leading_dim(t):
+        # shape is an attribute read on Tensor/jax/numpy leaves —
+        # never np.asarray, which would force a device-to-host
+        # transfer just to measure an on-device array. (Python
+        # lists/tuples never surface here: tree_flatten decomposes
+        # them into their elements.)
+        arr = t.data if is_tensor(t) else t
+        shape = getattr(arr, "shape", None)
+        if shape is not None and len(shape):
+            return shape[0]
+        return None  # scalar leaf: rides along whole
+
+    dims_set = {d for d in map(leading_dim, leaves) if d is not None}
+    if not dims_set:
+        raise ValueError("microbatches: no leaf has a batch dimension")
+    if len(dims_set) > 1:
+        raise ValueError(
+            f"microbatches: leaves disagree on batch size: "
+            f"{sorted(dims_set)}")
+    b = dims_set.pop()
+    if b % n != 0:
+        if not pad:
+            raise ValueError(
+                f"microbatches: batch size {b} is not divisible by "
+                f"n={n}; pass pad=True to repeat-pad the tail, or "
+                f"feed batches sized to a multiple of n")
+        b_padded = ((b + n - 1) // n) * n
+        extra = b_padded - b
+
+        def pad_leaf(t):
+            if leading_dim(t) is None:
+                return t
+            arr = t.data if is_tensor(t) else t
+            tail = arr[-1:]
+            reps = [extra] + [1] * (arr.ndim - 1)
+            if isinstance(arr, np.ndarray):
+                padded = np.concatenate([arr, np.tile(tail, reps)])
+            else:
+                import jax.numpy as jnp
+
+                padded = jnp.concatenate(
+                    [arr, jnp.tile(tail, reps)])
+            if is_tensor(t):
+                from . import tensor as tensor_mod
+
+                return tensor_mod.from_raw(padded, t.device)
+            return padded
+
+        leaves = [pad_leaf(t) for t in leaves]
+        b = b_padded
+    mb = b // n
+
+    def slice_leaf(t, k):
+        if leading_dim(t) is None:
+            return t  # scalar leaf rides along whole
+        arr = t.data if is_tensor(t) else t
+        piece = arr[k * mb:(k + 1) * mb]
+        if is_tensor(t):
+            from . import tensor as tensor_mod
+
+            return tensor_mod.from_raw(piece, t.device)
+        return piece
+
+    return [jax.tree_util.tree_unflatten(
+                treedef, [slice_leaf(t, k) for t in leaves])
+            for k in range(n)]
+
+
 def shard(x: np.ndarray, rank: int, world_size: int) -> np.ndarray:
     """Per-host shard of a dataset (multi-controller DP: each process
     feeds its slice; reference: global_rank-strided partition in

@@ -63,6 +63,8 @@ __all__ = [
     # (singa_tpu.resilience owns the state/counters).
     "set_step_guard",
     "set_loss_scaling",
+    # Microbatched gradient accumulation (ISSUE 4).
+    "set_grad_accum",
     # Migration aliases (reference names):
     "create_cuda_gpu",
     "create_cuda_gpu_on",
@@ -469,6 +471,34 @@ def set_loss_scaling(init_scale=2.0 ** 15, growth_factor: float = 2.0,
             "max_scale": max_scale,
         })
     resilience.reset_state()
+
+
+def set_grad_accum(n: int) -> None:
+    """Microbatched gradient accumulation factor (default 1 = off).
+
+    With n > 1 the compiled train step reshapes its incoming batch to
+    `[n, batch/n, ...]` and runs a `lax.scan` over the microbatches
+    INSIDE the one XLA program — forward + backward per microbatch,
+    gradients accumulated in fp32 — applying the optimizer exactly
+    once on the mean at the end. Train at an effective batch n× what
+    fits HBM (the live activation/gradient footprint stays at
+    microbatch size), and on a device mesh the gradient reduction
+    fires once per accumulated step instead of once per microbatch.
+    The eager path microbatches the same way with one fused optimizer
+    dispatch. The StepGuard finite check / DynamicLossScaler unscale
+    run once on the ACCUMULATED gradients, and bf16 slot storage
+    quantizes once at the final apply.
+
+    Read at executable build time (same contract as
+    `set_buffer_donation`/`set_step_guard`): re-`compile()` an
+    already-compiled graph-mode model after toggling.
+    `Model.compile(..., grad_accum=n)` overrides per-model. Batch
+    sizes must divide by n (`singa_tpu.data.microbatches` is the
+    feeding-side splitter). Geometry + applied-step counters surface
+    in `cache_stats()["accum"]`."""
+    from . import stats
+
+    stats.configure(grad_accum=n)
 
 
 def set_dag_auto_flops_per_op(v: float) -> None:

@@ -48,6 +48,9 @@ class Model(Layer):
         self._jit_fwd = None
         self._use_graph = False
         self._mesh = self._rules = self._batch_specs = None
+        # Per-model gradient-accumulation override (None = defer to
+        # the process knob, device.set_grad_accum / stats config).
+        self._grad_accum = None
         self.training = True
 
     # -- configuration -----------------------------------------------------
@@ -60,7 +63,8 @@ class Model(Layer):
 
     def compile(self, inputs: List[Tensor], is_train: bool = True,
                 use_graph: bool = False, sequential: bool = False,
-                mesh=None, rules=None, batch_specs=None):
+                mesh=None, rules=None, batch_specs=None,
+                grad_accum=None):
         """Reference: `Model.compile` — one tracing pass to initialize
         params (lazy shape inference), then optionally arm graph mode.
 
@@ -73,7 +77,23 @@ class Model(Layer):
         (`parallel.ShardingRules`), batch dims sharded over the "data"
         axis (`batch_specs` overrides per-input), gradients reduced by
         XLA over ICI. This subsumes DistOpt: same math, one program.
+
+        `grad_accum=n` arms microbatched gradient accumulation for
+        this model (overriding the process knob
+        `device.set_grad_accum`): the train step splits its batch into
+        n microbatches, scans forward/backward over them inside the
+        compiled program (eager mode loops the same microbatches with
+        one fused optimizer dispatch), accumulates gradients in fp32,
+        and applies the optimizer once on the mean. Batch sizes must
+        divide by n. `grad_accum=1` pins accumulation OFF regardless
+        of the process knob; None defers to it.
         """
+        if grad_accum is not None:
+            grad_accum = int(grad_accum)
+            if grad_accum < 1:
+                raise ValueError(
+                    f"grad_accum must be >= 1, got {grad_accum}")
+        self._grad_accum = grad_accum
         self.train(is_train)
         dev = inputs[0].device if inputs else None
         if dev is not None:
@@ -296,7 +316,83 @@ class Model(Layer):
     def train_one_batch_dispatch(self, *batch: Tensor):
         if self._use_graph:
             return self.train_one_batch_graph(*batch)
+        n = self._accum_n()
+        if n > 1 and self._optimizer is not None:
+            return self._train_one_batch_accum_eager(n, *batch)
         return self.train_one_batch(*batch)
+
+    def _accum_n(self) -> int:
+        """Effective gradient-accumulation factor: the per-model
+        `compile(grad_accum=...)` override, else the process knob
+        (`device.set_grad_accum`)."""
+        if self._grad_accum is not None:
+            return self._grad_accum
+        return stats_mod.grad_accum_n()
+
+    def _train_one_batch_accum_eager(self, n: int, *batch: Tensor):
+        """Eager-mode gradient accumulation: split the batch into n
+        microbatches (`data.microbatches`), run the user's
+        `train_one_batch` per microbatch with the optimizer in capture
+        mode (backward runs — scaled seed included — but the apply is
+        deferred), accumulate gradients in fp32 with a jitted adder,
+        and apply the optimizer ONCE on the mean via
+        `opt.apply_accumulated` — so an n-accum eager step pays one
+        fused optimizer dispatch instead of n, and the StepGuard /
+        DynamicLossScaler / bf16-slot policies all act once on the
+        accumulated gradients, exactly like the scan-fused graph step.
+
+        Returns the same pytree shape `train_one_batch` returns:
+        batch-dim outputs are the microbatch outputs concatenated
+        back to the full batch; scalar (loss) leaves become the mean
+        over microbatches."""
+        import jax.numpy as jnp
+
+        from . import data as data_mod
+
+        opt = self._optimizer
+        micro = data_mod.microbatches(list(batch), n)
+        order = None
+        acc = loss_sum = None
+        outs = []
+        for mb in micro:
+            opt._accum_begin()
+            try:
+                out = self.train_one_batch(*mb)
+            finally:
+                cap = opt._accum_end()
+            if len(cap) != 1:
+                raise RuntimeError(
+                    "gradient accumulation requires train_one_batch "
+                    "to call backward_and_update exactly once per "
+                    f"microbatch; it ran {len(cap)} times")
+            loss_t, pairs = cap[0]
+            gs = [g.data if isinstance(g, Tensor) else g
+                  for _, g in pairs]
+            loss_arr = (loss_t.data if isinstance(loss_t, Tensor)
+                        else jnp.asarray(loss_t))
+            if order is None:
+                order = [p for p, _ in pairs]
+                acc, loss_sum = _accum_seed(gs, loss_arr)
+            else:
+                if [id(p) for p, _ in pairs] != [id(p) for p in order]:
+                    raise RuntimeError(
+                        "gradient accumulation: the (param, grad) "
+                        "pair order changed across microbatches — "
+                        "train_one_batch must be structurally "
+                        "identical per microbatch")
+                acc, loss_sum = _accum_add(acc, gs, loss_sum, loss_arr)
+            outs.append(_unwrap_out(out))
+        mb_size = micro[0][0].data.shape[0] if hasattr(
+            micro[0][0], "data") else len(micro[0][0])
+        stats_mod.note_accum_build(n, mb_size, mb_size * n)
+        opt.apply_accumulated(loss_sum, list(zip(order, acc)), n)
+        stacked = jax.tree_util.tree_map(
+            lambda *ls: jnp.stack(ls), *outs)
+        merged = _merge_accum_out(stacked, mb_size)
+        dev = batch[0].device if batch and isinstance(
+            batch[0], Tensor) else None
+        return jax.tree_util.tree_map(
+            lambda a: tensor_mod.from_raw(a, dev), merged)
 
     def cache_stats(self):
         """Snapshot of every executable-cache's counters
@@ -513,6 +609,63 @@ def _unwrap_out(out):
     )
 
 
+# ---------------------------------------------------------------------------
+# Gradient-accumulation helpers (ISSUE 4). The fp32 accumulator math is
+# deliberately identical between the eager loop (jitted seed/add below)
+# and the scan-fused graph step (same expressions traced into the scan
+# body), so the two modes accumulate bit-identically: the sum order is
+# sequential-by-microbatch in both, and the final mean is an
+# elementwise division (never reassociated by fusion).
+# ---------------------------------------------------------------------------
+def _accum_seed_fn(gs, loss):
+    import jax.numpy as jnp
+
+    return ([g.astype(jnp.float32) for g in gs],
+            jnp.mean(jnp.asarray(loss)).astype(jnp.float32))
+
+
+def _accum_add_fn(acc, gs, loss_sum, loss):
+    import jax.numpy as jnp
+
+    return ([a + g.astype(jnp.float32) for a, g in zip(acc, gs)],
+            loss_sum + jnp.mean(jnp.asarray(loss)).astype(jnp.float32))
+
+
+# One jitted executable each, cached by jax per grad-list structure;
+# the running accumulator and loss sum are donated so XLA adds in
+# place instead of round-tripping fresh buffers every microbatch.
+_accum_seed = jax.jit(_accum_seed_fn)
+_accum_add = jax.jit(_accum_add_fn, donate_argnums=(0, 2))
+
+
+def _merge_accum_out(stacked, mb: int):
+    """Collapse per-microbatch outputs stacked on a leading [n] axis
+    back to the monolithic step's output shape: leaves carrying the
+    microbatch dim are concatenated to the full batch ([n, mb, ...] →
+    [n*mb, ...]), inexact leaves without it (the loss scalar) become
+    the mean over microbatches, and anything else (integer metadata)
+    keeps the last microbatch's value.
+
+    Known limitation: batch-ness is inferred by SHAPE (leading dim ==
+    microbatch size). A non-batch output vector whose length happens
+    to equal the microbatch size is indistinguishable from a
+    per-sample output and gets concatenated rather than averaged —
+    pick a microbatch size that differs from such output dims (this
+    is inherent to shape-based inference; train_one_batch outputs
+    carry no axis annotations)."""
+    import jax.numpy as jnp
+
+    def leaf(a):
+        a = jnp.asarray(a)
+        if a.ndim >= 2 and a.shape[1] == mb:
+            return a.reshape((a.shape[0] * mb,) + a.shape[2:])
+        if jnp.issubdtype(a.dtype, jnp.inexact):
+            return jnp.mean(a, axis=0)
+        return a[-1]
+
+    return jax.tree_util.tree_map(leaf, stacked)
+
+
 class _JitForward:
     """Compiles `model.forward` into one XLA program (inference path).
 
@@ -656,6 +809,10 @@ class _JitStep:
         self.opt = model._optimizer
         self._compiled = None
         self._hlo_rows = None  # graph-profile cache (hlo_profile.py)
+        # Gradient-accumulation factor baked into the built executable
+        # (1 = off); read from the model/process knob at _build time —
+        # toggling requires re-compile(), like donation/step-guard.
+        self._accum_built = 1
         # Step-guard state (loss scale + counters) rides the flattened
         # opt-state slot of the jit signature, so the guard's skip /
         # backoff math updates on device with no extra program inputs.
@@ -719,6 +876,30 @@ class _JitStep:
         # opt state) is stable from step one. step_counter is traced
         # (not static) so LR schedules don't retrigger compilation.
         self._ensure_opt_slots()
+        # Gradient accumulation (ISSUE 4): n > 1 swaps the monolithic
+        # step body for the scan-fused microbatch accumulator. Baked
+        # at build time like donation; requires an optimizer (a
+        # no-optimizer step has nothing to accumulate).
+        n = self._accum_built = (self.model._accum_n()
+                                 if self.opt is not None else 1)
+        if n > 1:
+            for b in batch_arrays:
+                if getattr(b, "ndim", 0) < 1 or b.shape[0] % n:
+                    raise ValueError(
+                        f"grad_accum={n}: every batch input needs a "
+                        f"leading dim divisible by {n}; got shape "
+                        f"{getattr(b, 'shape', ())} — see "
+                        "singa_tpu.data.microbatches")
+            mb = batch_arrays[0].shape[0] // n
+            stats_mod.note_accum_build(n, mb,
+                                       batch_arrays[0].shape[0])
+
+            def accum_fn(pvals, svals, ovals, key, step_counter,
+                         batch):
+                return self._accum_step(n, pvals, svals, ovals, key,
+                                        step_counter, batch)
+
+            step_fn = accum_fn
         # Donation honors the eager-config knob at build time
         # (device.set_buffer_donation); re-compile() to re-arm.
         donate = (0, 1, 2, 3) if stats_mod.donation_enabled() else ()
@@ -729,6 +910,175 @@ class _JitStep:
         """Hook for sharded subclasses (parallel.trainer.ShardedJitStep)
         to add in/out shardings over a mesh."""
         return {}
+
+    # ---- gradient accumulation (ISSUE 4) ---------------------------------
+    def _microbatch_stack(self, n, batch):
+        """Reshape every batch array [B, ...] → [n, B/n, ...] (the
+        scan axis first). Divisibility is validated at _build;
+        re-validated here because jit retraces on new shapes."""
+        out = []
+        for b in batch:
+            if getattr(b, "ndim", 0) < 1 or b.shape[0] % n:
+                raise ValueError(
+                    f"grad_accum={n}: batch shape "
+                    f"{getattr(b, 'shape', ())} has no leading dim "
+                    f"divisible by {n}")
+            out.append(b.reshape((n, b.shape[0] // n)
+                                 + tuple(b.shape[1:])))
+        return self._place_microbatches(out)
+
+    def _place_microbatches(self, micro):
+        """Hook: sharded subclasses constrain the microbatch layout
+        ([n] replicated, batch dims sharded); identity on one
+        device."""
+        return micro
+
+    def _run_accum_microbatch(self, dev, svals_c, key_c, mb):
+        """One microbatch forward+backward with the optimizer in
+        capture mode: binds states/key, runs the user's
+        train_one_batch, and returns (out_arrays, loss_array, pairs,
+        new_state_arrays, new_key). The shared body of the discovery
+        pass, the scan body, and the sharded local step."""
+        import jax.numpy as jnp
+
+        model, opt = self.model, self.opt
+        for s, v in zip(self.states, svals_c):
+            s.data = v
+        dev._rng_key = key_c
+        opt._accum_begin()
+        try:
+            out = model.train_one_batch(
+                *[tensor_mod.from_raw(b, dev) for b in mb])
+        finally:
+            cap = opt._accum_end()
+        if len(cap) != 1:
+            raise RuntimeError(
+                "gradient accumulation requires train_one_batch to "
+                "call backward_and_update exactly once per "
+                f"microbatch; it ran {len(cap)} times")
+        loss_t, pairs = cap[0]
+        loss_arr = jnp.asarray(
+            loss_t.data if isinstance(loss_t, Tensor) else loss_t)
+        return (_unwrap_out(out), loss_arr, pairs,
+                [s.data for s in self.states], dev._rng_key)
+
+    def _discover_accum_order(self, dev, svals, key, mb_specs):
+        """Learn which params receive gradients — and in what emission
+        order — by abstractly evaluating ONE microbatch
+        forward+backward under `jax.eval_shape` (no XLA compile, no
+        execution; the same zero-cost trick as the eval_shape param
+        init). The order fixes the scan carry structure. Also returns
+        the abstract per-microbatch output pytree
+        (jax.ShapeDtypeStruct leaves) — the sharded accumulation path
+        derives its shard_map out_specs from it. All bound state is
+        restored afterwards."""
+        saved_s = [s.data for s in self.states]
+        saved_key = dev._rng_key
+        order = []
+
+        def probe(svals_c, key_c, mb):
+            outs, _, pairs, _, _ = self._run_accum_microbatch(
+                dev, svals_c, key_c, mb)
+            order[:] = [p for p, _ in pairs]
+            return outs
+
+        try:
+            outs_sds = jax.eval_shape(probe, svals, key, mb_specs)
+        finally:
+            for s, v in zip(self.states, saved_s):
+                s.data = v
+            dev._rng_key = saved_key
+        if not order:
+            raise RuntimeError(
+                "gradient accumulation: the backward produced no "
+                "(param, grad) pairs — nothing to accumulate")
+        return order, outs_sds
+
+    def _accum_scan(self, dev, order, svals_init, key_init, micro):
+        """`lax.scan` the user's train_one_batch over a [n, mb, ...]
+        microbatch stack, accumulating gradients in fp32. The ONE
+        definition of the accumulation loop body — the single-device
+        step and the sharded shard_map local step both run exactly
+        this, so the modes cannot drift apart numerically. Returns
+        ((final_states, final_key, grad_sums, loss_sum),
+        stacked_outs)."""
+        import jax.numpy as jnp
+
+        acc0 = [jnp.zeros(p.data.shape, jnp.float32) for p in order]
+        ids = [id(p) for p in order]
+
+        def body(carry, mb_arrays):
+            svals_c, key_c, acc, loss_acc = carry
+            outs, loss_arr, pairs, new_s, new_key = \
+                self._run_accum_microbatch(dev, svals_c, key_c,
+                                           mb_arrays)
+            gd = {id(p): (g.data if isinstance(g, Tensor) else g)
+                  for p, g in pairs}
+            if sorted(gd) != sorted(ids):
+                raise RuntimeError(
+                    "gradient accumulation: the (param, grad) set "
+                    "changed between the discovery pass and the scan "
+                    "body")
+            # same sequential fp32 sum as the eager adder
+            # (_accum_add_fn) — the two modes accumulate
+            # bit-identically
+            acc = [a + gd[i].astype(jnp.float32)
+                   for a, i in zip(acc, ids)]
+            loss_acc = loss_acc + jnp.mean(loss_arr).astype(
+                jnp.float32)
+            return (tuple(new_s), new_key, acc, loss_acc), outs
+
+        carry0 = (tuple(svals_init), key_init, acc0,
+                  jnp.zeros((), jnp.float32))
+        return jax.lax.scan(body, carry0, micro)
+
+    def _accum_step(self, n, pvals, svals, ovals, key, step_counter,
+                    batch):
+        """The scan-fused accumulation step body: reshape the batch to
+        [n, mb, ...], `lax.scan` the user's train_one_batch over the
+        microbatches — layer states (BN running stats) and the RNG key
+        thread through the carry, gradients accumulate in fp32 — then
+        apply the optimizer exactly once on the mean via
+        `opt.apply_accumulated` (StepGuard cond, scaler unscale,
+        global-norm clip, and bf16 slot quantization all fire once on
+        the accumulated grads). XLA keeps the live activation/gradient
+        footprint at microbatch size: only the fp32 accumulator (one
+        param-sized set of arrays) persists across iterations."""
+        import jax.numpy as jnp
+
+        model, opt = self.model, self.opt
+        params, states = self.params, self.states
+        dev = self._device()
+        saved_o = self._opt_arrays()
+        saved_step = opt.step_counter
+        with _bound_model(params, states, dev, pvals, svals, key):
+            try:
+                self._bind_opt_arrays(ovals)
+                opt.step_counter = step_counter
+                micro = self._microbatch_stack(n, batch)
+                mb = micro[0].shape[1]
+                mb_specs = [jax.ShapeDtypeStruct(m.shape[1:], m.dtype)
+                            for m in micro]
+                order, _ = self._discover_accum_order(dev, svals, key,
+                                                      mb_specs)
+                (svals_f, key_f, acc, loss_sum), outs = \
+                    self._accum_scan(dev, order, svals, key, micro)
+                # rebind the post-scan values (the body's in-trace
+                # mutations died with the scan trace)
+                for s, v in zip(states, svals_f):
+                    s.data = v
+                dev._rng_key = key_f
+                opt.apply_accumulated(loss_sum,
+                                      list(zip(order, acc)), n)
+                out_arrays = _merge_accum_out(outs, mb)
+                new_p = [p.data for p in params]
+                new_s = [s.data for s in states]
+                new_o = self._opt_arrays()
+                new_key = dev._rng_key
+                return out_arrays, new_p, new_s, new_o, new_key
+            finally:
+                self._bind_opt_arrays(saved_o)
+                opt.step_counter = saved_step
 
     def _prepare_inputs(self, pvals, svals, ovals, key, batch_arrays):
         """Hook: place program inputs (sharded subclasses device_put
@@ -829,7 +1179,13 @@ class _JitStep:
         out, new_p, new_s, new_o, new_key = self._compiled(
             pvals, svals, ovals, key, step, batch_arrays
         )
-        stats_mod.count_train_step()
+        # Accumulated replays count their n microbatch invocations so
+        # train_steps agrees between eager and graph accumulation;
+        # accum_steps counts the one executed apply (the in-trace
+        # counter in apply_accumulated only fires on concrete values).
+        stats_mod.count_train_step(max(1, self._accum_built))
+        if self._accum_built > 1:
+            stats_mod.count_accum_step()
         if profiling:
             jax.block_until_ready(new_key)
             dt = time.perf_counter() - t0

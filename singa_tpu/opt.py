@@ -43,6 +43,25 @@ _FUSED_STATS = stats_mod.CacheStats("fused_opt")
 stats_mod.register_cache("fused_opt", _FUSED_STATS)
 
 
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=64)
+def _accum_finish_exec(n_total: int, dtypes: tuple):
+    """Jitted accumulation finisher for the eager path: mean + cast
+    for every accumulated gradient in ONE dispatch (accumulator
+    buffers donated). Cached per (n, dtype-tuple); jax re-caches per
+    shape set inside. Must stay expression-identical to the traced
+    inline branch in `Optimizer.apply_accumulated`."""
+    nf = jnp.float32(n_total)
+
+    def fin(acc, loss_sum):
+        return ([(a / nf).astype(dt) for a, dt in zip(acc, dtypes)],
+                jnp.asarray(loss_sum).astype(jnp.float32) / nf)
+
+    return jax.jit(fin, donate_argnums=(0,))
+
+
 class DecayScheduler:
     """Reference: `opt.DecayScheduler`. Maps step → learning rate."""
 
@@ -157,6 +176,12 @@ class Optimizer:
         # after the allreduce (`DistOpt._clip_pairs`); the partial/
         # sparse variants bypass it (per-grad streaming by design).
         self.clip_norm: Optional[float] = None
+        # Gradient-accumulation capture (ISSUE 4): while a list, each
+        # backward_and_update STASHES its (loss, pairs) instead of
+        # applying — the accumulation driver (Model's eager accum loop
+        # or the scan-fused graph step) sums the grads in fp32 and
+        # applies once via apply_accumulated.
+        self._accum_capture = None
 
     def set_clip_norm(self, value: Optional[float]):
         """Clip gradients to `value` by global L2 norm (None = off)."""
@@ -278,7 +303,7 @@ class Optimizer:
             items = []
             for k, v in sorted(vars(obj).items()):
                 if k in ("step_counter", "states", "_fused_cache",
-                         "_fused_static"):
+                         "_fused_static", "_accum_capture"):
                     continue
                 items.append((k, leaf(v)))
             return (type(obj).__name__, tuple(items))
@@ -575,6 +600,69 @@ class Optimizer:
     def __call__(self, loss: Tensor):
         return self.backward_and_update(loss)
 
+    # -- gradient-accumulation capture (ISSUE 4) ---------------------------
+    def _accum_begin(self) -> None:
+        """Arm capture mode: subsequent `backward_and_update` calls
+        stash their (loss, pairs) instead of applying. Used by the
+        accumulation drivers (Model's eager microbatch loop and the
+        scan-fused graph step); always paired with `_accum_end`."""
+        self._accum_capture = []
+
+    def _accum_end(self):
+        """Disarm capture mode and return the captured list of
+        (loss, pairs) tuples (one per backward that ran)."""
+        cap, self._accum_capture = self._accum_capture, None
+        return cap
+
+    def apply_accumulated(self, loss_sum, acc_pairs, n_total: int):
+        """Apply ONE optimizer step from fp32-accumulated gradient
+        SUMS over `n_total` microbatches: mean = sum / n_total, cast
+        to the param dtype, then the exact `apply_gradients` path a
+        monolithic step takes — so the StepGuard finite check and the
+        DynamicLossScaler unscale see the accumulated gradients once,
+        global-norm clipping clips the accumulated mean, bf16 slot
+        storage quantizes once, and the guard counters/scale advance
+        once per accumulated step. Works eagerly (concrete arrays →
+        fused update) and traced (inside the scan-fused graph step).
+
+        Division by n_total is elementwise IEEE division (never
+        reassociated by fusion), so the eager and graph accumulation
+        paths produce bit-identical means for any n."""
+        nf = jnp.float32(n_total)
+        concrete = not (isinstance(loss_sum, jax.core.Tracer) or any(
+            isinstance(a, jax.core.Tracer) for _, a in acc_pairs))
+        if concrete:
+            # eager: one jitted finisher (mean + cast for every param
+            # in one dispatch, accumulators donated)
+            fin = _accum_finish_exec(
+                int(n_total),
+                tuple(str(p.data.dtype) for p, _ in acc_pairs))
+            gs, loss_mean = fin([a for _, a in acc_pairs],
+                                jnp.asarray(loss_sum))
+        else:
+            # traced (graph step): the same expressions inline — the
+            # division/cast are elementwise, so both branches are
+            # bit-identical
+            gs = [(a / nf).astype(p.data.dtype)
+                  for p, a in acc_pairs]
+            loss_mean = jnp.asarray(loss_sum).astype(
+                jnp.float32) / nf
+        pairs = []
+        for (p, _), g in zip(acc_pairs, gs):
+            gt = tensor_mod.from_raw(g, p.device)
+            # fresh output of the accumulation program: nothing else
+            # references the buffer, so the fused update may donate it
+            gt._donatable = True
+            pairs.append((p, gt))
+        dev = pairs[0][0].device if pairs else None
+        loss_t = tensor_mod.from_raw(loss_mean, dev)
+        if not isinstance(loss_mean, jax.core.Tracer):
+            # eager path: count here; the graph step counts per
+            # executed replay in _JitStep.__call__ instead (a trace
+            # is not a step)
+            stats_mod.count_accum_step()
+        return self.apply_gradients(loss_t, pairs)
+
     def backward_and_update(self, loss: Tensor):
         """Reference: `opt.SGD.backward_and_update` — run autograd and
         apply updates per (param, grad) pair in emission order (with
@@ -585,20 +673,39 @@ class Optimizer:
         scaling the backward seed is the live scale instead of ones;
         under the step guard the fused eager update (or, traced inside
         a graph-mode step, `_guarded_traced_update`) folds the
-        all-finite check + skip-select into the compiled program."""
+        all-finite check + skip-select into the compiled program.
+
+        Under gradient-accumulation capture (`_accum_begin`) the
+        backward still runs — with the scaled seed, so accumulated
+        grads carry the scale exactly once — but the apply is
+        deferred: (loss, pairs) is stashed for `apply_accumulated`
+        and neither the optimizer step counter nor the guard state
+        advances here."""
         guard = resilience.guard_active()
         dy = None
         if guard and resilience.scaler_active():
             dy = resilience.scaled_seed(loss.data)
-        pairs = []
+        pairs = list(autograd.iter_backward(loss, dy))
+        if self._accum_capture is not None:
+            self._accum_capture.append((loss, pairs))
+            return loss
+        return self.apply_gradients(loss, pairs)
+
+    def apply_gradients(self, loss: Tensor, pairs):
+        """The post-backward half of `backward_and_update`: apply one
+        optimizer step to explicit (param, grad) pairs — fused eager
+        executable on concrete arrays, guard-folded traced updates
+        inside a jit trace — advancing the step counter once. Shared
+        by the normal backward path and `apply_accumulated`."""
+        guard = resilience.guard_active()
         eager = True
-        for p, g in autograd.iter_backward(loss, dy):
-            pairs.append((p, g))
+        for p, g in pairs:
             if (isinstance(p.data, jax.core.Tracer)
                     or isinstance(
                         g.data if isinstance(g, Tensor) else g,
                         jax.core.Tracer)):
                 eager = False
+                break
         if eager and pairs:
             # one jitted executable for ALL param updates (VERDICT r4
             # next #7) instead of one dispatch per param; global-norm
@@ -935,6 +1042,19 @@ class DistOpt(Optimizer):
         """Delegates to the wrapped optimizer (slots live there)."""
         self.opt.set_slot_dtype(dtype, exclude=exclude)
         return self
+
+    def _accum_begin(self) -> None:
+        """Gradient accumulation does not compose with the DistOpt
+        driver regime (its backward_and_* variants stream per-grad
+        allreduces from Python and never consult the capture hook —
+        silently applying per microbatch would defeat the
+        accumulation contract). Use mesh-mode
+        `Model.compile(..., mesh=..., grad_accum=n)`, where the one
+        SPMD program reduces once per accumulated step."""
+        raise RuntimeError(
+            "gradient accumulation is not supported with DistOpt; "
+            "compile the model over a mesh "
+            "(Model.compile(..., mesh=..., grad_accum=n)) instead")
 
     def slot_store_dtype(self, name, param):
         return self.opt.slot_store_dtype(name, param)

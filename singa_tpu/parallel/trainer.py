@@ -18,8 +18,9 @@ from typing import List, Optional, Sequence
 
 import jax
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..model import _JitStep
+from ..model import _JitStep, _merge_accum_out
 from .sharding import ShardingRules, batch_sharding, replicated
 
 
@@ -148,6 +149,238 @@ class ShardedJitStep(_JitStep):
             # holds the full value in its local shard; pull that.
             new_key = new_key.addressable_shards[0].data
         return jax.device_put(new_key, dev.jax_device)
+
+    # -- gradient accumulation (ISSUE 4) -----------------------------------
+    def _place_microbatches(self, micro):
+        """GSPMD fallback layout for the scan-fused accumulation: the
+        [n, mb, ...] stack keeps the scan axis replicated and the
+        microbatch dims on their normal batch sharding, so each scan
+        iteration computes on the same data-parallel layout a
+        monolithic step would."""
+        if self.batch_specs is not None:
+            specs = list(self.batch_specs)
+        else:
+            specs = [
+                batch_sharding(self.mesh, m.ndim - 1,
+                               batch_axis=self.batch_axis,
+                               seq_axis=self.seq_axis,
+                               seq_dim=self.seq_dim).spec
+                for m in micro
+            ]
+        return [
+            jax.lax.with_sharding_constraint(
+                m, NamedSharding(self.mesh, P(None, *spec)))
+            for m, spec in zip(micro, specs)
+        ]
+
+    def _accum_pure_dp(self, n, batch) -> bool:
+        """The single-reduction shard_map path applies when the step
+        is PURE data parallelism: params/states replicated, default
+        dim-0 batch sharding, no sequence axis, single controller, and
+        the per-device batch divides into n microbatches. Anything
+        else falls back to the GSPMD scan (correct, but the gradient
+        reduction stays inside the loop)."""
+        if self.batch_specs is not None or self.seq_axis is not None:
+            return False
+        if self._multiproc:
+            return False
+        if self.batch_axis not in self.mesh.shape:
+            return False
+        ndev = self.mesh.shape[self.batch_axis]
+        for b in batch:
+            if getattr(b, "ndim", 0) < 1 or b.shape[0] % (n * ndev):
+                return False
+        return all(s.spec == P() for s in self._param_shardings())
+
+    def _accum_step(self, n, pvals, svals, ovals, key, step_counter,
+                    batch):
+        """Mesh-mode accumulation. Pure-DP steps take the
+        single-reduction path: the step runs under `shard_map`, each
+        device scans its LOCAL batch shard as n microbatches
+        (accumulating local fp32 gradient partials — zero collectives
+        inside the loop), and the cross-device reduction is ONE
+        variadic `psum` of a flat fp32 bucket carrying every gradient,
+        the loss sum, and the float layer states — so an n-accum step
+        issues exactly one all-reduce, after the scan, where the
+        monolithic step issued one per batch and a Python accumulation
+        loop would issue n. The optimizer then applies on the global
+        mean inside the same program (identical on every device; the
+        StepGuard finite bit is computed from the post-psum global
+        grads, so ranks can never diverge).
+
+        Semantics notes vs the monolithic mesh step (classic
+        data-parallel semantics, documented in README): batch-coupled
+        statistics (BN) are computed per device shard and
+        psum-averaged into the running stats, and the microbatch
+        partition is per-device-local rather than global-contiguous.
+        Gradient math is unchanged — the accumulated mean equals the
+        monolithic gradient up to fp32 summation order.
+
+        Non-pure-DP configurations (TP rules, seq sharding,
+        multi-controller, indivisible local batches) fall back to the
+        GSPMD scan of the base class: same math, but GSPMD keeps the
+        gradient all-reduce inside the scan body (n reductions per
+        step — on real TPUs XLA's while-loop all-reduce code motion
+        can still hoist it)."""
+        import jax.numpy as jnp
+
+        from ..model import _bound_model
+        from ._compat import _CHECK_KW, shard_map
+
+        if not self._accum_pure_dp(n, batch):
+            return super()._accum_step(n, pvals, svals, ovals, key,
+                                       step_counter, batch)
+        mesh, ax = self.mesh, self.batch_axis
+        ndev = mesh.shape[ax]
+        dev = self._device()
+        model, opt = self.model, self.opt
+        params, states = self.params, self.states
+        mbl = batch[0].shape[0] // (n * ndev)
+        mb_specs = [
+            jax.ShapeDtypeStruct(
+                (b.shape[0] // (n * ndev),) + tuple(b.shape[1:]),
+                b.dtype)
+            for b in batch
+        ]
+        # Discovery runs at the outer level with LOCAL microbatch
+        # shapes: grad order + the per-microbatch out tree (which
+        # fixes the shard_map out_specs before any tracing).
+        saved_o = self._opt_arrays()
+        with _bound_model(params, states, dev, pvals, svals, key):
+            try:
+                self._bind_opt_arrays(ovals)
+                order, outs_sds = self._discover_accum_order(
+                    dev, svals, key, mb_specs)
+            finally:
+                self._bind_opt_arrays(saved_o)
+
+        def is_batch_leaf(sds):
+            return (getattr(sds, "ndim", 0) >= 1
+                    and sds.shape[0] == mbl)
+
+        # Non-batch INTEGER output leaves cannot ride this path
+        # honestly: the psum bucket only reduces float leaves (their
+        # mean semantics are well-defined), and presenting a
+        # device-local integer metric as global would silently report
+        # one shard's value. Such models take the GSPMD fallback,
+        # which computes every output leaf globally.
+        import jax.numpy as _jnp
+
+        for sds in jax.tree_util.tree_leaves(outs_sds):
+            if (not is_batch_leaf(sds)
+                    and not _jnp.issubdtype(sds.dtype, _jnp.inexact)):
+                return super()._accum_step(n, pvals, svals, ovals,
+                                           key, step_counter, batch)
+
+        outs_specs = jax.tree_util.tree_map(
+            lambda sds: P(ax) if is_batch_leaf(sds) else P(),
+            outs_sds)
+
+        def local_fn(pvals_l, svals_l, ovals_l, key_l, step_l,
+                     *batch_l):
+            saved_o = self._opt_arrays()
+            saved_step = opt.step_counter
+            with _bound_model(params, states, dev, pvals_l, svals_l,
+                              key_l):
+                try:
+                    self._bind_opt_arrays(list(ovals_l))
+                    opt.step_counter = step_l
+                    micro = [
+                        b.reshape((n, b.shape[0] // n)
+                                  + tuple(b.shape[1:]))
+                        for b in batch_l
+                    ]
+                    # Per-device RNG decorrelation (classic DDP
+                    # semantics): the replicated key would give every
+                    # device's shard the SAME dropout/noise masks —
+                    # fold the data-axis index in so each replica
+                    # draws an independent stream. The returned global
+                    # key advances by fold_in(key, n) — replicated,
+                    # deterministic, independent of how many splits
+                    # the model consumed.
+                    local_key = jax.random.fold_in(
+                        key_l, jax.lax.axis_index(ax))
+                    (svals_f, key_f, acc, loss_sum), outs = \
+                        self._accum_scan(dev, order, svals_l,
+                                         local_key, micro)
+                    for s, v in zip(states, svals_f):
+                        s.data = v
+                    dev._rng_key = jax.random.fold_in(
+                        key_l, np.int32(n))
+                    merged = _merge_accum_out(outs, mbl)
+                    # ---- the ONE reduction: a flat fp32 bucket of
+                    # every gradient partial + the loss sum + the
+                    # float layer states + non-batch float outputs,
+                    # psum'd in a single variadic all-reduce (the
+                    # fused-bucket idiom of DistOpt.fused_synch).
+                    fstate_ix = [
+                        i for i, s in enumerate(states)
+                        if jnp.issubdtype(jnp.asarray(s.data).dtype,
+                                          jnp.inexact)
+                    ]
+                    mleaves, mtree = jax.tree_util.tree_flatten(
+                        merged)
+                    fout_ix = [
+                        i for i, a in enumerate(mleaves)
+                        if jnp.issubdtype(jnp.asarray(a).dtype,
+                                          jnp.inexact)
+                        and not (getattr(a, "ndim", 0) >= 1
+                                 and a.shape[0] == n * mbl)
+                    ]
+                    parts = ([a.reshape(-1) for a in acc]
+                             + [loss_sum.reshape(1)]
+                             + [jnp.asarray(states[i].data)
+                                .astype(jnp.float32).reshape(-1)
+                                for i in fstate_ix]
+                             + [jnp.asarray(mleaves[i])
+                                .astype(jnp.float32).reshape(-1)
+                                for i in fout_ix])
+                    sizes = [int(np.prod(p.shape)) for p in parts]
+                    flat = (jnp.concatenate(parts)
+                            if len(parts) > 1 else parts[0])
+                    red = jax.lax.psum(flat, ax)
+                    pieces, off = [], 0
+                    for sz in sizes:
+                        pieces.append(red[off:off + sz])
+                        off += sz
+                    k = len(acc)
+                    acc = [pc.reshape(p.data.shape)
+                           for pc, p in zip(pieces[:k], order)]
+                    loss_sum = pieces[k].reshape(())
+                    k += 1
+                    for j, i in enumerate(fstate_ix):
+                        orig = states[i].data
+                        states[i].data = (
+                            (pieces[k + j] / ndev)
+                            .astype(orig.dtype).reshape(orig.shape))
+                    k += len(fstate_ix)
+                    for j, i in enumerate(fout_ix):
+                        orig = mleaves[i]
+                        mleaves[i] = (
+                            (pieces[k + j] / ndev)
+                            .astype(orig.dtype).reshape(orig.shape))
+                    merged = jax.tree_util.tree_unflatten(mtree,
+                                                          mleaves)
+                    # one apply on the global mean (n * ndev
+                    # microbatches contributed to the sums)
+                    opt.apply_accumulated(
+                        loss_sum, list(zip(order, acc)), n * ndev)
+                    new_p = [p.data for p in params]
+                    new_s = [s.data for s in states]
+                    new_o = self._opt_arrays()
+                    new_key = dev._rng_key
+                    return merged, new_p, new_s, new_o, new_key
+                finally:
+                    self._bind_opt_arrays(saved_o)
+                    opt.step_counter = saved_step
+
+        fn = shard_map(
+            local_fn, mesh=mesh,
+            in_specs=(P(), P(), P(), P(), P())
+            + tuple(P(ax) for _ in batch),
+            out_specs=(outs_specs, P(), P(), P(), P()),
+            **_CHECK_KW)
+        return fn(pvals, svals, ovals, key, step_counter, *batch)
 
     # -- jit wiring --------------------------------------------------------
     def _jit_kwargs(self, batch_arrays):

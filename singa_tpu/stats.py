@@ -45,6 +45,9 @@ __all__ = [
     "bn_stats_dtype",
     "dag_auto_flops_per_op",
     "count_train_step",
+    "grad_accum_n",
+    "note_accum_build",
+    "count_accum_step",
 ]
 
 
@@ -87,6 +90,17 @@ _CONFIG: Dict = {
     # min_scale} (normalized by configure). Setter:
     # device.set_loss_scaling.
     "loss_scaling": None,
+    # Microbatched gradient accumulation (ISSUE 4): the compiled train
+    # step reshapes its batch to [n, mb, ...] and lax.scans the
+    # forward/backward over microbatches, accumulating gradients in
+    # fp32 and applying the optimizer ONCE on the mean — effective
+    # batch beyond HBM, one gradient reduction per accumulated step on
+    # a mesh. 1 = off. Read at executable build time (the same
+    # contract as buffer_donation/step_guard): re-`compile()` an
+    # already-compiled graph-mode model after toggling. Setter:
+    # device.set_grad_accum; Model.compile(grad_accum=n) overrides
+    # per-model.
+    "grad_accum": 1,
 }
 
 _LOSS_SCALING_DEFAULTS = {
@@ -126,6 +140,10 @@ def configure(**kw) -> Dict:
             v = float(v)
             if v <= 0:
                 raise ValueError("dag_auto_flops_per_op must be > 0")
+        elif k == "grad_accum":
+            v = int(v)
+            if v < 1:
+                raise ValueError("grad_accum must be >= 1")
         elif k == "loss_scaling":
             if v is not None:
                 if not isinstance(v, dict):
@@ -361,10 +379,69 @@ def register_cache(name: str, cache) -> None:
     _CACHES[name] = cache
 
 
-def count_train_step() -> None:
-    """One train step ran (eager or graph). Lets observability report
-    per-step rates (retraces/step is the retrace-storm smoke signal)."""
-    _COUNTERS["train_steps"] += 1
+def count_train_step(n: int = 1) -> None:
+    """`n` train_one_batch invocations ran (eager or graph). Lets
+    observability report per-step rates (retraces/step is the
+    retrace-storm smoke signal). Gradient accumulation counts its n
+    microbatches in BOTH modes (eagerly via the per-microbatch
+    train_one_batch calls; per graph replay via n here), so the
+    counter means the same thing whichever mode trained."""
+    _COUNTERS["train_steps"] += n
+
+
+def grad_accum_n() -> int:
+    """Configured gradient-accumulation factor (1 = off)."""
+    return _CONFIG["grad_accum"]
+
+
+class _AccumStats:
+    """cache_stats()["accum"]: the gradient-accumulation view —
+    configured n, the last built step's microbatch/effective batch
+    (None until an accum step compiles or an eager accum step runs),
+    and how many accumulated optimizer steps were applied. Counters
+    reset with reset_cache_stats(); the build notes describe the live
+    executables and survive the reset."""
+
+    def __init__(self):
+        self.accum_steps = 0
+        self.last_n = None
+        self.microbatch = None
+        self.effective_batch = None
+
+    def note_build(self, n: int, microbatch: int,
+                   effective_batch: int) -> None:
+        self.last_n = int(n)
+        self.microbatch = int(microbatch)
+        self.effective_batch = int(effective_batch)
+
+    def snapshot(self) -> Dict:
+        return {
+            "configured_n": _CONFIG["grad_accum"],
+            "n": self.last_n,
+            "microbatch": self.microbatch,
+            "effective_batch": self.effective_batch,
+            "accum_steps": self.accum_steps,
+        }
+
+    def reset(self) -> None:
+        self.accum_steps = 0
+
+
+_ACCUM = _AccumStats()
+register_cache("accum", _ACCUM)
+
+
+def note_accum_build(n: int, microbatch: int,
+                     effective_batch: int) -> None:
+    """Record the microbatch geometry of an accumulation step at
+    build/dispatch time (shown in cache_stats()['accum'])."""
+    _ACCUM.note_build(n, microbatch, effective_batch)
+
+
+def count_accum_step() -> None:
+    """One ACCUMULATED optimizer step applied (n microbatches -> one
+    update)."""
+    _ACCUM.accum_steps += 1
 
 
 def cache_stats() -> Dict:

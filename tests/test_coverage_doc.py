@@ -33,6 +33,18 @@ def test_fault_tolerance_row_and_readme_section_present():
     assert "set_step_guard" in readme and "set_loss_scaling" in readme
 
 
+def test_grad_accum_row_and_readme_section_present():
+    """ISSUE 4 doc contract: the P14 gradient-accumulation row and
+    the README "Gradient accumulation" section exist (path rot in
+    either is caught by test_all_cited_paths_exist)."""
+    cov = open(os.path.join(_ROOT, "COVERAGE.md")).read()
+    assert "| P14 |" in cov
+    assert "tests/test_accum.py" in cov
+    readme = open(os.path.join(_ROOT, "README.md")).read()
+    assert "## Gradient accumulation" in readme
+    assert "set_grad_accum" in readme and "microbatches" in readme
+
+
 def test_all_cited_paths_exist():
     text = open(os.path.join(_ROOT, "COVERAGE.md")).read()
     missing = []

@@ -107,6 +107,10 @@ def main():
                 f", {k}={r[k]}" for k in ("slot_dtype", "bn_stats_dtype",
                                           "xla_profile")
                 if r.get(k) not in (None, "fp32", "default"))
+            # accumulation matrix column (ISSUE 4): bs is the
+            # EFFECTIVE batch; show the scan geometry alongside
+            if r.get("accum", 1) != 1:
+                diet += f", accum=x{r['accum']}(mb{r['microbatch']})"
             rows.append((stage,
                          f"{r['ips']:.1f} img/s  ({r['step_ms']:.1f} "
                          f"ms/step, bs{r['batch']}, {r.get('precision')}"
