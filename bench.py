@@ -234,8 +234,14 @@ def stage_resnet(batch, steps, deadline_s, amp=False, remat=False,
     TFLOP/s peak; ADVICE.md r3 #1). Pipelined wall-clock over N>=10
     steps is the honest steady-state throughput: it is how the device
     runs in a real input pipeline.
-    """
 
+    Observability (ISSUE 5): the result carries `stage_seconds`
+    (setup / compile / steady wall-time breakdown — where a failed
+    window actually went) and `metrics_jsonl`, the path of the
+    per-block structured metrics log this stage appends
+    (`tools/tpu_watch.sh metrics` tails it live).
+    """
+    t_stage0 = time.time()
     _setup_jax(xla_profile)
     sys.path.insert(0, os.path.join(HERE, "examples", "cnn"))
     sys.path.insert(0, os.path.join(HERE, "examples", "cnn", "model"))
@@ -295,6 +301,7 @@ def stage_resnet(batch, steps, deadline_s, amp=False, remat=False,
     tx = tensor.from_raw(x_dev, dev)
     ty = tensor.from_raw(y_dev, dev)
     log(f"inputs on device (bs={batch}, amp={amp})")
+    setup_s = time.time() - t_stage0
 
     t0 = time.time()
     m.compile([tx], is_train=True, use_graph=True)
@@ -306,6 +313,15 @@ def stage_resnet(batch, steps, deadline_s, amp=False, remat=False,
     loss.data.block_until_ready()
     first_step = time.time() - t0
     log(f"first step (XLA compile + run): {first_step:.1f}s")
+
+    # Structured per-block metrics (singa_tpu.trace.MetricsLogger):
+    # appended under metrics/ so `tools/tpu_watch.sh metrics` can tail
+    # a live run; the path rides the result JSON.
+    from singa_tpu import trace as trace_mod
+
+    mpath = os.path.join(HERE, "metrics", "bench_resnet.jsonl")
+    mlog = trace_mod.MetricsLogger(mpath)
+    t_steady0 = time.time()
 
     def run_block(n):
         t0 = time.time()
@@ -326,6 +342,12 @@ def stage_resnet(batch, steps, deadline_s, amp=False, remat=False,
         log(f"bs{batch} {chunk}-step block: {dt * 1e3:.1f} ms/step "
             f"({batch / dt:.1f} img/s)")
         blocks.append(dt)
+        # run_block already fenced, so the loss read is free here
+        mlog.log_step(n_done, loss=float(loss.to_numpy()),
+                      examples=batch * chunk, step_s=dt * chunk,
+                      batch=batch, precision="bf16" if amp else "fp32")
+    steady_s = time.time() - t_steady0
+    mlog.close()
     if not blocks:
         print(json.dumps({"ok": False, "error": "no steps completed"}),
               flush=True)
@@ -348,6 +370,13 @@ def stage_resnet(batch, steps, deadline_s, amp=False, remat=False,
            "accum": accum,
            "microbatch": batch // accum,
            "compile_s": round(host_compile + first_step, 1),
+           # per-stage wall-time breakdown (ISSUE 5): where the window
+           # went — tools/fold_onchip.py renders the column
+           "stage_seconds": {"setup": round(setup_s, 1),
+                             "compile": round(host_compile + first_step,
+                                              1),
+                             "steady": round(steady_s, 1)},
+           "metrics_jsonl": os.path.relpath(mpath, HERE),
            "loss": round(float(loss.to_numpy()), 3)}
     if accum > 1:
         out["accum_images_per_sec"] = round(ips, 2)
@@ -425,6 +454,7 @@ def stage_lm(batch, seq, steps, deadline_s):
     (secondary metric; ResNet img/s stays the headline)."""
     import numpy as np
 
+    t_stage0 = time.time()
     _setup_jax()
     import jax
 
@@ -448,13 +478,14 @@ def stage_lm(batch, seq, steps, deadline_s):
                            .astype(np.int32), device=dev)
     ty = tensor.from_numpy(rs.randint(0, V, (batch, seq))
                            .astype(np.int32), device=dev)
+    setup_s = time.time() - t_stage0
     t0 = time.time()
     m.compile([tx], is_train=True, use_graph=True)
-    log(f"lm host setup: {time.time() - t0:.1f}s")
-    t0 = time.time()
     out, loss = m(tx, ty)
     loss.data.block_until_ready()
-    log(f"lm first step: {time.time() - t0:.1f}s")
+    compile_s = time.time() - t0
+    log(f"lm host setup + first step: {compile_s:.1f}s")
+    t_steady0 = time.time()
     best = None
     done = 0
     while done < steps and time.time() < hard_stop:
@@ -480,6 +511,9 @@ def stage_lm(batch, seq, steps, deadline_s):
                    + ("+flash" if flash else "")),
         "tokens_per_sec": round(batch * seq / best, 1),
         "step_ms": round(best * 1e3, 2),
+        "stage_seconds": {"setup": round(setup_s, 1),
+                          "compile": round(compile_s, 1),
+                          "steady": round(time.time() - t_steady0, 1)},
         "loss": round(float(loss.to_numpy()), 3)}), flush=True)
 
 
@@ -494,6 +528,7 @@ def stage_bert(batch, seq, steps, deadline_s, slot_dtype=None,
     the mechanics tests."""
     import numpy as np
 
+    t_stage0 = time.time()
     _setup_jax(xla_profile)
     sys.path.insert(0, os.path.join(HERE, "examples", "onnx"))
     import jax
@@ -521,13 +556,19 @@ def stage_bert(batch, seq, steps, deadline_s, slot_dtype=None,
     ty = tensor.from_numpy(rs.randint(0, C, batch).astype(np.int32),
                            device=dev)
     log(f"bert built (V{V} d{D}h{H}l{L} seq{S}): {time.time() - t0:.1f}s")
+    setup_s = time.time() - t_stage0
     t0 = time.time()
     m.compile([tx], is_train=True, use_graph=True)
     log(f"bert host setup: {time.time() - t0:.1f}s")
-    t0 = time.time()
     out, loss = m(tx, ty)
     loss.data.block_until_ready()
-    log(f"bert first step: {time.time() - t0:.1f}s")
+    compile_s = time.time() - t0
+    log(f"bert compile + first step: {compile_s:.1f}s")
+    from singa_tpu import trace as trace_mod
+
+    mpath = os.path.join(HERE, "metrics", "bench_bert.jsonl")
+    mlog = trace_mod.MetricsLogger(mpath)
+    t_steady0 = time.time()
     best = None
     done = 0
     while done < steps and time.time() < hard_stop:
@@ -541,8 +582,12 @@ def stage_bert(batch, seq, steps, deadline_s, slot_dtype=None,
         done += n
         log(f"bert {n}-step block: {dt * 1e3:.1f} ms/step "
             f"({batch * S / dt / 1e3:.1f}k tok/s)")
+        mlog.log_step(done, loss=float(loss.to_numpy()),
+                      examples=batch * S * n, step_s=dt * n,
+                      batch=batch, seq=S)
         if best is None or dt < best:
             best = dt
+    mlog.close()
     if best is None:
         print(json.dumps({"ok": False, "error": "no steps"}), flush=True)
         return
@@ -552,6 +597,10 @@ def stage_bert(batch, seq, steps, deadline_s, slot_dtype=None,
         "slot_dtype": slot_dtype or "fp32",
         "tokens_per_sec": round(batch * S / best, 1),
         "step_ms": round(best * 1e3, 2),
+        "stage_seconds": {"setup": round(setup_s, 1),
+                          "compile": round(compile_s, 1),
+                          "steady": round(time.time() - t_steady0, 1)},
+        "metrics_jsonl": os.path.relpath(mpath, HERE),
         "loss": round(float(loss.to_numpy()), 3)}), flush=True)
     # The result is flushed; skip interpreter/PJRT teardown. The large
     # imported-ONNX graph occasionally segfaults the CPU PJRT client's

@@ -172,6 +172,71 @@ def _measure_guard(steps):
     return off, on, (on - off) / off * 100.0
 
 
+def _measure_trace(steps):
+    """Tracer on/off A/B on the eager hot path (ISSUE 5 acceptance:
+    disabled tracer < 1 % — it is a strict no-op, `span()` returns a
+    shared null context and records NOTHING, proven by the zero span
+    count — and the enabled tracer < 5 %: two host spans per eager
+    step, train_one_batch + opt_apply). Same median-of-blocks
+    methodology as the guard A/B."""
+    from singa_tpu import device, layer, model, opt, stats, tensor
+
+    class MLP(model.Model):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = layer.Linear(256)
+            self.r1 = layer.ReLU()
+            self.fc2 = layer.Linear(10)
+
+        def forward(self, x):
+            return self.fc2(self.r1(self.fc1(x)))
+
+    dev = device.get_default_device()
+    rs = np.random.RandomState(0)
+    tx = tensor.from_numpy(rs.randn(64, 784).astype(np.float32),
+                           device=dev)
+    ty = tensor.from_numpy(rs.randint(0, 10, 64).astype(np.int32),
+                           device=dev)
+
+    def spans():
+        return stats.cache_stats()["trace"]["spans"]
+
+    def run(tracing):
+        device.set_tracing(tracing)
+        try:
+            dev.SetRandSeed(0)
+            m = MLP()
+            m.set_optimizer(opt.SGD(lr=0.05, momentum=0.9))
+            m.compile([tx], is_train=True, use_graph=False)
+            for _ in range(5):
+                out, loss = m(tx, ty)
+            loss.data.block_until_ready()
+            s0 = spans()
+            blocks = []
+            for _ in range(5):
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    out, loss = m(tx, ty)
+                loss.data.block_until_ready()
+                blocks.append((time.perf_counter() - t0) / steps)
+            per_step = (spans() - s0) / (5 * steps)
+            return sorted(blocks)[len(blocks) // 2], per_step
+        finally:
+            device.set_tracing(False)
+
+    off, off_spans = run(False)
+    on, on_spans = run(True)
+    return {
+        "off_step_ms": round(off * 1e3, 4),
+        "on_step_ms": round(on * 1e3, 4),
+        "trace_overhead_pct": round((on - off) / off * 100.0, 2),
+        # the deterministic half of the contract: the disabled path
+        # records literally nothing
+        "spans_per_step": {"disabled": off_spans,
+                           "enabled": round(on_spans, 2)},
+    }
+
+
 def _measure_accum(steps, n=8):
     """Gradient-accumulation dispatch amortization on the eager path
     (ISSUE 4): process the SAME n microbatches either as n independent
@@ -354,6 +419,13 @@ def main():
           f"on_ms={guard['on_step_ms']} "
           f"step_guard_overhead_pct={guard['overhead_pct']}")
 
+    # -- Part 1b2: tracer on/off A/B (singa_tpu.trace, ISSUE 5) -----------
+    tr = _measure_trace(30 if a.quick else max(steps, 50))
+    print(f"tracer off_ms={tr['off_step_ms']} on_ms={tr['on_step_ms']} "
+          f"trace_overhead_pct={tr['trace_overhead_pct']} "
+          f"spans_per_step disabled={tr['spans_per_step']['disabled']} "
+          f"enabled={tr['spans_per_step']['enabled']}")
+
     # -- Part 1c: gradient-accumulation dispatch amortization -------------
     accum = _measure_accum(5 if a.quick else max(10, steps // 3))
     print(f"accum_demo n={accum['n']} mb={accum['microbatch']} "
@@ -403,6 +475,7 @@ def main():
         "ratio": round(eager / graph, 2),
         "eager_us_per_op": round(per_op_us, 1),
         "step_guard": guard,
+        "trace": tr,
         "accum": accum,
         "demo": demo,
     }), flush=True)

@@ -37,6 +37,7 @@ from . import snapshot  # noqa: F401
 from . import sonnx  # noqa: F401
 from . import stats  # noqa: F401
 from . import tensor  # noqa: F401
+from . import trace  # noqa: F401
 from .model import Model  # noqa: F401
 from .stats import cache_stats, reset_cache_stats  # noqa: F401
 from .device import (  # noqa: F401

@@ -30,6 +30,8 @@ class BatchIter:
         self.prefetch = prefetch
 
     def __iter__(self) -> Iterator:
+        from . import trace as trace_mod
+
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
         _END = object()
         closed = threading.Event()
@@ -67,7 +69,11 @@ class BatchIter:
         t.start()
         try:
             while True:
-                item = q.get()
+                # data_wait: how long the training loop stalls on the
+                # host input pipeline (singa_tpu.trace span; the
+                # per-step number MetricsLogger reports)
+                with trace_mod.span("data_wait"):
+                    item = q.get()
                 if item is _END:
                     break
                 if isinstance(item, tuple) and len(item) >= 2 \

@@ -65,6 +65,9 @@ __all__ = [
     "set_loss_scaling",
     # Microbatched gradient accumulation (ISSUE 4).
     "set_grad_accum",
+    # Observability (ISSUE 5): span tracer + device-profiler window
+    # (singa_tpu.trace owns the state).
+    "set_tracing",
     # Migration aliases (reference names):
     "create_cuda_gpu",
     "create_cuda_gpu_on",
@@ -499,6 +502,31 @@ def set_grad_accum(n: int) -> None:
     from . import stats
 
     stats.configure(grad_accum=n)
+
+
+def set_tracing(flag: bool = True, ring_capacity: Optional[int] = None,
+                profile_dir: Optional[str] = None) -> None:
+    """Toggle the span-based host tracer (`singa_tpu.trace`).
+
+    Disabled (the default) the tracer is a strict no-op — `span()`
+    hands back a shared null context, nothing is recorded. Enabled,
+    spans land in a bounded ring buffer: the step path is pre-wired
+    (`BatchIter` data-wait, eager `train_one_batch` + fused optimizer
+    apply, graph-step dispatch vs `block_until_ready` device-sync,
+    sharded placement, resumable-loop checkpoint save/restore), so a
+    training loop wrapped in `trace.step_span(i)` decomposes each
+    step for `trace.export_chrome_trace(path)` (Perfetto-loadable),
+    `trace.format_summary()`, and the `MetricsLogger` per-step JSONL.
+    NOTE: enabling adds a device sync per graph-mode step (the
+    device_sync span needs a fence to mean anything) — leave it off
+    for peak-throughput runs. `ring_capacity` resizes the span ring
+    (default 16384 spans); `profile_dir` is where
+    `trace.profile_steps(n)` writes `jax.profiler` device traces.
+    Counters: `cache_stats()["trace"]`."""
+    from . import trace
+
+    trace.configure(enabled=flag, ring_capacity=ring_capacity,
+                    profile_dir=profile_dir)
 
 
 def set_dag_auto_flops_per_op(v: float) -> None:

@@ -32,6 +32,20 @@ class Metric:
     def __call__(self, x, y) -> float:
         return self.evaluate(x, y)
 
+    def register(self, logger, name=None):
+        """Register this metric into a `trace.MetricsLogger`: every
+        `log_step(..., outputs=..., labels=...)` evaluates it and the
+        value lands under `record["metrics"][name]` — eval metrics in
+        the same JSONL stream as the loss (ISSUE 5). `name` defaults
+        to the lowercased class name. Returns self (chainable):
+
+            with trace.MetricsLogger(path) as ml:
+                metric.Accuracy().register(ml, "top1")
+        """
+        logger.register_metric(name or type(self).__name__.lower(),
+                               self)
+        return self
+
 
 class Accuracy(Metric):
     """Reference: `metric.Accuracy(top_k)` — fraction of samples whose

@@ -33,7 +33,8 @@ from typing import Dict, List, Optional
 import jax
 import numpy as np
 
-from . import autograd, stats as stats_mod, tensor as tensor_mod
+from . import autograd, stats as stats_mod, tensor as tensor_mod, \
+    trace as trace_mod
 from .layer import Layer
 from .tensor import Tensor
 
@@ -317,9 +318,14 @@ class Model(Layer):
         if self._use_graph:
             return self.train_one_batch_graph(*batch)
         n = self._accum_n()
-        if n > 1 and self._optimizer is not None:
-            return self._train_one_batch_accum_eager(n, *batch)
-        return self.train_one_batch(*batch)
+        # Spanned HERE (not in train_one_batch) so user models that
+        # override train_one_batch wholesale — the reference idiom —
+        # still get the eager step on the timeline; the graph path
+        # gets its dispatch/device_sync spans in _JitStep instead.
+        with trace_mod.span("train_one_batch"):
+            if n > 1 and self._optimizer is not None:
+                return self._train_one_batch_accum_eager(n, *batch)
+            return self.train_one_batch(*batch)
 
     def _accum_n(self) -> int:
         """Effective gradient-accumulation factor: the per-model
@@ -524,7 +530,7 @@ class Model(Layer):
         return meta.get("aux", {})
 
     def fit_resumable(self, manager, batch_fn, total_steps: int,
-                      save_every: int = 10):
+                      save_every: int = 10, metrics=None):
         """Crash-consistent training loop: restore the latest VALID
         checkpoint from `manager` (a `checkpoint.CheckpointManager` —
         corrupt/truncated newest checkpoints are skipped via their
@@ -532,13 +538,15 @@ class Model(Layer):
         checkpointing every `save_every` steps. `batch_fn(step)` must
         deterministically produce that step's (x, y) batch so a
         resumed run's loss trajectory matches the uninterrupted one.
-        Returns {step: loss} for the steps this call ran. See
-        `singa_tpu.resilience.run_resumable`."""
+        `metrics` (a `trace.MetricsLogger`) logs one structured JSONL
+        record per executed step. Returns {step: loss} for the steps
+        this call ran. See `singa_tpu.resilience.run_resumable`."""
         from . import resilience
 
         return resilience.run_resumable(self, manager, batch_fn,
                                         total_steps,
-                                        save_every=save_every)
+                                        save_every=save_every,
+                                        metrics=metrics)
 
 
 def _lazy_snapshot(root: Layer):
@@ -1176,9 +1184,17 @@ class _JitStep:
             except Exception:
                 self._hlo_rows = []
         t0 = time.perf_counter() if profiling else 0.0
-        out, new_p, new_s, new_o, new_key = self._compiled(
-            pvals, svals, ovals, key, step, batch_arrays
-        )
+        # dispatch: host time to enqueue the compiled program (first
+        # call: trace+compile). device_sync below only exists while
+        # tracing — an unconditional fence would break the pipelined
+        # steady state this step is designed for.
+        with trace_mod.span("dispatch"):
+            out, new_p, new_s, new_o, new_key = self._compiled(
+                pvals, svals, ovals, key, step, batch_arrays
+            )
+        if trace_mod.enabled():
+            with trace_mod.span("device_sync"):
+                jax.block_until_ready(new_key)
         # Accumulated replays count their n microbatch invocations so
         # train_steps agrees between eager and graph accumulation;
         # accum_steps counts the one executed apply (the in-trace

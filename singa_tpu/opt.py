@@ -20,7 +20,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import autograd, resilience, stats as stats_mod, tensor as tensor_mod
+from . import autograd, resilience, stats as stats_mod, \
+    tensor as tensor_mod, trace as trace_mod
 from .tensor import Tensor
 
 # _DONATION_FILTER: donated-but-unaliased buffers are deliberate
@@ -575,10 +576,14 @@ class Optimizer:
             # replay the executable (the donated-buffers lowering
             # warning is suppressed module-wide, see _DONATION_FILTER).
             t0 = time.perf_counter()
-            out = fn(*call_args)
+            with trace_mod.span("opt_apply"):
+                out = fn(*call_args)
             _FUSED_STATS.record_trace(time.perf_counter() - t0)
         else:
-            out = fn(*call_args)
+            # opt_apply: the one fused optimizer dispatch of an eager
+            # step (singa_tpu.trace span; null context when disabled)
+            with trace_mod.span("opt_apply"):
+                out = fn(*call_args)
         if guard:
             new_values, new_slots, new_gstate = out
             resilience.bind_state_arrays(new_gstate)

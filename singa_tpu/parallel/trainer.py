@@ -20,6 +20,7 @@ import jax
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .. import trace as trace_mod
 from ..model import _JitStep, _merge_accum_out
 from .sharding import ShardingRules, batch_sharding, replicated
 
@@ -129,18 +130,23 @@ class ShardedJitStep(_JitStep):
 
     def _prepare_inputs(self, pvals, svals, ovals, key, batch_arrays):
         """device_put everything to its mesh layout (no-op for arrays
-        already placed — users may rebind p.data to host arrays)."""
-        rep = replicated(self.mesh)
-        pvals = [self._gput(v, s)
-                 for v, s in zip(pvals, self._param_shardings())]
-        svals = [self._gput(v, rep) for v in svals]
-        ovals = [self._gput(v, s)
-                 for v, s in zip(ovals, self._opt_shardings())]
-        key = self._gput(key, rep)
-        batch_arrays = tuple(
-            self._gput(b, s)
-            for b, s in zip(batch_arrays, self._batch_shardings(batch_arrays))
-        )
+        already placed — users may rebind p.data to host arrays).
+        Traced as a "shard_place" span: re-placement cost here means
+        something upstream keeps handing the step host/off-mesh
+        arrays every step."""
+        with trace_mod.span("shard_place"):
+            rep = replicated(self.mesh)
+            pvals = [self._gput(v, s)
+                     for v, s in zip(pvals, self._param_shardings())]
+            svals = [self._gput(v, rep) for v in svals]
+            ovals = [self._gput(v, s)
+                     for v, s in zip(ovals, self._opt_shardings())]
+            key = self._gput(key, rep)
+            batch_arrays = tuple(
+                self._gput(b, s)
+                for b, s in zip(batch_arrays,
+                                self._batch_shardings(batch_arrays))
+            )
         return pvals, svals, ovals, key, batch_arrays
 
     def _restore_key(self, new_key, dev):

@@ -43,6 +43,7 @@ from __future__ import annotations
 import hashlib
 import os
 import sys
+import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
@@ -483,7 +484,8 @@ class FaultInjector:
 # ---------------------------------------------------------------------------
 def run_resumable(model, manager, batch_fn: Callable[[int], tuple],
                   total_steps: int, save_every: int = 10,
-                  aux_extra: Optional[Dict] = None) -> Dict[int, float]:
+                  aux_extra: Optional[Dict] = None,
+                  metrics=None) -> Dict[int, float]:
     """Resumable training loop: restore the latest VALID checkpoint
     (corrupt/truncated newest ones are skipped, see
     `CheckpointManager.restore_latest`), then train steps
@@ -494,23 +496,48 @@ def run_resumable(model, manager, batch_fn: Callable[[int], tuple],
     deterministic function of the step number is what makes the
     resumed loss trajectory match the uninterrupted run exactly.
 
+    Observability (singa_tpu.trace): each step runs under a
+    `trace.step_span` whose children decompose it — data_wait (the
+    batch_fn call, plus any BatchIter wait inside it), the model's
+    dispatch/device_sync spans, and checkpoint_save/checkpoint_restore
+    around manager I/O. `metrics` (a `trace.MetricsLogger`) appends
+    one structured JSONL record per executed step (loss, examples/sec,
+    the span timings, cache/resilience/accum counters) — the record is
+    flushed before the step's checkpoint can publish, so a killed run
+    keeps a log at least as far as its last durable checkpoint.
+
     Returns {step: loss} for the steps THIS invocation ran. A fresh
     process that crashed mid-run calls this again with the same
     arguments and continues where the last durable checkpoint left
     off; also exposed as `Model.fit_resumable`.
     """
-    start, _aux = manager.restore_latest(model)
+    from . import trace as trace_mod
+
+    with trace_mod.span("checkpoint_restore"):
+        start, _aux = manager.restore_latest(model)
     start = 0 if start is None else int(start)
     losses: Dict[int, float] = {}
     for step in range(start + 1, int(total_steps) + 1):
-        x, y = batch_fn(step)
-        _, loss = model(x, y)
-        losses[step] = float(np.asarray(
-            loss.to_numpy() if hasattr(loss, "to_numpy") else loss))
+        t0 = time.perf_counter()
+        with trace_mod.step_span(step):
+            with trace_mod.span("data_wait"):
+                x, y = batch_fn(step)
+            _, loss = model(x, y)
+            with trace_mod.span("device_sync"):
+                losses[step] = float(np.asarray(
+                    loss.to_numpy() if hasattr(loss, "to_numpy")
+                    else loss))
+        if metrics is not None:
+            shape = getattr(x, "shape", None)
+            metrics.log_step(
+                step, loss=losses[step],
+                examples=shape[0] if shape else None,
+                step_s=time.perf_counter() - t0)
         if step % save_every == 0 or step == total_steps:
             aux = {"resumable_step": step}
             if aux_extra:
                 aux.update(aux_extra)
-            manager.save(model, step=step, aux_states=aux)
+            with trace_mod.span("checkpoint_save"):
+                manager.save(model, step=step, aux_states=aux)
     manager.wait_all()
     return losses

@@ -1,0 +1,632 @@
+"""Step-timeline tracing, structured metrics logging, and device-
+profiler hooks (ISSUE 5) — the standard instrumentation surface.
+
+The reference's observability is a per-op wall-time table
+(`Device::PrintTimeProfiling`); the TPU-native step is one opaque XLA
+program, so op tables cannot say where a STEP spends its wall time —
+waiting on the host input pipeline, dispatching the executable, or
+blocked on the device. TVM (arXiv:1802.04799) makes the general point
+(an optimizing stack is only as good as its cost visibility) and
+µ-cuDNN (arXiv:1804.04806) the specific one (per-microbatch timing is
+what justifies decomposition choices). Three pieces:
+
+  - **Span tracer** — `span(name)` context managers, nestable and
+    thread-safe, recorded into a bounded ring buffer. Disabled (the
+    default) it is a strict no-op: `span()` returns a shared null
+    context, nothing is recorded, nothing allocates. Spans are
+    pre-wired through the whole step path (`data.BatchIter`
+    data-wait, eager `train_one_batch` + the fused optimizer apply,
+    `_JitStep` dispatch vs `block_until_ready` device-sync,
+    `ShardedJitStep` shard placement, `run_resumable`
+    checkpoint save/restore). Enable: `device.set_tracing(True)`.
+    Export: `export_chrome_trace(path)` (Chrome trace-event /
+    Perfetto JSON) or the per-step `format_summary()` table.
+  - **MetricsLogger** — one schema-stable JSONL record per training
+    step (step, loss, examples/sec, data-wait / dispatch /
+    device-sync seconds, `cache_stats` counter deltas,
+    resilience/accum counters, registered eval metrics), flushed
+    record-atomically so a killed run (PR 3's `fit_resumable`)
+    leaves a parseable log — `read_metrics` tolerates the one
+    partial trailing line a kill mid-write can leave.
+  - **Device profiler hook** — `profile_steps(n)` arms
+    `jax.profiler` tracing for the next n step spans, so bench runs
+    capture REAL device traces for steps k..k+n, not host proxies.
+
+Counters surface in `cache_stats()["trace"]` and reset with
+`reset_cache_stats()` (ring entries survive the reset — resetting
+observability must not lose the timeline, the same contract as the
+executable caches keeping their entries).
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from . import stats as stats_mod
+
+__all__ = [
+    "configure",
+    "get_config",
+    "enabled",
+    "span",
+    "step_span",
+    "records",
+    "clear",
+    "last_step_timings",
+    "export_chrome_trace",
+    "span_summary",
+    "format_summary",
+    "profile_steps",
+    "MetricsLogger",
+    "read_metrics",
+    "default_metrics_path",
+]
+
+SCHEMA_VERSION = 1
+
+_LOCK = threading.RLock()
+_ENABLED = False
+_RING: deque = deque(maxlen=16384)
+_NEXT_ID = itertools.count(1)  # .__next__ is atomic in CPython
+_TLS = threading.local()
+_PROFILE: Optional[Dict] = None
+_PROFILE_DIR = "/tmp/singa_tpu_profile"
+_LAST_STEP: Optional[Dict] = None
+
+
+class _TraceStats:
+    """cache_stats()["trace"]: spans recorded / dropped by the ring /
+    step spans closed / chrome exports written. reset() zeroes the
+    counters; the ring itself is cleared only by `trace.clear()`."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        self.spans = 0
+        self.dropped = 0
+        self.steps = 0
+        self.exports = 0
+
+    def snapshot(self) -> Dict:
+        return {
+            "enabled": _ENABLED,
+            "spans": self.spans,
+            "dropped": self.dropped,
+            "steps": self.steps,
+            "exports": self.exports,
+            "ring_size": len(_RING),
+            "ring_capacity": _RING.maxlen,
+        }
+
+
+_STATS = _TraceStats()
+stats_mod.register_cache("trace", _STATS)
+
+
+# ---------------------------------------------------------------------------
+# Config (user-facing setter: device.set_tracing — the reference's
+# config surface, same pattern as every other knob).
+# ---------------------------------------------------------------------------
+def configure(enabled: Optional[bool] = None,
+              ring_capacity: Optional[int] = None,
+              profile_dir: Optional[str] = None) -> Dict:
+    global _ENABLED, _RING, _PROFILE_DIR
+    with _LOCK:
+        if ring_capacity is not None:
+            cap = int(ring_capacity)
+            if cap < 1:
+                raise ValueError("ring_capacity must be >= 1")
+            if cap != _RING.maxlen:
+                _RING = deque(_RING, maxlen=cap)
+        if profile_dir is not None:
+            _PROFILE_DIR = str(profile_dir)
+        if enabled is not None:
+            _ENABLED = bool(enabled)
+    return get_config()
+
+
+def get_config() -> Dict:
+    return {"enabled": _ENABLED, "ring_capacity": _RING.maxlen,
+            "profile_dir": _PROFILE_DIR}
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+# ---------------------------------------------------------------------------
+# Spans
+# ---------------------------------------------------------------------------
+def _stack() -> list:
+    st = getattr(_TLS, "stack", None)
+    if st is None:
+        st = _TLS.stack = []
+    return st
+
+
+class _NullSpan:
+    """The disabled-tracer span: a shared, stateless no-op context."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("name", "args", "id", "parent", "depth", "t0")
+
+    def __init__(self, name: str, args: Optional[Dict]):
+        self.name = name
+        self.args = args
+
+    def __enter__(self):
+        st = _stack()
+        self.depth = len(st)
+        self.parent = st[-1].id if st else None
+        self.id = next(_NEXT_ID)
+        st.append(self)
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter()
+        st = _stack()
+        if st and st[-1] is self:
+            st.pop()
+        else:  # mismatched exit (generator teardown): best-effort
+            try:
+                st.remove(self)
+            except ValueError:
+                pass
+        frame = getattr(_TLS, "step_frame", None)
+        rec = {
+            "name": self.name,
+            # µs on the shared perf_counter clock (what Chrome "ts"
+            # wants; absolute origin is irrelevant, only deltas are)
+            "ts": self.t0 * 1e6,
+            "dur": (t1 - self.t0) * 1e6,
+            "tid": threading.get_ident(),
+            "id": self.id,
+            "parent": self.parent,
+            "depth": self.depth,
+            "step": frame["step"] if frame is not None else None,
+        }
+        if self.args:
+            rec["args"] = self.args
+        with _LOCK:
+            if not _ENABLED:
+                return False  # disabled mid-span: drop silently
+            if len(_RING) == _RING.maxlen:
+                _STATS.dropped += 1
+            _RING.append(rec)
+            _STATS.spans += 1
+            if frame is not None and self.name != "step":
+                acc = frame["acc"]
+                acc[self.name] = acc.get(self.name, 0.0) + (t1 - self.t0)
+        return False
+
+
+def span(name: str, **args):
+    """Context manager timing one named host span. Nests (thread-local
+    stack fixes depth/parent), records into the bounded ring on exit.
+    Strict no-op while tracing is disabled: the shared `_NULL` context
+    is returned, nothing is recorded or allocated."""
+    if not _ENABLED:
+        return _NULL
+    return _Span(name, args or None)
+
+
+class _StepCtx:
+    """One training step: opens a "step" span, accumulates child span
+    durations by name (the per-step data_wait / dispatch / device_sync
+    decomposition `MetricsLogger` reads via `last_step_timings`), and
+    drives the jax.profiler window armed by `profile_steps`."""
+
+    __slots__ = ("step", "_span", "_frame", "_prev_frame", "_t0")
+
+    def __init__(self, step):
+        self.step = step
+
+    def __enter__(self):
+        _profile_step_started()
+        if not _ENABLED:
+            self._span = None
+            return self
+        self._prev_frame = getattr(_TLS, "step_frame", None)
+        self._frame = {"step": self.step, "acc": {}}
+        _TLS.step_frame = self._frame
+        self._t0 = time.perf_counter()
+        self._span = _Span("step", None)
+        self._span.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        global _LAST_STEP
+        if self._span is not None:
+            self._span.__exit__(*exc)
+            wall = time.perf_counter() - self._t0
+            _TLS.step_frame = self._prev_frame
+            acc = self._frame["acc"]
+            summary = {
+                "step": self.step,
+                "step_s": wall,
+                "data_wait_s": acc.get("data_wait", 0.0),
+                "dispatch_s": acc.get("dispatch", 0.0),
+                "device_sync_s": acc.get("device_sync", 0.0),
+            }
+            with _LOCK:
+                if _ENABLED:
+                    _LAST_STEP = summary
+                    _STATS.steps += 1
+        _profile_step_finished()
+        return False
+
+
+def step_span(step=None):
+    """Context manager for ONE training step. While tracing is enabled
+    it opens a "step" span whose children (data_wait / dispatch /
+    device_sync, emitted by the wired step path) become the per-step
+    decomposition; it also ticks the `profile_steps` window either
+    way. A strict no-op when tracing is off and no profile is armed."""
+    if not _ENABLED and _PROFILE is None:
+        return _NULL
+    return _StepCtx(step)
+
+
+def records() -> List[Dict]:
+    """Snapshot of the span ring (oldest first)."""
+    with _LOCK:
+        return [dict(r) for r in _RING]
+
+
+def clear() -> None:
+    """Drop all recorded spans and the last-step summary (counters
+    survive; use `reset_cache_stats()` for those)."""
+    global _LAST_STEP
+    with _LOCK:
+        _RING.clear()
+        _LAST_STEP = None
+
+
+def last_step_timings() -> Optional[Dict]:
+    """The most recent closed step span's timing decomposition:
+    {step, step_s, data_wait_s, dispatch_s, device_sync_s}. None until
+    a step span closes with tracing enabled."""
+    with _LOCK:
+        return dict(_LAST_STEP) if _LAST_STEP else None
+
+
+# ---------------------------------------------------------------------------
+# Exporters
+# ---------------------------------------------------------------------------
+def export_chrome_trace(path: str) -> str:
+    """Write the span ring as Chrome trace-event JSON (the
+    `chrome://tracing` / Perfetto `traceEvents` format: complete "X"
+    events with µs ts/dur, nested by time containment per pid/tid).
+    Atomic: written to a temp file and renamed into place."""
+    pid = os.getpid()
+    with _LOCK:
+        recs = list(_RING)
+    events = []
+    for r in recs:
+        ev = {"name": r["name"], "ph": "X", "cat": "singa_tpu",
+              "ts": round(r["ts"], 3), "dur": round(r["dur"], 3),
+              "pid": pid, "tid": r["tid"]}
+        args = dict(r.get("args") or {})
+        if r.get("step") is not None:
+            args["step"] = r["step"]
+        if args:
+            ev["args"] = args
+        events.append(ev)
+    events.sort(key=lambda e: e["ts"])
+    payload = {"traceEvents": events, "displayTimeUnit": "ms"}
+    tmp = f"{path}.tmp.{pid}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
+    with _LOCK:
+        _STATS.exports += 1
+    return path
+
+
+def span_summary() -> Dict[str, Dict]:
+    """Aggregate the ring by span name:
+    name -> {count, total_ms, mean_ms, max_ms}."""
+    out: Dict[str, Dict] = {}
+    for r in records():
+        s = out.setdefault(r["name"],
+                           {"count": 0, "total_ms": 0.0, "max_ms": 0.0})
+        d = r["dur"] / 1e3
+        s["count"] += 1
+        s["total_ms"] += d
+        if d > s["max_ms"]:
+            s["max_ms"] = d
+    for s in out.values():
+        s["mean_ms"] = round(s["total_ms"] / s["count"], 4)
+        s["total_ms"] = round(s["total_ms"], 4)
+        s["max_ms"] = round(s["max_ms"], 4)
+    return out
+
+
+def format_summary() -> str:
+    """The per-step summary table: one row per span name with count,
+    total/mean/max ms, and ms per step (total over the step spans in
+    the ring) — the at-a-glance answer to "where does a step go"."""
+    snap = span_summary()
+    n_steps = max(snap.get("step", {}).get("count", 0), 1)
+    lines = [f"trace summary ({n_steps} step span(s) in ring):",
+             f"  {'span':<22} {'count':>7} {'total_ms':>10} "
+             f"{'mean_ms':>9} {'max_ms':>9} {'ms/step':>9}"]
+    for name, s in sorted(snap.items(), key=lambda kv: -kv[1]["total_ms"]):
+        lines.append(
+            f"  {name:<22} {s['count']:>7d} {s['total_ms']:>10.3f} "
+            f"{s['mean_ms']:>9.3f} {s['max_ms']:>9.3f} "
+            f"{s['total_ms'] / n_steps:>9.3f}")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Device profiler hook: jax.profiler over a step window.
+# ---------------------------------------------------------------------------
+def profile_steps(n: int, logdir: Optional[str] = None) -> str:
+    """Arm `jax.profiler.trace` for the NEXT `n` step spans: the trace
+    starts when the next `step_span` opens and stops after n of them
+    close, so bench runs capture real device traces for steps k..k+n
+    (not host-side proxies) without bracketing warmup/compile noise.
+    Returns the log directory (default: the `profile_dir` configured
+    via `device.set_tracing`). One window at a time; re-arming
+    replaces a not-yet-started window."""
+    global _PROFILE
+    n = int(n)
+    if n < 1:
+        raise ValueError(f"profile_steps: n must be >= 1, got {n}")
+    with _LOCK:
+        if _PROFILE is not None and _PROFILE["active"]:
+            raise RuntimeError(
+                "profile_steps: a profiler window is already running")
+        _PROFILE = {"remaining": n,
+                    "logdir": str(logdir or _PROFILE_DIR),
+                    "active": False}
+        return _PROFILE["logdir"]
+
+
+def _profile_step_started() -> None:
+    global _PROFILE
+    with _LOCK:
+        prof = _PROFILE
+        if prof is None or prof["active"]:
+            return
+        prof["active"] = True
+        logdir = prof["logdir"]
+    try:
+        import jax
+
+        os.makedirs(logdir, exist_ok=True)
+        jax.profiler.start_trace(logdir)
+    except Exception as e:
+        import sys
+
+        print(f"singa_tpu: jax profiler start failed ({e!r}); "
+              "profile window dropped", file=sys.stderr)
+        with _LOCK:
+            _PROFILE = None
+
+
+def _profile_step_finished() -> None:
+    global _PROFILE
+    with _LOCK:
+        prof = _PROFILE
+        if prof is None or not prof["active"]:
+            return
+        prof["remaining"] -= 1
+        if prof["remaining"] > 0:
+            return
+        _PROFILE = None
+    try:
+        import jax
+
+        jax.profiler.stop_trace()
+    except Exception as e:
+        import sys
+
+        print(f"singa_tpu: jax profiler stop failed ({e!r})",
+              file=sys.stderr)
+
+
+# ---------------------------------------------------------------------------
+# Structured metrics log (JSONL, one record per train step).
+# ---------------------------------------------------------------------------
+def default_metrics_path(tag: str) -> str:
+    """`$SINGA_TPU_METRICS_DIR/<tag>.jsonl` (default dir: ./metrics),
+    created on demand — the directory `tools/tpu_watch.sh metrics`
+    tails."""
+    d = os.environ.get("SINGA_TPU_METRICS_DIR") or os.path.join(
+        os.getcwd(), "metrics")
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, f"{tag}.jsonl")
+
+
+def _json_default(v):
+    if isinstance(v, (np.floating, np.integer)):
+        return v.item()
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    return str(v)
+
+
+class MetricsLogger:
+    """Append-only JSONL training log: ONE schema-stable record per
+    training step, written as a single flush-per-record append so a
+    SIGKILL mid-run leaves every completed record parseable
+    (`read_metrics` skips the at-most-one partial trailing line).
+
+    Record fields (always present, None when unknown): schema, time,
+    step, loss, examples_per_sec, step_s, data_wait_s, dispatch_s,
+    device_sync_s (from the tracer's last closed step span when
+    tracing is on), cache (per-cache COUNTER DELTAS since the previous
+    record — retraces/step after warmup ≈ 0 is the healthy signal),
+    resilience + accum (absolute counters from `cache_stats()`),
+    metrics (registered eval metrics — `Metric.register(logger)`),
+    extra (caller keyword passthrough).
+
+    `fsync=True` additionally fsyncs every record (survives OS crash,
+    not just process kill) — off by default, it serializes the step
+    loop on disk latency."""
+
+    def __init__(self, path: str, fsync: bool = False):
+        self.path = path
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._f = open(path, "ab")
+        self._fsync = bool(fsync)
+        self._lock = threading.Lock()
+        self._prev_cache: Optional[Dict] = None
+        self._metrics: Dict[str, object] = {}
+        self.records_written = 0
+
+    # -- metric registration (singa_tpu.metric.Metric.register) ----------
+    def register_metric(self, name: str, metric) -> None:
+        """Evaluate `metric` (anything with `.evaluate(outputs,
+        labels) -> float`) into every record whose `log_step` call
+        passes outputs/labels; the value lands under
+        `record["metrics"][name]` — eval metrics in the same stream as
+        the loss."""
+        self._metrics[str(name)] = metric
+
+    # -- record construction ----------------------------------------------
+    def _cache_delta(self, snap: Dict) -> Dict:
+        """Per-cache numeric-counter deltas vs the previous record
+        (resilience/accum are reported absolute elsewhere)."""
+        cur: Dict = {}
+        for name, s in snap.items():
+            if name in ("resilience", "accum"):
+                continue
+            if isinstance(s, dict):
+                cur[name] = {
+                    k: v for k, v in s.items()
+                    if isinstance(v, (int, float))
+                    and not isinstance(v, bool)}
+            elif isinstance(s, (int, float)) and not isinstance(s, bool):
+                cur[name] = s
+        prev = self._prev_cache or {}
+        out: Dict = {}
+        for name, s in cur.items():
+            if isinstance(s, dict):
+                p = prev.get(name, {})
+                if not isinstance(p, dict):
+                    p = {}
+                out[name] = {
+                    k: (round(v - p.get(k, 0), 6)
+                        if isinstance(v, float) else v - p.get(k, 0))
+                    for k, v in s.items()}
+            else:
+                p = prev.get(name, 0)
+                out[name] = s - (p if isinstance(p, (int, float)) else 0)
+        self._prev_cache = cur
+        return out
+
+    def log_step(self, step, loss=None, examples=None, step_s=None,
+                 outputs=None, labels=None, **extra) -> Dict:
+        """Append the record for `step`. `loss` may be a Tensor /
+        device scalar / float; `examples` is the batch's sample count
+        (drives examples_per_sec); `step_s` overrides the tracer's
+        step wall time (pass it when no step span wrapped the step).
+        `outputs`/`labels` feed the registered eval metrics. Returns
+        the record dict."""
+        t = last_step_timings()
+        if t is not None and t.get("step") not in (None, step):
+            t = None  # stale frame from a different step: don't misattribute
+        if step_s is None and t is not None:
+            step_s = t["step_s"]
+        snap = stats_mod.cache_stats()
+        if loss is not None:
+            loss = float(np.asarray(
+                loss.to_numpy() if hasattr(loss, "to_numpy") else loss))
+        if outputs is not None and labels is not None:
+            mvals = {name: float(m.evaluate(outputs, labels))
+                     for name, m in self._metrics.items()}
+        else:
+            mvals = {name: None for name in self._metrics}
+        rec = {
+            "schema": SCHEMA_VERSION,
+            "time": round(time.time(), 3),
+            "step": int(step),
+            "loss": loss,
+            "step_s": None if step_s is None else round(float(step_s), 6),
+            "data_wait_s": round(t["data_wait_s"], 6) if t else None,
+            "dispatch_s": round(t["dispatch_s"], 6) if t else None,
+            "device_sync_s": round(t["device_sync_s"], 6) if t else None,
+            "examples_per_sec": (
+                round(float(examples) / float(step_s), 2)
+                if examples and step_s else None),
+            "cache": self._cache_delta(snap),
+            "resilience": dict(snap.get("resilience", {})),
+            "accum": dict(snap.get("accum", {})),
+            "metrics": mvals,
+            "extra": dict(extra),
+        }
+        self._write(rec)
+        return rec
+
+    def _write(self, rec: Dict) -> None:
+        # one encode + one write + one flush per record: a kill lands
+        # between records (or mid-way through at most the last line)
+        data = (json.dumps(rec, sort_keys=True, default=_json_default)
+                + "\n").encode("utf-8")
+        with self._lock:
+            self._f.write(data)
+            self._f.flush()
+            if self._fsync:
+                os.fsync(self._f.fileno())
+            self.records_written += 1
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def read_metrics(path: str) -> List[Dict]:
+    """Parse a metrics JSONL. Tolerant of the one artifact a killed
+    run can leave — a partial trailing line — and of any interleaved
+    garbage: non-JSON lines are skipped, never raised on."""
+    out: List[Dict] = []
+    try:
+        f = open(path, "r", encoding="utf-8", errors="replace")
+    except OSError:
+        return out
+    with f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict):
+                out.append(rec)
+    return out

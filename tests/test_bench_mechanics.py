@@ -82,6 +82,30 @@ def test_fold_onchip_renders_probe_timeouts(tmp_path, capsys,
     assert "123.4 img/s" in out
 
 
+def test_fold_onchip_renders_stage_seconds(tmp_path, capsys,
+                                           monkeypatch):
+    """ISSUE 5: tools/fold_onchip.py renders the `stage_seconds`
+    breakdown column on throughput rows; pre-observability logs
+    (no field) fold unchanged."""
+    fold = _load_module("fold_onchip_for_test", "tools/fold_onchip.py")
+    logs = tmp_path / "onchip_logs"
+    logs.mkdir()
+    (logs / "resnet_bs128.out").write_text(json.dumps(
+        {"ok": True, "ips": 1234.5, "step_ms": 103.7, "batch": 128,
+         "precision": "bf16",
+         "stage_seconds": {"setup": 3.1, "compile": 41.0,
+                           "steady": 12.5}}) + "\n")
+    (logs / "resnet_old.out").write_text(json.dumps(
+        {"ok": True, "ips": 900.0, "step_ms": 142.2, "batch": 128,
+         "precision": "bf16"}) + "\n")
+    monkeypatch.setattr(fold, "LOGS", str(logs))
+    assert fold.main() == 0
+    out = capsys.readouterr().out
+    assert "t=setup 3.1s/compile 41.0s/steady 12.5s" in out
+    assert "900.0 img/s" in out and "t=setup" not in \
+        [ln for ln in out.splitlines() if "900.0" in ln][0]
+
+
 def test_stage_env_exports_compilation_cache():
     """ISSUE 4 satellite: stage subprocesses (and THEIR children —
     stage_pallas / stage_parity spawn grandchildren that never run
@@ -143,7 +167,10 @@ def test_bert_stage_contract_and_slot_dtype_matrix():
     """The BERT-SONNX fine-tune stage (north-star config #5's chip
     metric): one result-JSON line with the pinned metric name, and the
     `--slot-dtype` matrix column carried in the result so
-    tools/fold_onchip.py folds matrix rows without format drift."""
+    tools/fold_onchip.py folds matrix rows without format drift.
+    ISSUE 5: the result also carries the `stage_seconds` wall-time
+    breakdown and the stage's metrics-JSONL path, and that JSONL
+    parses with one record per measured block."""
     proc, result = _run_stage(
         ["--stage", "bert", "--size", "tiny", "--batch", "2",
          "--seq", "16", "--steps", "2", "--deadline", "150",
@@ -155,6 +182,20 @@ def test_bert_stage_contract_and_slot_dtype_matrix():
     assert result["tokens_per_sec"] > 0
     assert result["step_ms"] > 0
     assert result["slot_dtype"] == "bfloat16"
+    # observability contract (ISSUE 5)
+    assert set(result["stage_seconds"]) == {"setup", "compile",
+                                            "steady"}
+    assert all(v >= 0 for v in result["stage_seconds"].values())
+    assert result["metrics_jsonl"] == os.path.join("metrics",
+                                                   "bench_bert.jsonl")
+    from singa_tpu import trace
+
+    recs = trace.read_metrics(
+        os.path.join(_ROOT, result["metrics_jsonl"]))
+    assert recs, "bert stage wrote no metrics records"
+    last = recs[-1]
+    assert last["examples_per_sec"] > 0 and isinstance(
+        last["loss"], float)
 
 
 def test_byte_diet_matrix_flags_validate_in_argparse():
@@ -217,3 +258,11 @@ def test_eager_overhead_emits_stats_line_and_final_json():
     assert accum["apply_calls_per_step"]["accum1"] == 8.0
     assert accum["split_steps_ms"] > 0 and accum["accum_step_ms"] > 0
     assert "dispatch_amortization_pct" in accum
+    # tracer A/B (ISSUE 5): the deterministic contract — the disabled
+    # tracer records literally nothing, the enabled one spans every
+    # eager step; the percentage is reported but not asserted (noise)
+    tr = last["trace"]
+    assert tr["spans_per_step"]["disabled"] == 0
+    assert tr["spans_per_step"]["enabled"] >= 1
+    assert "trace_overhead_pct" in tr
+    assert tr["off_step_ms"] > 0 and tr["on_step_ms"] > 0

@@ -45,6 +45,22 @@ def test_grad_accum_row_and_readme_section_present():
     assert "set_grad_accum" in readme and "microbatches" in readme
 
 
+def test_observability_row_and_readme_section_present():
+    """ISSUE 5 doc contract: the P15 observability row and the README
+    "Observability" section exist (path rot in either is caught by
+    test_all_cited_paths_exist)."""
+    cov = open(os.path.join(_ROOT, "COVERAGE.md")).read()
+    assert "| P15 |" in cov
+    assert "singa_tpu/trace.py" in cov
+    assert "tests/test_trace.py" in cov
+    readme = open(os.path.join(_ROOT, "README.md")).read()
+    assert "## Observability" in readme
+    assert "set_tracing" in readme
+    assert "MetricsLogger" in readme
+    assert "export_chrome_trace" in readme
+    assert "profile_steps" in readme
+
+
 def test_all_cited_paths_exist():
     text = open(os.path.join(_ROOT, "COVERAGE.md")).read()
     missing = []

@@ -55,6 +55,18 @@ def last_json(path):
     return out, stale
 
 
+def _stage_breakdown(r):
+    """Render the `stage_seconds` wall-time breakdown (ISSUE 5:
+    setup / compile / steady) when a stage reports it; empty string
+    for pre-observability logs so they fold unchanged."""
+    ss = r.get("stage_seconds")
+    if not isinstance(ss, dict):
+        return ""
+    return (f", t=setup {ss.get('setup')}s"
+            f"/compile {ss.get('compile')}s"
+            f"/steady {ss.get('steady')}s")
+
+
 def main():
     if not os.path.isdir(LOGS):
         print("no onchip_logs/ yet — run tools/onchip_runner.sh first")
@@ -111,6 +123,7 @@ def main():
             # EFFECTIVE batch; show the scan geometry alongside
             if r.get("accum", 1) != 1:
                 diet += f", accum=x{r['accum']}(mb{r['microbatch']})"
+            diet += _stage_breakdown(r)
             rows.append((stage,
                          f"{r['ips']:.1f} img/s  ({r['step_ms']:.1f} "
                          f"ms/step, bs{r['batch']}, {r.get('precision')}"
@@ -119,6 +132,7 @@ def main():
         elif "tokens_per_sec" in r:
             diet = ("" if r.get("slot_dtype") in (None, "fp32")
                     else f", slot_dtype={r['slot_dtype']}")
+            diet += _stage_breakdown(r)
             rows.append((stage, f"{r['tokens_per_sec']:.0f} tok/s  "
                                 f"({r.get('config')}{diet})" + mark))
         elif "diffs" in r:

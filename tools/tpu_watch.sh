@@ -1,6 +1,72 @@
 #!/bin/bash
-# Background tunnel watcher: probe the TPU every ~4 min; append status to
-# /tmp/tpu_watch.log and write /tmp/tpu_up when a probe succeeds.
+# TPU-side watchers.
+#
+#   tools/tpu_watch.sh                 background tunnel watcher: probe the
+#                                      TPU every ~4 min; append status to
+#                                      /tmp/tpu_watch.log, touch /tmp/tpu_up
+#                                      while a probe succeeds.
+#   tools/tpu_watch.sh metrics [DIR]   tail the NEWEST metrics JSONL under
+#                                      DIR (default: ./metrics, where bench
+#                                      stages and MetricsLogger write) and
+#                                      print one pretty line per training
+#                                      step — live training telemetry
+#                                      instead of raw stage logs. Partial
+#                                      trailing lines (a run killed
+#                                      mid-write) are skipped, matching
+#                                      singa_tpu.trace.read_metrics.
+
+if [ "$1" = "metrics" ]; then
+  dir=${2:-metrics}
+  f=$(ls -t "$dir"/*.jsonl 2>/dev/null | head -1)
+  if [ -z "$f" ]; then
+    echo "tpu_watch: no metrics JSONL under $dir/ yet" >&2
+    exit 1
+  fi
+  echo "tpu_watch: tailing $f" >&2
+  tail -n +1 -F "$f" | python3 -u -c '
+import json, sys
+
+def fmt(v, nd=3):
+    if v is None:
+        return "-"
+    return str(round(v, nd))
+
+for line in sys.stdin:
+    line = line.strip()
+    if not line:
+        continue
+    try:
+        r = json.loads(line)
+    except ValueError:
+        continue  # partial trailing line from a killed writer
+    if not isinstance(r, dict):
+        continue  # valid JSON but not a record: skip, like read_metrics
+    cache = r.get("cache") or {}
+    retr = sum(c.get("retraces", 0) for c in cache.values()
+               if isinstance(c, dict))
+    res = r.get("resilience") or {}
+    bits = [
+        "step " + str(r.get("step", "?")).rjust(6),
+        "loss " + fmt(r.get("loss"), 4),
+        "ex/s " + fmt(r.get("examples_per_sec"), 1),
+        "step_s " + fmt(r.get("step_s"), 4),
+        "wait " + fmt(r.get("data_wait_s"), 4),
+        "disp " + fmt(r.get("dispatch_s"), 4),
+        "sync " + fmt(r.get("device_sync_s"), 4),
+        "retraces " + str(retr),
+    ]
+    if res.get("steps_skipped"):
+        bits.append("skipped " + str(res["steps_skipped"]))
+    mets = {k: v for k, v in (r.get("metrics") or {}).items()
+            if v is not None}
+    for k, v in sorted(mets.items()):
+        bits.append(k + " " + fmt(v, 4))
+    print("  ".join(bits))
+'
+  # never fall through into the tunnel-watcher loop below
+  exit $?
+fi
+
 while true; do
   if timeout 120 python -c "import jax; d=jax.devices(); assert d[0].platform!='cpu'; import jax.numpy as jnp; (jnp.ones((8,8))@jnp.ones((8,8))).block_until_ready(); print(d[0].device_kind)" >/tmp/tpu_probe_out 2>/dev/null; then
     echo "$(date +%H:%M:%S) UP $(cat /tmp/tpu_probe_out)" >> /tmp/tpu_watch.log
