@@ -24,6 +24,7 @@ from . import autograd  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import data  # noqa: F401
 from . import device  # noqa: F401
+from . import export_cache  # noqa: F401
 from . import initializer  # noqa: F401
 from . import io  # noqa: F401
 from . import layer  # noqa: F401

@@ -68,6 +68,10 @@ __all__ = [
     # Observability (ISSUE 5): span tracer + device-profiler window
     # (singa_tpu.trace owns the state).
     "set_tracing",
+    # AOT export cache + shape bucketing (ISSUE 6; singa_tpu.
+    # export_cache owns the state).
+    "set_export_cache",
+    "set_shape_buckets",
     # Migration aliases (reference names):
     "create_cuda_gpu",
     "create_cuda_gpu_on",
@@ -527,6 +531,50 @@ def set_tracing(flag: bool = True, ring_capacity: Optional[int] = None,
 
     trace.configure(enabled=flag, ring_capacity=ring_capacity,
                     profile_dir=profile_dir)
+
+
+def set_export_cache(directory) -> None:
+    """Arm the persistent AOT executable store (`singa_tpu.
+    export_cache`): graph-mode train steps, sharded mesh steps, and
+    forward executables are serialized with `jax.export` into
+    `directory`, keyed by (model topology fingerprint, abstract shape
+    signature, dtype, device kind, and a snapshot of every
+    step-affecting knob), and a process that finds a matching artifact
+    DESERIALIZES it instead of re-tracing — millisecond warm starts
+    where tracing took seconds. A knob/topology change changes the
+    key, so a stale artifact can never load; a corrupt artifact falls
+    back to tracing loudly (`tools/export_cache_gc.py` lists/validates/
+    collects the store). NOTE: export-cached steps run without buffer
+    donation (see `_JitStep._build`). `None` disables. Counters:
+    `cache_stats()["export"]`."""
+    from . import export_cache
+
+    export_cache.configure(directory=directory)
+
+
+def set_shape_buckets(max_batch=None, seq_dim=None, max_seq=None) -> None:
+    """Arm the powers-of-two shape-bucketing policy: forward/serving
+    dispatches pad their batch dim (and `seq_dim`, when given — right
+    padding, causal-attention-safe only) up to the next pow2 bucket
+    and slice padded rows back off the outputs, so diverse traffic
+    retraces at most once per bucket instead of once per novel shape
+    — and fills at most that many export-cache artifacts. A shape
+    above `max_batch`/`max_seq` raises `export_cache.
+    BucketOverflowError` (loud, never a silent retrace). Ceilings
+    must be powers of two. `set_shape_buckets()` with no args
+    disables. Works with or without `set_export_cache`."""
+    from . import export_cache
+
+    if max_batch is None and max_seq is None and seq_dim is None:
+        export_cache.configure(buckets=None)
+    else:
+        # seq_dim without max_seq falls through to BucketPolicy's own
+        # "seq_dim set but max_seq missing" ValueError — silently
+        # disabling a policy the caller thought they armed would leave
+        # retraces unbounded with no signal.
+        export_cache.configure(buckets=export_cache.BucketPolicy(
+            max_batch=max_batch if max_batch is not None else 4096,
+            seq_dim=seq_dim, max_seq=max_seq))
 
 
 def set_dag_auto_flops_per_op(v: float) -> None:

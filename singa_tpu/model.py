@@ -400,6 +400,68 @@ class Model(Layer):
         return jax.tree_util.tree_map(
             lambda a: tensor_mod.from_raw(a, dev), merged)
 
+    def _class_source_digest(self, h) -> None:
+        """Fold this model's class identity + source into hasher `h` —
+        the shared prelude of every topology fingerprint (a forward()
+        edit must orphan cached AOT artifacts)."""
+        import inspect
+
+        h.update(type(self).__qualname__.encode())
+        try:
+            h.update(inspect.getsource(type(self)).encode())
+        except (OSError, TypeError):
+            pass  # source unavailable (REPL/frozen): inventory only
+
+    # Layer machinery + per-run mutables that must NOT key an AOT
+    # artifact: tensors/sublayers are inventoried separately, and
+    # train/eval flags ride the export key's own extras.
+    _FP_SKIP_ATTRS = frozenset({
+        "_params", "_sublayers", "_state_attrs", "_initialized",
+        "training", "_use_graph", "_jit_step", "_jit_fwd",
+        "_optimizer", "_mesh", "_rules", "_batch_specs",
+    })
+
+    def topology_fingerprint(self) -> str:
+        """Stable identity of this model's traced program structure:
+        class + source + the full param/state inventory (names,
+        shapes, dtypes) + every layer's scalar CONFIG attributes —
+        two instances with identical weights but e.g. `causal=True`
+        vs `False`, or a stride that leaves kernel shapes unchanged,
+        trace different programs and must never share an artifact.
+        Keys the `export_cache` artifact store; models whose program
+        is data-driven rather than source-driven override this
+        (`sonnx.SONNXModel` hashes the imported ONNX graph)."""
+        import hashlib
+        import json
+
+        h = hashlib.sha256()
+        self._class_source_digest(h)
+        for name, t in sorted(self.get_params().items()) + sorted(
+                self.get_states().items()):
+            h.update(f"{name}:{tuple(t.shape)}:{t.dtype}".encode())
+
+        def config_of(layer):
+            out = {}
+            for k, v in layer.__dict__.items():
+                if k in Model._FP_SKIP_ATTRS:
+                    continue
+                if isinstance(v, (bool, int, float, str, type(None))):
+                    out[k] = v
+                elif isinstance(v, (tuple, list)) and all(
+                        isinstance(x, (bool, int, float, str,
+                                       type(None))) for x in v):
+                    out[k] = list(v)
+            return out
+
+        stack = [("", self)]
+        while stack:
+            path, l = stack.pop()
+            h.update(json.dumps([path, config_of(l)],
+                                sort_keys=True).encode())
+            for k in sorted(l.sublayers):
+                stack.append((f"{path}/{k}", l.sublayers[k]))
+        return h.hexdigest()
+
     def cache_stats(self):
         """Snapshot of every executable-cache's counters
         (`singa_tpu.stats.cache_stats()`): the DAG backward cache, the
@@ -762,27 +824,86 @@ class _JitForward:
         )
         return pvals, svals, key, batch_arrays
 
+    def _obtain(self, cache_key, tensor_pos, statics, nargs, args):
+        """Forward executable via the AOT store when armed: load the
+        serialized artifact (no tracing) or trace once + publish —
+        the serving-tier warm start, ONNX-imported models included."""
+        from . import export_cache
+
+        if not export_cache.active() or cache_key is None:
+            fn = self._build(tensor_pos, statics, nargs)
+            export_cache.count_trace(0.0)
+            return fn
+        key, parts = export_cache.step_key(
+            self.model, None, "forward", args,
+            extras={"training": self.model.training,
+                    "tensor_pos": list(tensor_pos),
+                    # address-free: repr() of a plain object embeds
+                    # its 0x... address and would make keys
+                    # process-unique (never a warm hit)
+                    "statics": [export_cache._scalarize(s)
+                                for s in statics]})
+        exp = export_cache.load(key)
+        if exp is None:
+            built = self._build(tensor_pos, statics, nargs)
+            exp = export_cache.export_and_save(key, parts, built, args)
+            if exp is None:
+                return built
+        return jax.jit(exp.call)
+
     def __call__(self, *xs):
+        from . import export_cache
+
         tensor_pos = tuple(i for i, x in enumerate(xs)
                            if isinstance(x, Tensor))
         statics = tuple(x for x in xs if not isinstance(x, Tensor))
         batch_arrays = tuple(xs[i].data for i in tensor_pos)
+        # Pad-to-bucket at dispatch (ISSUE 6): under the pow2 policy a
+        # stream of diverse batch/sequence sizes collapses onto at
+        # most n_buckets() traced shapes; the padded rows/positions
+        # (repeated final sample) are sliced back off the outputs
+        # below (export_cache.slice_bucket_out — shape-inferred, the
+        # _merge_accum_out caveat applies).
+        # Training-mode forwards are NEVER padded: the program writes
+        # BN running stats back from new_s, and stats over a padded
+        # batch (final sample repeated) are reweighted state
+        # corruption — the same contract as train_one_batch
+        # ("training batches are not padded implicitly").
+        bucket_info = None
+        if (export_cache.bucket_policy() is not None and batch_arrays
+                and not self.model.training):
+            batch_arrays, bucket_info = \
+                export_cache.pad_batch_to_bucket(batch_arrays)
+            batch_arrays = tuple(batch_arrays)
+            if (bucket_info["n_bucket"] == bucket_info["n_real"]
+                    and bucket_info["seq_bucket"] ==
+                    bucket_info["seq_real"]):
+                bucket_info = None  # on bucket edges: nothing to slice
         try:
             cache_key = (self.model.training, tensor_pos, statics)
+            if export_cache.active():
+                # serialized artifacts are shape-specialized: key the
+                # executable cache per abstract batch signature
+                cache_key += (tuple(
+                    (tuple(int(d) for d in b.shape), str(b.dtype))
+                    for b in batch_arrays),)
             fn = self._compiled.get(cache_key)
         except TypeError:  # unhashable static arg: compile fresh
             cache_key, fn = None, None
-        if fn is None:
-            fn = self._build(tensor_pos, statics, len(xs))
-            if cache_key is not None:
-                self._compiled[cache_key] = fn
         dev = self._device()
         pvals, svals, key, batch_arrays = self._place_inputs(
             [p.data for p in self.params],
             [s.data for s in self.states],
             dev._rng_key, batch_arrays,
         )
+        if fn is None:
+            fn = self._obtain(cache_key, tensor_pos, statics, len(xs),
+                              (pvals, svals, key, batch_arrays))
+            if cache_key is not None:
+                self._compiled[cache_key] = fn
         out, new_s, new_key = fn(pvals, svals, key, batch_arrays)
+        if bucket_info is not None:
+            out = export_cache.slice_bucket_out(out, bucket_info)
         if self.model.training:
             for s, v in zip(self.states, new_s):
                 s.data = v
@@ -817,6 +938,14 @@ class _JitStep:
         self.opt = model._optimizer
         self._compiled = None
         self._hlo_rows = None  # graph-profile cache (hlo_profile.py)
+        # Export-cache state (ISSUE 6): one executable per abstract
+        # batch signature when the AOT store is armed (a serialized
+        # artifact is shape-specialized, unlike a polymorphic jit),
+        # plus the seen-signature set behind the retrace-storm warning.
+        self._by_sig: Dict = {}
+        self._batch_sig = None
+        self._seen_sigs = set()
+        self._from_export = False
         # Gradient-accumulation factor baked into the built executable
         # (1 = off); read from the model/process knob at _build time —
         # toggling requires re-compile(), like donation/step-guard.
@@ -855,7 +984,7 @@ class _JitStep:
 
         return get_default_device()
 
-    def _build(self, *batch_arrays):
+    def _build(self, *batch_arrays, donate=None):
         model, opt = self.model, self.opt
         params, states = self.params, self.states
 
@@ -909,9 +1038,16 @@ class _JitStep:
 
             step_fn = accum_fn
         # Donation honors the eager-config knob at build time
-        # (device.set_buffer_donation); re-compile() to re-arm.
-        donate = (0, 1, 2, 3) if stats_mod.donation_enabled() else ()
-        return jax.jit(step_fn, donate_argnums=donate,
+        # (device.set_buffer_donation); re-compile() to re-arm. The
+        # export-cache path forces donation OFF (`donate=False`): a
+        # deserialized artifact executes through `Exported.call`,
+        # whose caller never donates, and an aliased-input module
+        # without donated buffers would silently invalidate arrays the
+        # Python side still holds.
+        if donate is None:
+            donate = stats_mod.donation_enabled()
+        donate_argnums = (0, 1, 2, 3) if donate else ()
+        return jax.jit(step_fn, donate_argnums=donate_argnums,
                        **self._jit_kwargs(batch_arrays))
 
     def _jit_kwargs(self, batch_arrays):
@@ -1154,14 +1290,116 @@ class _JitStep:
             pvals, svals, ovals, key, step, batch_arrays
         ).compile().as_text()
 
+    # ---- AOT export cache (ISSUE 6) --------------------------------------
+    def _export_kind(self) -> str:
+        return "step"
+
+    def _export_extras(self):
+        """Hook: per-subclass key identity (the sharded step adds its
+        mesh layout). None on one device."""
+        return None
+
+    def _note_batch_sig(self, batch_arrays):
+        """Track the abstract batch signature across calls. Returns
+        the PRIOR signature when this one is new-after-warmup (the
+        retrace-storm precondition) else None — the caller fires
+        `export_cache.note_step_retrace` only where a trace is
+        actually imminent (plain-jit new shape, or an export-store
+        MISS): a warm artifact LOAD of a new shape is not a retrace
+        and must not alarm the provisioning counter."""
+        sig = tuple(
+            (tuple(int(d) for d in getattr(b, "shape", ())),
+             str(getattr(b, "dtype", type(b).__name__)))
+            for b in batch_arrays)
+        prior = None
+        if (self._batch_sig is not None and sig != self._batch_sig
+                and sig not in self._seen_sigs):
+            prior = self._batch_sig
+        self._seen_sigs.add(sig)
+        self._batch_sig = sig
+        return prior
+
+    def _note_warm_geometry(self, batch_arrays):
+        """A warm-loaded artifact skips _build, so re-derive the
+        bookkeeping _build would have done: the accumulation factor
+        baked into the artifact (the key guarantees it matches the
+        live knob) and its microbatch geometry counters."""
+        n = self.model._accum_n() if self.opt is not None else 1
+        self._accum_built = n
+        if (n > 1 and batch_arrays
+                and getattr(batch_arrays[0], "ndim", 0) >= 1
+                and batch_arrays[0].shape[0] % n == 0):
+            b = int(batch_arrays[0].shape[0])
+            stats_mod.note_accum_build(n, b // n, b)
+
+    def _obtain_export(self, args, batch_arrays, prior_sig=None):
+        """Export-cache path: one executable per batch signature —
+        load the serialized artifact when one exists (millisecond warm
+        start, zero tracing), else trace once, serialize, and publish
+        so every later process warm-starts. Falls back to the plain
+        jit loudly when the program cannot be exported. `prior_sig`
+        (a new-after-warmup signature's predecessor) arms the
+        retrace-storm warning — fired only on a store MISS, where a
+        trace is actually paid."""
+        import jax as _jax
+
+        from . import export_cache
+
+        fn = self._by_sig.get(self._batch_sig)
+        if fn is not None:
+            return fn
+        # The knob snapshot records the PROCESS grad_accum knob; the
+        # effective factor can differ per model (compile(grad_accum=n)
+        # overrides it) and bakes a different program — it must key.
+        extras = {"accum": (self.model._accum_n()
+                            if self.opt is not None else 1),
+                  "subclass": self._export_extras()}
+        key, parts = export_cache.step_key(
+            self.model, self.opt, self._export_kind(), args,
+            extras=extras)
+        exp = export_cache.load(key)
+        if exp is not None:
+            self._note_warm_geometry(batch_arrays)
+            fn = _jax.jit(exp.call)
+        else:
+            if prior_sig is not None:
+                export_cache.note_step_retrace(prior_sig,
+                                               self._batch_sig)
+            built = self._build(*batch_arrays, donate=False)
+            exp = export_cache.export_and_save(key, parts, built, args)
+            fn = _jax.jit(exp.call) if exp is not None else built
+        self._by_sig[self._batch_sig] = fn
+        return fn
+
     def __call__(self, *batch: Tensor):
+        from . import export_cache
+
         batch_arrays = tuple(
             b.data if isinstance(b, Tensor) else b for b in batch
         )
-        if self._compiled is None:
-            self._compiled = self._build(*batch_arrays)
+        prior_sig = self._note_batch_sig(batch_arrays)
         dev = self._device()
         opt = self.opt
+        exporting = export_cache.active()
+        if not exporting:
+            if self._from_export:
+                # the store was disarmed mid-run: the held executable
+                # is shape-SPECIALIZED (Exported.call rejects new
+                # shapes where a polymorphic jit would retrace) —
+                # rebuild plain
+                self._compiled = None
+                self._from_export = False
+            if prior_sig is not None and self._compiled is not None:
+                # the polymorphic jit is about to retrace internally
+                export_cache.note_step_retrace(prior_sig,
+                                               self._batch_sig)
+            if self._compiled is None:
+                self._compiled = self._build(*batch_arrays)
+                export_cache.count_trace(0.0)
+        if exporting and self._batch_sig not in self._by_sig:
+            # signature must be stable before arrays are collected:
+            # slots are pre-created here exactly as _build would
+            self._ensure_opt_slots()
         pvals = [p.data for p in self.params]
         svals = [s.data for s in self.states]
         ovals = self._opt_arrays()
@@ -1169,6 +1407,11 @@ class _JitStep:
         pvals, svals, ovals, key, batch_arrays = self._prepare_inputs(
             pvals, svals, ovals, dev._rng_key, batch_arrays
         )
+        if exporting:
+            self._compiled = self._obtain_export(
+                (pvals, svals, ovals, key, step, batch_arrays),
+                batch_arrays, prior_sig=prior_sig)
+            self._from_export = True
         profiling = dev._verbosity > 0
         if profiling and getattr(self, "_hlo_rows", None) is None:
             # One extra lower+compile (shapes only — safe before the

@@ -388,6 +388,28 @@ class ShardedJitStep(_JitStep):
             **_CHECK_KW)
         return fn(pvals, svals, ovals, key, step_counter, *batch)
 
+    # -- AOT export cache (ISSUE 6) ----------------------------------------
+    def _export_kind(self) -> str:
+        return "sharded_step"
+
+    def _export_extras(self):
+        """Mesh identity for the artifact key: an exported SPMD
+        program is specialized to its mesh layout, so axis names/
+        sizes, the sharding rules, batch-spec overrides, and the
+        controller topology all invalidate on change."""
+        from .. import export_cache
+
+        return {
+            "mesh_axes": {str(k): int(v)
+                          for k, v in self.mesh.shape.items()},
+            "batch_axis": self.batch_axis,
+            "seq": [self.seq_axis, self.seq_dim],
+            "batch_specs": (None if self.batch_specs is None
+                            else [repr(s) for s in self.batch_specs]),
+            "rules": export_cache._scalarize(self.rules),
+            "multiproc": bool(self._multiproc),
+        }
+
     # -- jit wiring --------------------------------------------------------
     def _jit_kwargs(self, batch_arrays):
         rep = replicated(self.mesh)

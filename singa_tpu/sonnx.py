@@ -1221,6 +1221,15 @@ class SONNXModel(model_mod.Model):
         super().__init__()
         if isinstance(onnx_model, (str, bytes)):
             onnx_model = load(onnx_model)
+        # Exact graph digest for the AOT export cache: an imported
+        # model's program is the ONNX graph, not Python source, so the
+        # base topology_fingerprint (class source + param inventory)
+        # could collide across two graphs with identical weights
+        # inventory but different wiring.
+        import hashlib
+
+        self._onnx_digest = hashlib.sha256(
+            onnx_model.SerializeToString()).hexdigest()
         self.rep = SingaRep(onnx_model, device)
         # BN running stats are state, not trainable params (the native
         # BatchNorm2d layer registers them the same way).
@@ -1253,3 +1262,17 @@ class SONNXModel(model_mod.Model):
         loss = autograd.softmax_cross_entropy(out0, y)
         self._optimizer.backward_and_update(loss)
         return out, loss
+
+    def topology_fingerprint(self) -> str:
+        """AOT export-cache identity (ISSUE 6): everything the base
+        fingerprint hashes (subclass source, param/state inventory,
+        scalar config attrs — a fine-tune subclass's baked constants
+        must key) PLUS the EXACT serialized ONNX graph, which the
+        inventory alone cannot distinguish (two graphs can wire the
+        same weights differently)."""
+        import hashlib
+
+        h = hashlib.sha256()
+        h.update(super().topology_fingerprint().encode())
+        h.update(self._onnx_digest.encode())
+        return h.hexdigest()

@@ -106,6 +106,31 @@ def test_fold_onchip_renders_stage_seconds(tmp_path, capsys,
         [ln for ln in out.splitlines() if "900.0" in ln][0]
 
 
+def test_fold_onchip_renders_compile_split_and_warm_column(
+        tmp_path, capsys, monkeypatch):
+    """ISSUE 6: when a stage reports the trace/compile/load split and
+    the artifact-cache counters, tools/fold_onchip.py renders them
+    (plus the `warm=` hit-rate column); pre-split logs fold with the
+    ISSUE 5 three-field rendering unchanged (pinned by
+    test_fold_onchip_renders_stage_seconds)."""
+    fold = _load_module("fold_onchip_for_test", "tools/fold_onchip.py")
+    logs = tmp_path / "onchip_logs"
+    logs.mkdir()
+    (logs / "resnet_warm.out").write_text(json.dumps(
+        {"ok": True, "ips": 2000.0, "step_ms": 64.0, "batch": 128,
+         "precision": "bf16",
+         "stage_seconds": {"setup": 3.0, "trace": 1.2, "compile": 8.4,
+                           "load": 0.05, "steady": 12.5},
+         "export_cache": {"hits": 2, "misses": 0,
+                          "hit_rate": 1.0}}) + "\n")
+    monkeypatch.setattr(fold, "LOGS", str(logs))
+    assert fold.main() == 0
+    out = capsys.readouterr().out
+    assert ("t=setup 3.0s/trace 1.2s/compile 8.4s/load 0.05s"
+            "/steady 12.5s") in out
+    assert "warm=100%" in out
+
+
 def test_stage_env_exports_compilation_cache():
     """ISSUE 4 satellite: stage subprocesses (and THEIR children —
     stage_pallas / stage_parity spawn grandchildren that never run
@@ -114,6 +139,7 @@ def test_stage_env_exports_compilation_cache():
     re-pay the ~73 s ResNet compile that burned the r05 window."""
     bench = _load_module("bench_for_test", "bench.py")
     saved = os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
+    saved_ec = os.environ.pop("SINGA_TPU_EXPORT_CACHE", None)
     try:
         env = bench._stage_env()
         assert env["JAX_COMPILATION_CACHE_DIR"].endswith(".jax_cache")
@@ -125,11 +151,22 @@ def test_stage_env_exports_compilation_cache():
         os.environ["JAX_COMPILATION_CACHE_DIR"] = "/tmp/elsewhere"
         assert bench._stage_env()[
             "JAX_COMPILATION_CACHE_DIR"] == "/tmp/elsewhere"
+        # ISSUE 6: the AOT artifact store travels the same way (kill
+        # the trace half of a repeat attempt, not just the compile
+        # half); checked INSIDE the popped-env window so an ambient
+        # SINGA_TPU_EXPORT_CACHE (incl. the documented "" disable)
+        # cannot fail the test
+        assert bench._stage_env()["SINGA_TPU_EXPORT_CACHE"].endswith(
+            ".export_cache")
     finally:
         if saved is None:
             os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
         else:
             os.environ["JAX_COMPILATION_CACHE_DIR"] = saved
+        if saved_ec is None:
+            os.environ.pop("SINGA_TPU_EXPORT_CACHE", None)
+        else:
+            os.environ["SINGA_TPU_EXPORT_CACHE"] = saved_ec
     # and run_stage_status actually passes the env to the child
     src = open(os.path.join(_ROOT, "bench.py")).read()
     assert "env=_stage_env()" in src
@@ -182,10 +219,15 @@ def test_bert_stage_contract_and_slot_dtype_matrix():
     assert result["tokens_per_sec"] > 0
     assert result["step_ms"] > 0
     assert result["slot_dtype"] == "bfloat16"
-    # observability contract (ISSUE 5)
-    assert set(result["stage_seconds"]) == {"setup", "compile",
+    # observability contract (ISSUE 5; ISSUE 6 splits `compile` into
+    # trace/compile/load and adds the artifact-cache hit rate)
+    assert set(result["stage_seconds"]) == {"setup", "trace",
+                                            "compile", "load",
                                             "steady"}
     assert all(v >= 0 for v in result["stage_seconds"].values())
+    ec = result["export_cache"]
+    assert set(ec) == {"hits", "misses", "hit_rate"}
+    assert 0.0 <= ec["hit_rate"] <= 1.0
     assert result["metrics_jsonl"] == os.path.join("metrics",
                                                    "bench_bert.jsonl")
     from singa_tpu import trace
@@ -266,3 +308,21 @@ def test_eager_overhead_emits_stats_line_and_final_json():
     assert tr["spans_per_step"]["enabled"] >= 1
     assert "trace_overhead_pct" in tr
     assert tr["off_step_ms"] > 0 and tr["on_step_ms"] > 0
+    # AOT cold-vs-warm A/B (ISSUE 6 acceptance): the process-fresh
+    # warm start loads the serialized step WITHOUT tracing (hit
+    # counter = 1, zero traces/retraces), bit-identical loss, and
+    # time-to-first-step drops >= 3x vs the export-cache-off cold
+    # run. All three fleet regimes are reported: full-cold (trace +
+    # compile), trace-only (XLA cache warm — the pre-PR-6 steady
+    # state), and warm; the trace-only ratio must still favor warm.
+    ws = last["warm_start"]
+    assert ws["export_hits"] == 1
+    assert ws["export_traces"] == 0
+    assert ws["dag_retraces"] == 0
+    assert ws["loss_match"] is True
+    assert ws["cold_first_step_s"] > 0 and ws["warm_first_step_s"] > 0
+    assert ws["trace_only_first_step_s"] > 0
+    assert ws["warm_start_speedup"] >= 3.0, (
+        f"warm start only {ws['warm_start_speedup']}x vs cold")
+    assert ws["speedup_vs_trace_only"] > 1.0, (
+        "warm start must beat the trace-only (compile-cached) regime")

@@ -61,6 +61,23 @@ def test_observability_row_and_readme_section_present():
     assert "profile_steps" in readme
 
 
+def test_export_cache_row_and_readme_section_present():
+    """ISSUE 6 doc contract: the P16 AOT warm-start row and the README
+    "AOT warm start" section exist (path rot in either is caught by
+    test_all_cited_paths_exist)."""
+    cov = open(os.path.join(_ROOT, "COVERAGE.md")).read()
+    assert "| P16 |" in cov
+    assert "singa_tpu/export_cache.py" in cov
+    assert "tests/test_export_cache.py" in cov
+    assert "tools/export_cache_gc.py" in cov
+    readme = open(os.path.join(_ROOT, "README.md")).read()
+    assert "## AOT warm start" in readme
+    assert "set_export_cache" in readme
+    assert "set_shape_buckets" in readme
+    assert "warm_start_speedup" in readme
+    assert "export_cache_gc" in readme
+
+
 def test_all_cited_paths_exist():
     text = open(os.path.join(_ROOT, "COVERAGE.md")).read()
     missing = []

@@ -1,0 +1,181 @@
+"""Inventory / validate / garbage-collect the AOT export-cache store.
+
+The store (`device.set_export_cache(dir)`, `singa_tpu/export_cache.py`)
+accumulates one `.jexp` artifact + `.jexp.json` digest manifest per
+(model, shape bucket, knob snapshot, device kind) — a fleet's store
+grows with every new configuration and never shrinks on its own. This
+tool is the janitor:
+
+    python tools/export_cache_gc.py --dir .export_cache list
+    python tools/export_cache_gc.py --dir .export_cache validate
+    python tools/export_cache_gc.py --dir .export_cache gc \
+        [--older-than-days N] [--dry-run]
+
+`list` prints one row per artifact (size, age, kind, model, device,
+validity). `validate` digest-checks every artifact (the
+`CheckpointManager` manifest discipline) and exits 1 if any is corrupt
+— a CI-able store health check. `gc` deletes invalid artifacts (their
+runtime fate is only a loud fall-back-to-tracing, but they waste disk
+and hide real hit rates), orphaned manifests, and — with
+`--older-than-days` — artifacts past the age cutoff.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.abspath(os.path.join(HERE, "..")))
+
+
+def _rows(directory, deep=True):
+    from singa_tpu import export_cache
+
+    return export_cache.list_artifacts(directory, deep=deep)
+
+
+def _fmt_age(created):
+    if not created:
+        return "?"
+    days = (time.time() - created) / 86400.0
+    return f"{days:.1f}d"
+
+
+def cmd_list(directory):
+    # stat-only validation: list must stay cheap on a fleet-sized
+    # store (full digests are `validate`'s job)
+    rows = _rows(directory, deep=False)
+    if not rows:
+        print(f"no artifacts under {directory!r}")
+        return 0
+    total = 0
+    for r in rows:
+        meta = r["meta"]
+        total += r["size"]
+        status = ("OK" if r["invalid"] is None
+                  else f"INVALID: {r['invalid']}")
+        print(f"  {r['name']:<40} {r['size']:>9}B  "
+              f"age={_fmt_age(r['created']):<7} "
+              f"kind={meta.get('kind', '?'):<13} "
+              f"model={meta.get('model_class', '?'):<16} "
+              f"dev={meta.get('device_kind', '?')}  {status}")
+    print(f"  {len(rows)} artifact(s), {total} bytes")
+    return 0
+
+
+def cmd_validate(directory):
+    rows = _rows(directory)
+    bad = [r for r in rows if r["invalid"] is not None]
+    for r in bad:
+        print(f"  INVALID {r['path']}: {r['invalid']}")
+    print(f"  {len(rows) - len(bad)}/{len(rows)} artifacts valid")
+    return 1 if bad else 0
+
+
+def _orphan_manifests(directory):
+    """Manifests whose artifact is gone (a partial GC or external rm)."""
+    from singa_tpu.export_cache import ARTIFACT_SUFFIX, MANIFEST_SUFFIX
+
+    out = []
+    for name in sorted(os.listdir(directory)):
+        if not name.endswith(MANIFEST_SUFFIX):
+            continue
+        art = name[:-len(MANIFEST_SUFFIX)] + ARTIFACT_SUFFIX
+        if not os.path.exists(os.path.join(directory, art)):
+            out.append(os.path.join(directory, name))
+    return out
+
+
+STALE_TMP_SECONDS = 3600
+
+
+def _stale_tmp_files(directory):
+    """Orphaned `*.tmp.<pid>` files from writers killed between the
+    tmp write and the atomic publish. Only files older than an hour —
+    a younger tmp may belong to a live writer mid-save."""
+    out = []
+    now = time.time()
+    for name in sorted(os.listdir(directory)):
+        if ".tmp." not in name:
+            continue
+        path = os.path.join(directory, name)
+        try:
+            if now - os.path.getmtime(path) > STALE_TMP_SECONDS:
+                out.append(path)
+        except OSError:
+            pass
+    return out
+
+
+def cmd_gc(directory, older_than_days=None, dry_run=False):
+    rows = _rows(directory)
+    victims = []
+    for r in rows:
+        if r["invalid"] is not None:
+            victims.append((r, f"invalid ({r['invalid']})"))
+        elif (older_than_days is not None and r["created"]
+              and time.time() - r["created"] > older_than_days * 86400):
+            victims.append((r, f"older than {older_than_days}d"))
+    freed = 0
+    for r, why in victims:
+        freed += r["size"]
+        print(f"  {'would remove' if dry_run else 'removing'} "
+              f"{r['name']}: {why}")
+        if not dry_run:
+            for path in (r["path"], r["path"] + ".json"):
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+    for man in _orphan_manifests(directory):
+        print(f"  {'would remove' if dry_run else 'removing'} "
+              f"{os.path.basename(man)}: orphan manifest")
+        if not dry_run:
+            try:
+                os.remove(man)
+            except OSError:
+                pass
+    for tmp in _stale_tmp_files(directory):
+        print(f"  {'would remove' if dry_run else 'removing'} "
+              f"{os.path.basename(tmp)}: stale tmp (writer died "
+              "mid-save)")
+        if not dry_run:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+    kept = len(rows) - len(victims)
+    print(f"  {'would free' if dry_run else 'freed'} {freed} bytes "
+          f"({len(victims)} artifact(s)); {kept} kept")
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default=os.path.join(HERE, "..",
+                                                  ".export_cache"),
+                    help="artifact store directory")
+    ap.add_argument("command", nargs="?", default="list",
+                    choices=["list", "validate", "gc"])
+    ap.add_argument("--older-than-days", type=float, default=None,
+                    help="gc: also remove valid artifacts older than "
+                    "this many days")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="gc: report victims without deleting")
+    a = ap.parse_args(argv)
+    directory = os.path.abspath(a.dir)
+    if not os.path.isdir(directory):
+        print(f"no store at {directory!r} — arm it with "
+              "device.set_export_cache(dir)")
+        return 0
+    if a.command == "list":
+        return cmd_list(directory)
+    if a.command == "validate":
+        return cmd_validate(directory)
+    return cmd_gc(directory, older_than_days=a.older_than_days,
+                  dry_run=a.dry_run)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -57,14 +57,25 @@ def last_json(path):
 
 def _stage_breakdown(r):
     """Render the `stage_seconds` wall-time breakdown (ISSUE 5:
-    setup / compile / steady) when a stage reports it; empty string
-    for pre-observability logs so they fold unchanged."""
+    setup / compile / steady; ISSUE 6 splits compile into
+    trace/compile/load and adds the artifact-cache `warm=` hit-rate
+    column) when a stage reports it; empty string for
+    pre-observability logs so they fold unchanged."""
     ss = r.get("stage_seconds")
     if not isinstance(ss, dict):
         return ""
-    return (f", t=setup {ss.get('setup')}s"
-            f"/compile {ss.get('compile')}s"
-            f"/steady {ss.get('steady')}s")
+    out = f", t=setup {ss.get('setup')}s"
+    split = "trace" in ss or "load" in ss
+    if split:
+        out += f"/trace {ss.get('trace')}s"
+    out += f"/compile {ss.get('compile')}s"
+    if split:
+        out += f"/load {ss.get('load')}s"
+    out += f"/steady {ss.get('steady')}s"
+    ec = r.get("export_cache")
+    if isinstance(ec, dict) and "hit_rate" in ec:
+        out += f", warm={int(round(ec['hit_rate'] * 100))}%"
+    return out
 
 
 def main():
