@@ -706,6 +706,216 @@ def stage_decode(batch, prompt, new, deadline_s):
         "ms_per_token": round(best * 1e3 / new, 3)}), flush=True)
 
 
+def stage_serve(requests, deadline_s, rate=0.0, max_batch=64,
+                max_wait_ms=1.0):
+    """Continuous-batching serving throughput (ISSUE 7): drive
+    `singa_tpu.serve.ServingEngine` with a seeded Poisson OPEN-LOOP
+    load generator and report `serve_requests_per_sec` + p50/p99
+    request latency vs the batch=1 sequential baseline under the SAME
+    arrival schedule.
+
+    CPU-runnable by design: the speedup comes from amortizing
+    per-dispatch overhead (host dispatch + framework layer) across
+    coalesced rows, which exists on every backend — CI measures it,
+    the chip only confirms. The model's params and inputs are
+    quantized to dyadic values so every matmul reduction is EXACT in
+    fp32 regardless of batching, making the per-request replies
+    provably bit-identical to the unbatched forward (the acceptance
+    gate), not merely close.
+
+    `rate=0` auto-scales the Poisson rate to ~6x the calibrated
+    sequential capacity, so the serve run is measured under
+    saturation (the regime continuous batching exists for) without
+    hand-tuning per machine.
+    """
+    import numpy as np
+
+    t_stage0 = time.time()
+    _setup_jax()
+    import jax
+    import jax.numpy as jnp
+
+    from singa_tpu import device, export_cache, layer, model, serve, \
+        stats, tensor
+    from singa_tpu import trace as trace_mod
+
+    hard_stop = time.time() + deadline_s
+    dev = device.create_tpu_device()
+    dev.SetRandSeed(0)
+    FEATS, HIDDEN, CLASSES = 32, 32, 8
+
+    class ServeMLP(model.Model):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = layer.Linear(HIDDEN)
+            self.r1 = layer.ReLU()
+            self.fc2 = layer.Linear(CLASSES)
+
+        def forward(self, x):
+            return self.fc2(self.r1(self.fc1(x)))
+
+    rs = np.random.RandomState(0)
+    m = ServeMLP()
+    m.compile([tensor.from_numpy(
+        rs.randn(max_batch, FEATS).astype(np.float32), device=dev)],
+        is_train=False, use_graph=True)
+    m.eval()
+    # Dyadic params: multiples of 1/16 — with dyadic inputs every
+    # product/sum below stays exact in fp32, so batched and unbatched
+    # replies are bit-identical by arithmetic, not by luck.
+    for p in m.param_tensors():
+        p.data = jnp.round(p.data * 16.0) / 16.0
+    device.set_shape_buckets(max_batch=max_batch)
+    pol = export_cache.BucketPolicy(max_batch=max_batch)
+    setup_s = time.time() - t_stage0
+
+    # Offline prewarm (the tools/prewarm.py workflow): with the store
+    # armed, the serve run's dispatches are deserialize-only.
+    t0 = time.time()
+    if export_cache.active():
+        built = serve.prewarm_forward(
+            m, [((FEATS,), "float32")], max_batch=max_batch)
+        log(f"prewarm: {sum(1 for r in built if r['status'] != 'present')}"
+            f" built / {len(built)} buckets")
+    # single-sample request stream (dyadic inputs, see above)
+    reqs = [(rs.randint(-16, 16, (1, FEATS)) / 8.0).astype(np.float32)
+            for _ in range(requests)]
+
+    # Calibrate sequential capacity on the same request path.
+    for x in reqs[:5]:
+        m.forward_graph(tensor.from_numpy(x, device=dev))
+    t_cal = time.time()
+    n_cal = min(40, requests)
+    for x in reqs[:n_cal]:
+        np.asarray(m.forward_graph(
+            tensor.from_numpy(x, device=dev)).data)
+    seq_est_rps = n_cal / max(time.time() - t_cal, 1e-9)
+    rate = float(rate) or 6.0 * seq_est_rps
+    compile_s = time.time() - t0
+    log(f"calibrated sequential ~{seq_est_rps:.0f} req/s; "
+        f"poisson rate {rate:.0f} req/s")
+
+    rs_arr = np.random.RandomState(1)
+    arrivals = np.cumsum(rs_arr.exponential(1.0 / rate, requests))
+
+    t_steady0 = time.time()
+    # Both arms run PASSES times over the identical schedule and the
+    # best makespan counts (the decode stage's min-of-trials idiom):
+    # on a small shared CI box a single preemption spike inside the
+    # ~100 ms serve window would otherwise dominate the ratio.
+    PASSES = 2
+
+    # -- batch=1 sequential baseline under the same arrival schedule --
+    base_out = [None] * requests
+    seq_rps, base_lat = 0.0, None
+    for _ in range(PASSES):
+        lat_pass = np.zeros(requests)
+        t0 = time.perf_counter()
+        for i, x in enumerate(reqs):
+            now = time.perf_counter() - t0
+            if now < arrivals[i]:
+                time.sleep(arrivals[i] - now)
+            base_out[i] = np.asarray(m.forward_graph(
+                tensor.from_numpy(x, device=dev)).data).copy()
+            lat_pass[i] = (time.perf_counter() - t0) - arrivals[i]
+            if time.time() > hard_stop:
+                print(json.dumps({"ok": False,
+                                  "error": "deadline inside baseline"}),
+                      flush=True)
+                return
+        rps = requests / (time.perf_counter() - t0)
+        if rps > seq_rps:
+            seq_rps, base_lat = rps, lat_pass
+    log(f"sequential baseline: {seq_rps:.0f} req/s "
+        f"(p99 {np.percentile(base_lat, 99) * 1e3:.1f} ms)")
+
+    # -- continuous-batching serve runs, same schedule ----------------
+    mpath = os.path.join(HERE, "metrics", "bench_serve.jsonl")
+    mlog = trace_mod.MetricsLogger(mpath)
+    es0 = stats.cache_stats()["export"]
+    engine = serve.ServingEngine(m, max_batch=max_batch,
+                                 max_wait_ms=max_wait_ms,
+                                 metrics=mlog).start()
+    # Worker-boot warmup: execute each bucket program once so the
+    # timed runs measure the warm request path (deserialize-only with
+    # a prewarmed store) — the sequential baseline got the same
+    # treatment from its calibration loop above.
+    t_warm = time.time()
+    warmed = engine.warmup(reqs[0])
+    log(f"engine warmup: {warmed} bucket programs in "
+        f"{time.time() - t_warm:.2f}s")
+    serve_rps, match, replies = 0.0, True, None
+    for _ in range(PASSES):
+        replies_pass = [None] * requests
+        t0 = time.perf_counter()
+        for i, x in enumerate(reqs):
+            now = time.perf_counter() - t0
+            if now < arrivals[i]:
+                time.sleep(arrivals[i] - now)
+            replies_pass[i] = engine.submit(x)
+        try:
+            for r in replies_pass:
+                r.result(timeout=max(hard_stop - time.time(), 5))
+        except TimeoutError:  # structured error, like the baseline arm
+            engine.stop(drain=False)
+            mlog.close()
+            print(json.dumps({"ok": False,
+                              "error": "deadline inside serve run"}),
+                  flush=True)
+            return
+        rps = requests / (max(r.t_reply for r in replies_pass) - t0)
+        # the bit-identity gate holds on EVERY pass, not just the best
+        match = match and all(
+            np.array_equal(r.result(), base_out[i])
+            for i, r in enumerate(replies_pass))
+        if rps > serve_rps:
+            serve_rps, replies = rps, replies_pass
+    pct = engine.percentiles()
+    engine.stop()
+    mlog.close()
+    es1 = stats.cache_stats()["export"]
+    snap = stats.cache_stats()["serve"]
+    steady_s = time.time() - t_steady0
+
+    lat = np.asarray([r.latency_s for r in replies]) * 1e3
+    traces = es1["traces"] - es0["traces"]
+    stage_secs, export_info = _stage_obs(setup_s, compile_s, 0.0,
+                                         steady_s)
+    out = {
+        "ok": True, "metric": "serve_requests_per_sec",
+        "requests": requests,
+        "passes": PASSES,
+        "rate_rps": round(rate, 1),
+        "serve_requests_per_sec": round(serve_rps, 1),
+        "sequential_requests_per_sec": round(seq_rps, 1),
+        "speedup_vs_sequential": round(serve_rps / seq_rps, 2),
+        "p50_ms": round(float(np.percentile(lat, 50)), 3),
+        "p95_ms": round(float(np.percentile(lat, 95)), 3),
+        "p99_ms": round(float(np.percentile(lat, 99)), 3),
+        "sequential_p50_ms": round(
+            float(np.percentile(base_lat, 50)) * 1e3, 3),
+        "sequential_p99_ms": round(
+            float(np.percentile(base_lat, 99)) * 1e3, 3),
+        "rolling_percentiles": pct,
+        "dispatches": snap["dispatches"],
+        "coalesce_mean": snap["coalesce_mean"],
+        "occupancy_mean": snap["occupancy"],
+        "pad_fraction_mean": round(1.0 - snap["occupancy"], 4),
+        "buckets": snap["buckets"],
+        "replies_match": bool(match),
+        "forward_traces": traces,
+        "n_buckets": pol.n_buckets(),
+        "retrace_bound_ok": bool(traces <= pol.n_buckets()),
+        "max_batch": max_batch,
+        "max_wait_ms": max_wait_ms,
+        "stage_seconds": stage_secs,
+        "export_cache": export_info,
+        "metrics_jsonl": os.path.relpath(mpath, HERE),
+    }
+    log(f"RESULT {out}")
+    print(json.dumps(out), flush=True)
+
+
 def stage_pallas():
     """SINGA_TPU_PALLAS=1 microbench on the chip -> PALLAS_BENCH.md."""
     os.environ["SINGA_TPU_PALLAS"] = "1"
@@ -774,6 +984,16 @@ def main():
                    "scans batch/accum microbatches and applies once")
     p.add_argument("--size", choices=["base", "tiny"], default="base",
                    help="bert stage model size (tiny = CPU mechanics)")
+    p.add_argument("--requests", type=int, default=400,
+                   help="serve stage: Poisson open-loop request count")
+    p.add_argument("--rate", type=float, default=0.0,
+                   help="serve stage: Poisson arrival rate (req/s); "
+                   "0 = auto (~6x calibrated sequential capacity)")
+    p.add_argument("--max-wait-ms", type=float, default=1.0,
+                   help="serve stage: coalescing wait window")
+    p.add_argument("--serve-max-batch", type=int, default=64,
+                   help="serve stage: rows per fused dispatch "
+                   "(pow2; also the bucket ceiling)")
     p.add_argument("--smoke", action="store_true",
                    help="<=2min chip smoke test only")
     a = p.parse_args()
@@ -793,6 +1013,10 @@ def main():
         return stage_bert(a.batch, a.seq, a.steps, a.deadline,
                           slot_dtype=a.slot_dtype, size=a.size,
                           xla_profile=a.xla_profile)
+    if a.stage == "serve":
+        return stage_serve(a.requests, a.deadline, rate=a.rate,
+                           max_batch=a.serve_max_batch,
+                           max_wait_ms=a.max_wait_ms)
     if a.stage == "pallas":
         return stage_pallas()
     if a.stage == "decode":
@@ -973,6 +1197,18 @@ def main():
                 result_extra["decode_tokens_per_sec"] = (
                     dec["tokens_per_sec"])
                 result_extra["decode_config"] = dec["config"]
+        # Serving tier (ISSUE 7): continuous-batching requests/sec +
+        # SLO percentiles — the "millions of users" metric. Cheap
+        # (small MLP, CPU-provable), so it rides even tight windows.
+        if remaining() > 180:
+            srv = run_stage("serve", ["--requests", "400",
+                                      "--deadline", "150"], 210)
+            if srv and srv.get("ok"):
+                result_extra["serve_requests_per_sec"] = (
+                    srv["serve_requests_per_sec"])
+                result_extra["serve_p99_ms"] = srv["p99_ms"]
+                result_extra["serve_speedup_vs_sequential"] = (
+                    srv["speedup_vs_sequential"])
         # North-star config #5 chip metric (VERDICT r5 next #3): the
         # BERT-SONNX fine-tune step.
         if remaining() > 240:

@@ -14,6 +14,9 @@ Layer map (mirrors SURVEY.md §1, re-designed TPU-first):
     checkpoint           async checkpoint writer + keep-N rotation
     resilience           step guard, dynamic loss scaling, fault
                          injection, crash-consistent auto-resume
+    serve                continuous-batching inference serving tier
+                         (admission queue, bucket-padded fused
+                         dispatch, SLO percentiles, prewarm)
     converter            Caffe prototxt importer
     io/ + native/        record IO, snapshot, C++ runtime pieces
 """
@@ -34,6 +37,7 @@ from . import model  # noqa: F401
 from . import opt  # noqa: F401
 from . import resilience  # noqa: F401
 from . import rnn  # noqa: F401
+from . import serve  # noqa: F401
 from . import snapshot  # noqa: F401
 from . import sonnx  # noqa: F401
 from . import stats  # noqa: F401

@@ -72,6 +72,9 @@ __all__ = [
     # export_cache owns the state).
     "set_export_cache",
     "set_shape_buckets",
+    # Continuous-batching serving tier (ISSUE 7; singa_tpu.serve owns
+    # the state).
+    "set_serving",
     # Migration aliases (reference names):
     "create_cuda_gpu",
     "create_cuda_gpu_on",
@@ -575,6 +578,31 @@ def set_shape_buckets(max_batch=None, seq_dim=None, max_seq=None) -> None:
         export_cache.configure(buckets=export_cache.BucketPolicy(
             max_batch=max_batch if max_batch is not None else 4096,
             seq_dim=seq_dim, max_seq=max_seq))
+
+
+def set_serving(max_batch=None, max_wait_ms=None,
+                max_queue=None) -> None:
+    """Process defaults for the continuous-batching serving tier
+    (`singa_tpu.serve.ServingEngine`): `max_batch` bounds the rows one
+    fused dispatch coalesces, `max_wait_ms` is how long the dispatcher
+    holds the FIRST queued request waiting for companions (the
+    latency floor a lone request pays for batch occupancy), and
+    `max_queue` bounds the admission queue (full ⇒ a loud
+    `ServeQueueFullError` drop, counted in `cache_stats()["serve"]` —
+    never an unbounded backlog). Engines constructed afterwards read
+    these; per-engine constructor args override. Only the arguments
+    given change."""
+    from . import serve
+
+    kw = {}
+    if max_batch is not None:
+        kw["max_batch"] = max_batch
+    if max_wait_ms is not None:
+        kw["max_wait_ms"] = max_wait_ms
+    if max_queue is not None:
+        kw["max_queue"] = max_queue
+    if kw:
+        serve.configure(**kw)
 
 
 def set_dag_auto_flops_per_op(v: float) -> None:

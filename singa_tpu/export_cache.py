@@ -71,6 +71,7 @@ __all__ = [
     "pad_batch",
     "batch_mask",
     "step_key",
+    "artifact_exists",
     "load",
     "export_and_save",
     "note_step_retrace",
@@ -339,6 +340,13 @@ def knob_fingerprint() -> Dict:
     cfg = stats_mod.get_config()
     remat = getattr(autograd, "_remat", False)
     return {
+        # train/eval mode: dropout and BatchNorm trace DIFFERENT
+        # programs (eval BN normalizes by running stats and never
+        # updates them) — a train-mode forward artifact silently
+        # reused for inference would be a correctness bug, so the mode
+        # rides the knob snapshot for every executable kind, not just
+        # the forward extras.
+        "train_mode": bool(autograd.training),
         # pallas tier: flash-attention vs plain attention are
         # DIFFERENT traced programs behind the same model code
         "pallas": pallas_kernels.enabled(),
@@ -428,6 +436,14 @@ MANIFEST_SUFFIX = ".jexp.json"
 def _paths(key: str) -> Tuple[str, str]:
     base = os.path.join(_CONFIG["directory"], key[:32])
     return base + ARTIFACT_SUFFIX, base + MANIFEST_SUFFIX
+
+
+def artifact_exists(key: str) -> bool:
+    """Whether the store holds an artifact for `key` (existence only —
+    `load` still digest-checks). The prewarm tool's `--dry-run` probe:
+    answers "would this executable warm-start?" without deserializing,
+    tracing, or touching the hit/miss counters."""
+    return active() and os.path.exists(_paths(key)[0])
 
 
 def load(key: str):

@@ -492,13 +492,20 @@ class Model(Layer):
                 self._jit_step = _JitStep(self)
         return self._jit_step.lowered_text(*batch)
 
+    def _ensure_forward_exec(self) -> "_JitForward":
+        """The model's forward-executable wrapper, created lazily —
+        shared by `forward_graph`, the serving engine (`serve.py`
+        dispatches through it so requests hit the same warm AOT
+        artifacts), and the prewarm tool's dry-run key probe."""
+        if self._jit_fwd is None:
+            self._jit_fwd = _JitForward(self)
+        return self._jit_fwd
+
     def forward_graph(self, *xs: Tensor):
         """Run `forward` as one compiled XLA program (the eval-path
         analogue of `train_one_batch_graph`; reference eval replays the
         same buffered Graph)."""
-        if self._jit_fwd is None:
-            self._jit_fwd = _JitForward(self)
-        return self._jit_fwd(*xs)
+        return self._ensure_forward_exec()(*xs)
 
     # -- checkpoint --------------------------------------------------------
     def state_snapshot(self, aux_states: Optional[Dict] = None):
@@ -824,6 +831,51 @@ class _JitForward:
         )
         return pvals, svals, key, batch_arrays
 
+    def _export_identity(self, tensor_pos, statics, args):
+        """(key, parts) of the AOT artifact a forward dispatch with
+        these program args resolves to — the ONE definition shared by
+        the dispatch path (`_obtain`) and the prewarm tool's dry-run
+        probe (`export_key`), so the two can never drift."""
+        from . import export_cache
+
+        return export_cache.step_key(
+            self.model, None, "forward", args,
+            extras={"training": self.model.training,
+                    "tensor_pos": list(tensor_pos),
+                    # address-free: repr() of a plain object embeds
+                    # its 0x... address and would make keys
+                    # process-unique (never a warm hit)
+                    "statics": [export_cache._scalarize(s)
+                                for s in statics]})
+
+    def export_key(self, *xs) -> str:
+        """Store key of the artifact a `__call__` with these inputs
+        would load — computed WITHOUT tracing, dispatching, or
+        touching the hit/miss counters. Applies the same bucket
+        padding `__call__` would, so feeding real (unbucketed) request
+        shapes answers for the bucket they land in. Drives
+        `tools/prewarm.py --dry-run` ("which (model, bucket) artifacts
+        are missing?")."""
+        from . import export_cache
+
+        tensor_pos = tuple(i for i, x in enumerate(xs)
+                           if isinstance(x, Tensor))
+        statics = tuple(x for x in xs if not isinstance(x, Tensor))
+        batch_arrays = tuple(xs[i].data for i in tensor_pos)
+        if (export_cache.bucket_policy() is not None and batch_arrays
+                and not self.model.training):
+            batch_arrays, _ = export_cache.pad_batch_to_bucket(
+                batch_arrays)
+            batch_arrays = tuple(batch_arrays)
+        dev = self._device()
+        pvals, svals, key, batch_arrays = self._place_inputs(
+            [p.data for p in self.params],
+            [s.data for s in self.states],
+            dev._rng_key, batch_arrays,
+        )
+        args = (pvals, svals, key, batch_arrays)
+        return self._export_identity(tensor_pos, statics, args)[0]
+
     def _obtain(self, cache_key, tensor_pos, statics, nargs, args):
         """Forward executable via the AOT store when armed: load the
         serialized artifact (no tracing) or trace once + publish —
@@ -834,15 +886,7 @@ class _JitForward:
             fn = self._build(tensor_pos, statics, nargs)
             export_cache.count_trace(0.0)
             return fn
-        key, parts = export_cache.step_key(
-            self.model, None, "forward", args,
-            extras={"training": self.model.training,
-                    "tensor_pos": list(tensor_pos),
-                    # address-free: repr() of a plain object embeds
-                    # its 0x... address and would make keys
-                    # process-unique (never a warm hit)
-                    "statics": [export_cache._scalarize(s)
-                                for s in statics]})
+        key, parts = self._export_identity(tensor_pos, statics, args)
         exp = export_cache.load(key)
         if exp is None:
             built = self._build(tensor_pos, statics, nargs)

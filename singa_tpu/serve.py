@@ -1,0 +1,747 @@
+"""Continuous-batching inference serving tier (ISSUE 7; ROADMAP
+item 1 — the "millions of users" leg).
+
+Production traffic is mostly forward passes, and the per-dispatch cost
+on an accelerator is dominated by fixed overhead (host dispatch, the
+Python framework layer, kernel launch) rather than by the rows in the
+batch — so the classic inference-throughput optimization is to turn
+many small concurrent requests into a few large fused dispatches.
+`ServingEngine` does exactly that:
+
+  admission queue  — `submit()` enqueues a single-sample (or
+      small-batch) request into a BOUNDED queue and returns a
+      `ServeReply` future; a full queue drops the request LOUDLY
+      (`ServeQueueFullError`, counted), never silently stalls the
+      caller forever.
+  coalescing       — a dispatcher thread drains whatever is waiting,
+      up to `max_batch` rows or a `max_wait_ms` deadline from the
+      first queued request (the latency/occupancy trade: waiting
+      longer fills bigger batches). Requests with different
+      per-sample signatures (trailing dims / dtypes) form separate
+      dispatch groups in the same drain cycle.
+  bucket padding   — the coalesced batch is padded up to the nearest
+      PR 6 shape bucket (`export_cache.pad_batch_to_bucket`, the
+      `pad_batch`/`batch_mask` idiom: repeat-final-sample rows,
+      provably inert for the row-independent eval forward), so
+      diverse traffic executes at most `BucketPolicy.n_buckets()`
+      distinct programs. A request larger than the top bucket gets a
+      loud per-request `BucketOverflowError` — never a silent
+      retrace.
+  one dispatch     — the padded batch runs through the model's
+      forward executable (`model._JitForward` in EVAL mode), which
+      loads warm from the AOT export cache when armed: the request
+      path never traces on a provisioned worker (native models and
+      ONNX-imported `sonnx.SONNXModel`s alike, via
+      `topology_fingerprint`). `tools/prewarm.py` populates the store
+      offline so worker cold start is deserialize-only.
+  scatter          — per-request reply rows are sliced back out
+      (pad rows dropped first) and delivered through the futures as
+      host numpy arrays.
+
+Observability: per-request spans thread the PR 5 tracer (`queue_wait`
+via `trace.record_span` — it crosses threads — plus per-dispatch
+`batch_assemble` / `dispatch` / `reply` spans), a `MetricsLogger`
+JSONL stream records one record per dispatch (batch occupancy, pad
+fraction, rolling p50/p95/p99 request latency), and
+`cache_stats()["serve"]` exposes queue depth, coalesce sizes, the
+bucket hit histogram, and dropped/overflowed request counters.
+
+Knobs: `device.set_serving(max_batch=..., max_wait_ms=...,
+max_queue=...)` sets the process defaults; `ServingEngine(...)`
+overrides per-engine. Bench: `bench.py --stage serve` drives the
+engine with a seeded Poisson open-loop load generator and reports
+`serve_requests_per_sec` + p50/p99 — CPU-runnable, so CI measures the
+continuous-batching speedup and the chip only confirms it.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from . import export_cache, stats as stats_mod, trace as trace_mod
+
+__all__ = [
+    "ServingEngine",
+    "ServeReply",
+    "ServeQueueFullError",
+    "ServeClosedError",
+    "configure",
+    "get_config",
+    "prewarm_forward",
+]
+
+
+class ServeQueueFullError(RuntimeError):
+    """The admission queue is at `max_queue`: the request is DROPPED
+    (counted in `cache_stats()["serve"]["dropped"]`). Deliberately
+    loud at submit time — back-pressure the caller can act on beats a
+    queue that grows without bound or a request that silently
+    vanishes."""
+
+
+class ServeClosedError(RuntimeError):
+    """The engine is stopped (or stopping): no new requests are
+    admitted, and requests still queued at stop() are failed with
+    this."""
+
+
+# ---------------------------------------------------------------------------
+# Process-default knobs (user-facing setter: device.set_serving).
+# ---------------------------------------------------------------------------
+_CONFIG: Dict = {
+    # Max ROWS per fused dispatch (the coalescing ceiling). Engines
+    # clamp it to the bucket policy's ceiling when one is armed.
+    "max_batch": 64,
+    # How long the dispatcher waits, from the FIRST queued request,
+    # for more requests to coalesce before dispatching a partial
+    # batch — the latency floor a lone request pays for occupancy.
+    "max_wait_ms": 2.0,
+    # Admission-queue bound (requests, not rows). Full => loud drop.
+    "max_queue": 4096,
+}
+
+
+def configure(**kw) -> Dict:
+    """Update serving defaults (`max_batch`, `max_wait_ms`,
+    `max_queue`). User-facing setter: `device.set_serving`."""
+    for k, v in kw.items():
+        if k not in _CONFIG:
+            raise KeyError(f"unknown serving config key {k!r}; known: "
+                           f"{sorted(_CONFIG)}")
+        if k == "max_wait_ms":
+            v = float(v)
+            if v < 0:
+                raise ValueError("max_wait_ms must be >= 0")
+        else:
+            v = int(v)
+            if v < 1:
+                raise ValueError(f"{k} must be >= 1")
+        _CONFIG[k] = v
+    return dict(_CONFIG)
+
+
+def get_config() -> Dict:
+    return dict(_CONFIG)
+
+
+# ---------------------------------------------------------------------------
+# Observability: cache_stats()["serve"]
+# ---------------------------------------------------------------------------
+class _ServeStats:
+    """Counters for the serving tier. `queue_depth` is live state (the
+    requests waiting right now); `buckets` is the bucket-size hit
+    histogram — together with `coalesce_mean` it says whether traffic
+    actually fuses (occupancy near 1 at big buckets) or the wait
+    window is too short (many size-1 dispatches)."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        self.requests = 0
+        self.replies = 0
+        self.errors = 0
+        self.dropped = 0
+        self.overflowed = 0
+        self.dispatches = 0
+        self.coalesced_requests = 0
+        self.coalesced_rows = 0
+        self.pad_rows = 0
+        self.max_coalesce = 0
+        # queue_depth is LIVE state (requests waiting right now), not
+        # a counter — reset keeps it and restarts its high-water mark
+        # (the resilience-scaler reset convention).
+        self.queue_depth = getattr(self, "queue_depth", 0)
+        self.max_queue_depth = self.queue_depth
+        self._buckets: Dict[int, int] = {}
+
+    def note_dispatch(self, n_requests: int, n_rows: int,
+                      n_bucket: int) -> None:
+        self.dispatches += 1
+        self.coalesced_requests += n_requests
+        self.coalesced_rows += n_rows
+        self.pad_rows += n_bucket - n_rows
+        if n_requests > self.max_coalesce:
+            self.max_coalesce = n_requests
+        self._buckets[n_bucket] = self._buckets.get(n_bucket, 0) + 1
+
+    def snapshot(self) -> Dict:
+        d = max(self.dispatches, 1)
+        return {
+            "requests": self.requests,
+            "replies": self.replies,
+            "errors": self.errors,
+            "dropped": self.dropped,
+            "overflowed": self.overflowed,
+            "dispatches": self.dispatches,
+            "coalesce_mean": round(self.coalesced_requests / d, 3),
+            "max_coalesce": self.max_coalesce,
+            "rows": self.coalesced_rows,
+            "pad_rows": self.pad_rows,
+            "occupancy": round(
+                self.coalesced_rows
+                / max(self.coalesced_rows + self.pad_rows, 1), 4),
+            "queue_depth": self.queue_depth,
+            "max_queue_depth": self.max_queue_depth,
+            "buckets": {str(k): v
+                        for k, v in sorted(self._buckets.items())},
+        }
+
+
+_STATS = _ServeStats()
+stats_mod.register_cache("serve", _STATS)
+
+
+def serve_stats() -> _ServeStats:
+    return _STATS
+
+
+# ---------------------------------------------------------------------------
+# Requests / replies
+# ---------------------------------------------------------------------------
+class ServeReply:
+    """Future for one submitted request. `result(timeout)` blocks for
+    the reply (host numpy array, or pytree of them, with the request's
+    REAL row count) and re-raises the per-request error if the
+    dispatch failed — a `BucketOverflowError` request fails ITS future
+    loudly without poisoning the batch it would have ridden in."""
+
+    __slots__ = ("_ev", "_value", "_error", "n", "t_submit", "t_reply")
+
+    def __init__(self, n: int):
+        self._ev = threading.Event()
+        self._value = None
+        self._error: Optional[BaseException] = None
+        self.n = n
+        self.t_submit = time.perf_counter()
+        self.t_reply: Optional[float] = None
+
+    def done(self) -> bool:
+        return self._ev.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._ev.wait(timeout):
+            raise TimeoutError("serve reply not ready")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        return (None if self.t_reply is None
+                else self.t_reply - self.t_submit)
+
+    # -- engine side -----------------------------------------------------
+    def _deliver(self, value) -> None:
+        self.t_reply = time.perf_counter()
+        self._value = value
+        self._ev.set()
+
+    def _fail(self, err: BaseException) -> None:
+        self.t_reply = time.perf_counter()
+        self._error = err
+        self._ev.set()
+
+
+class _Request:
+    __slots__ = ("arrays", "n", "sig", "reply", "t_enqueue")
+
+    def __init__(self, arrays: List[np.ndarray], n: int, sig, reply):
+        self.arrays = arrays
+        self.n = n
+        self.sig = sig
+        self.reply = reply
+        self.t_enqueue = time.perf_counter()
+
+
+def _pow2_ceil(n: int) -> int:
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
+
+
+# ---------------------------------------------------------------------------
+# The engine
+# ---------------------------------------------------------------------------
+class ServingEngine:
+    """Continuous micro-batching over one model's eval forward.
+
+    `model` must have initialized params (call `compile(...)` once) —
+    the engine forces EVAL mode at `start()` (serving a train-mode
+    forward would consume dropout keys and corrupt BN running stats)
+    and dispatches through `model._JitForward`, so the AOT export
+    cache, the bucket policy, and the SONNX graph fingerprint all
+    apply to the request path exactly as they do to a direct
+    `forward_graph` call.
+
+    All dispatching happens on ONE daemon thread: jax dispatch and the
+    device RNG key stay single-writer, and `submit()` is safe from any
+    number of caller threads.
+    """
+
+    def __init__(self, model, max_batch: Optional[int] = None,
+                 max_wait_ms: Optional[float] = None,
+                 max_queue: Optional[int] = None,
+                 bucket_policy: Optional["export_cache.BucketPolicy"]
+                 = None,
+                 metrics: Optional["trace_mod.MetricsLogger"] = None,
+                 latency_window: int = 2048):
+        cfg = get_config()
+        self.model = model
+        self.max_batch = int(max_batch if max_batch is not None
+                             else cfg["max_batch"])
+        self.max_wait_s = float(max_wait_ms if max_wait_ms is not None
+                                else cfg["max_wait_ms"]) / 1e3
+        self.max_queue = int(max_queue if max_queue is not None
+                             else cfg["max_queue"])
+        if self.max_batch < 1 or self.max_queue < 1:
+            raise ValueError("max_batch and max_queue must be >= 1")
+        # Bucket ladder: an explicit policy wins, else the process
+        # policy (device.set_shape_buckets), else a private pow2
+        # ladder capped at max_batch — the engine ALWAYS dispatches
+        # bucketed shapes, so retraces/artifacts stay bounded even
+        # when the process never armed a policy.
+        self.policy = (bucket_policy or export_cache.bucket_policy()
+                       or export_cache.BucketPolicy(
+                           max_batch=_pow2_ceil(self.max_batch)))
+        if self.max_batch > self.policy.max_batch:
+            raise ValueError(
+                f"max_batch {self.max_batch} exceeds the bucket "
+                f"ceiling {self.policy.max_batch}; a dispatch the "
+                "policy cannot bucket would be a guaranteed overflow")
+        # The forward dispatch path re-pads with the PROCESS policy
+        # when one is armed — an engine policy with a higher ceiling
+        # would coalesce batches the dispatch then rejects, failing
+        # whole groups that each passed submit().
+        proc = export_cache.bucket_policy()
+        if (proc is not None and proc is not self.policy
+                and self.policy.bucket_batch(self.max_batch)
+                > proc.max_batch):
+            raise ValueError(
+                f"engine bucket ladder tops at "
+                f"{self.policy.bucket_batch(self.max_batch)} but the "
+                f"process policy (device.set_shape_buckets) caps "
+                f"dispatches at {proc.max_batch}; lower max_batch or "
+                "raise the process ceiling")
+        self.metrics = metrics
+        self._latencies: deque = deque(maxlen=int(latency_window))
+        self._queue: deque = deque()
+        self._lock = threading.Lock()
+        self._have_work = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+        self._dispatch_idx = 0
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "ServingEngine":
+        if self._running:
+            return self
+        # Same contract as calling forward_graph directly: the model
+        # must have been compile()d (lazy params initialized) first.
+        self.model.eval()
+        self._running = True
+        self._thread = threading.Thread(target=self._loop,
+                                        name="singa_tpu-serve",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True,
+             timeout: Optional[float] = 30.0) -> None:
+        """Stop the dispatcher. `drain=True` (default) serves what is
+        already queued first; `drain=False` fails queued requests with
+        `ServeClosedError` (counted as errors)."""
+        if not self._running:
+            return
+        if not drain:
+            with self._lock:
+                victims = list(self._queue)
+                self._queue.clear()
+                _STATS.queue_depth = 0
+            for req in victims:
+                _STATS.errors += 1
+                req.reply._fail(ServeClosedError("engine stopped"))
+        with self._lock:  # atomic vs submit()'s admission check
+            self._running = False
+        self._have_work.set()  # wake the dispatcher to exit
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        # Fail any straggler that slipped in while the dispatcher was
+        # exiting — a queued request with no thread to serve it would
+        # otherwise hang its caller until their own timeout.
+        with self._lock:
+            victims = list(self._queue)
+            self._queue.clear()
+            _STATS.queue_depth = 0
+        for req in victims:
+            _STATS.errors += 1
+            req.reply._fail(ServeClosedError("engine stopped"))
+
+    def warmup(self, *arrays) -> int:
+        """Execute the forward once per dispatchable bucket, padding
+        `arrays` (ONE example request) up the pow2 ladder — the
+        worker-boot step that moves deserialize + XLA-compile of every
+        bucket program off the request path. With a prewarmed store
+        this costs loads only (zero traces); without one it traces
+        each bucket exactly once, which is the same bounded cost the
+        first live requests would otherwise pay at p99. Call before
+        (or right after) `start()`, ahead of real traffic — it
+        dispatches directly, bypassing the queue. Returns the number
+        of bucket programs warmed."""
+        from . import tensor as tensor_mod
+
+        batch = [a[:1] for a in self._as_batch(arrays)]
+        was_training = self.model.training
+        self.model.eval()
+        dev = self._device()
+        ceiling = min(self.policy.max_batch,
+                      _pow2_ceil(self.max_batch))
+        warmed, b = 0, 1
+        try:
+            while b <= ceiling:
+                padded = export_cache.pad_batch(batch, b)
+                self.model._ensure_forward_exec()(
+                    *[tensor_mod.from_numpy(np.ascontiguousarray(a),
+                                            device=dev)
+                      for a in padded])
+                warmed += 1
+                b <<= 1
+        finally:
+            self.model.train(was_training)
+        return warmed
+
+    def __enter__(self) -> "ServingEngine":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- admission --------------------------------------------------------
+    @staticmethod
+    def _as_batch(arrays: Sequence) -> List[np.ndarray]:
+        out = []
+        for a in arrays:
+            a = np.asarray(getattr(a, "data", a))
+            if a.ndim == 0:
+                raise ValueError(
+                    "serve requests are batched along dim 0; got a "
+                    "0-d input — wrap single samples as shape "
+                    "(1, ...)")
+            out.append(a)
+        return out
+
+    def submit(self, *arrays) -> ServeReply:
+        """Enqueue one request (numpy arrays or Tensors; every array
+        batched along dim 0 with a shared row count) and return its
+        `ServeReply` future. Raises `ServeQueueFullError` /
+        `ServeClosedError` / `BucketOverflowError` at admission —
+        requests the engine could never serve are refused while the
+        caller can still act, not parked."""
+        if not self._running:
+            raise ServeClosedError("engine not running: call start()")
+        batch = self._as_batch(arrays)
+        if not batch:
+            raise ValueError("serve request needs at least one input")
+        n = int(batch[0].shape[0])
+        for a in batch:
+            if int(a.shape[0]) != n:
+                raise ValueError(
+                    "serve request inputs disagree on the batch dim: "
+                    f"{[int(x.shape[0]) for x in batch]}")
+        _STATS.requests += 1
+        if n > self.policy.max_batch or n > self.max_batch:
+            _STATS.overflowed += 1
+            raise export_cache.BucketOverflowError(
+                f"request batch {n} exceeds the serving ceiling "
+                f"(max_batch {self.max_batch}, top bucket "
+                f"{self.policy.max_batch}); split the request or "
+                "raise the ceiling — a silent retrace above the "
+                "ladder is exactly what the policy forbids")
+        if self.policy.seq_dim is not None:
+            d = self.policy.seq_dim
+            for a in batch:
+                if a.ndim > d and int(a.shape[d]) > self.policy.max_seq:
+                    _STATS.overflowed += 1
+                    raise export_cache.BucketOverflowError(
+                        f"request seq length {int(a.shape[d])} (dim "
+                        f"{d}) exceeds the bucket ladder's max_seq "
+                        f"{self.policy.max_seq}; truncate/split the "
+                        "request or raise the ceiling")
+        sig = tuple((tuple(int(d) for d in a.shape[1:]),
+                     str(a.dtype)) for a in batch)
+        reply = ServeReply(n)
+        req = _Request(batch, n, sig, reply)
+        with self._lock:
+            # re-checked under the lock stop() takes: past this point
+            # the dispatcher is guaranteed to drain the queue once
+            # more before exiting, so the request cannot strand
+            if not self._running:
+                raise ServeClosedError("engine stopped")
+            if len(self._queue) >= self.max_queue:
+                _STATS.dropped += 1
+                raise ServeQueueFullError(
+                    f"admission queue full ({self.max_queue} "
+                    "requests); the request was dropped — scale "
+                    "workers or raise max_queue "
+                    "(device.set_serving)")
+            self._queue.append(req)
+            _STATS.queue_depth = len(self._queue)
+            if _STATS.queue_depth > _STATS.max_queue_depth:
+                _STATS.max_queue_depth = _STATS.queue_depth
+        self._have_work.set()
+        return reply
+
+    def infer(self, *arrays, timeout: Optional[float] = None):
+        """Synchronous submit+wait — one request's reply."""
+        return self.submit(*arrays).result(timeout)
+
+    # -- dispatcher -------------------------------------------------------
+    def _pop(self) -> Optional[_Request]:
+        with self._lock:
+            if self._queue:
+                req = self._queue.popleft()
+                _STATS.queue_depth = len(self._queue)
+                return req
+            self._have_work.clear()
+            return None
+
+    def _loop(self) -> None:
+        while True:
+            req = self._pop()
+            if req is None:
+                if not self._running:
+                    return
+                self._have_work.wait(0.05)
+                continue
+            # Coalesce window: from the FIRST request of this batch,
+            # wait up to max_wait_s for more work, stopping early when
+            # the batch is full. A request that does not fit (wrong
+            # signature, or it would overflow max_batch) is requeued
+            # at the FRONT below — never reordered behind later
+            # requests of its own signature. The scan stops once a
+            # full cycle's worth of mismatches piled up: under deep
+            # alternating-signature queues an unbounded scan would
+            # churn the whole deque every dispatch.
+            group = [req]
+            rows = req.n
+            deadline = req.t_enqueue + self.max_wait_s
+            pending: List[_Request] = []
+            while rows < self.max_batch:
+                nxt = self._pop()
+                if nxt is None:
+                    now = time.perf_counter()
+                    if now >= deadline or not self._running:
+                        break
+                    self._have_work.wait(min(deadline - now, 0.005))
+                    continue
+                if nxt.sig != req.sig or rows + nxt.n > self.max_batch:
+                    pending.append(nxt)
+                    # a full batch is full regardless of signature;
+                    # mixed-signature traffic dispatches next cycle
+                    if (rows + nxt.n > self.max_batch
+                            or len(pending) >= self.max_batch):
+                        break
+                    continue
+                group.append(nxt)
+                rows += nxt.n
+            # requeue the leftovers at the FRONT, preserving order
+            if pending:
+                with self._lock:
+                    for p in reversed(pending):
+                        self._queue.appendleft(p)
+                    _STATS.queue_depth = len(self._queue)
+                self._have_work.set()
+            self._dispatch(group, rows)
+
+    def _dispatch(self, group: List[_Request], rows: int) -> None:
+        from . import tensor as tensor_mod
+
+        t_deq = time.perf_counter()
+        for r in group:
+            trace_mod.record_span("queue_wait", r.t_enqueue, t_deq,
+                                  rows=r.n)
+        self._dispatch_idx += 1
+        try:
+            with trace_mod.span("batch_assemble", requests=len(group),
+                                rows=rows):
+                if len(group) == 1:
+                    batch = list(group[0].arrays)
+                else:
+                    batch = [np.concatenate([g.arrays[i]
+                                             for g in group])
+                             for i in range(len(group[0].arrays))]
+                padded, info = export_cache.pad_batch_to_bucket(
+                    batch, self.policy)
+                n_bucket = info["n_bucket"]
+                dev = self._device()
+                tensors = [tensor_mod.from_numpy(np.ascontiguousarray(a),
+                                                 device=dev)
+                           for a in padded]
+            t0 = time.perf_counter()
+            with trace_mod.span("dispatch", bucket=n_bucket):
+                out = self.model._ensure_forward_exec()(*tensors)
+            with trace_mod.span("reply", requests=len(group)):
+                host = self._to_host(out, info)
+                self._scatter(group, host, rows)
+            dispatch_s = time.perf_counter() - t0
+        except BaseException as e:  # fail the whole group, keep serving
+            for r in group:
+                _STATS.errors += 1
+                r.reply._fail(e)
+            return
+        try:  # replies are out — bookkeeping must not kill the thread
+            _STATS.note_dispatch(len(group), rows, n_bucket)
+            _STATS.replies += len(group)
+            with self._lock:  # percentiles() reads from caller threads
+                for r in group:
+                    self._latencies.append(r.reply.latency_s)
+            if self.metrics is not None:
+                p = self.percentiles()
+                self.metrics.log_step(
+                    self._dispatch_idx, examples=rows,
+                    step_s=dispatch_s,
+                    requests=len(group), rows=rows, bucket=n_bucket,
+                    occupancy=round(rows / n_bucket, 4),
+                    pad_fraction=round((n_bucket - rows) / n_bucket, 4),
+                    queue_depth=_STATS.queue_depth,
+                    p50_ms=p["p50_ms"], p95_ms=p["p95_ms"],
+                    p99_ms=p["p99_ms"])
+        except Exception:
+            _STATS.errors += 1  # e.g. metrics stream closed mid-serve
+
+    def _device(self):
+        ps = self.model.param_tensors()
+        if ps:
+            return ps[0].device
+        from .device import get_default_device
+
+        return get_default_device()
+
+    @staticmethod
+    def _to_host(out, info):
+        """Flatten the reply pytree to host numpy and undo the bucket
+        padding (`export_cache.slice_bucket_out`): pad ROWS come off
+        every batch-carrying leaf, and when the policy bucketed a
+        sequence dim the pad POSITIONS come off too — a reply must
+        never carry fabricated repeated-final-position output."""
+        import jax
+
+        host = jax.tree_util.tree_map(
+            lambda t: np.asarray(getattr(t, "data", t)), out,
+            is_leaf=lambda t: hasattr(t, "data") or hasattr(t, "shape"))
+        return export_cache.slice_bucket_out(host, info)
+
+    @staticmethod
+    def _scatter(group: List[_Request], host, rows: int) -> None:
+        import jax
+
+        off = 0
+        for r in group:
+            lo, hi = off, off + r.n
+            off = hi
+
+            def cut(a, lo=lo, hi=hi):
+                if (getattr(a, "ndim", 0) >= 1
+                        and a.shape[0] == rows):
+                    return a[lo:hi]
+                return a  # non-batch leaf: shared across requests
+
+            r.reply._deliver(jax.tree_util.tree_map(cut, host))
+
+    # -- SLO percentiles --------------------------------------------------
+    def percentiles(self) -> Dict[str, Optional[float]]:
+        """Rolling request-latency percentiles (ms) over the last
+        `latency_window` replies — the SLO numbers the metrics stream
+        and the bench report."""
+        with self._lock:
+            lat = [l for l in self._latencies if l is not None]
+        if not lat:
+            return {"p50_ms": None, "p95_ms": None, "p99_ms": None}
+        arr = np.asarray(lat) * 1e3
+        return {"p50_ms": round(float(np.percentile(arr, 50)), 3),
+                "p95_ms": round(float(np.percentile(arr, 95)), 3),
+                "p99_ms": round(float(np.percentile(arr, 99)), 3)}
+
+
+# ---------------------------------------------------------------------------
+# Offline prewarm (tools/prewarm.py drives this)
+# ---------------------------------------------------------------------------
+def prewarm_forward(model, sample_spec, policy=None,
+                    max_batch: Optional[int] = None,
+                    dry_run: bool = False) -> List[Dict]:
+    """Populate the AOT export cache with the EVAL forward executable
+    for every bucket a serving config can dispatch, so a serving
+    worker's cold start is deserialize-only. `sample_spec` is one
+    (per_sample_shape, dtype) pair per model input — the batch dim is
+    prepended per bucket. With `dry_run=True` nothing traces: each
+    bucket's artifact key is computed (`_JitForward.export_key`) and
+    reported present/missing. Returns one row per bucket:
+    {bucket, seq, key, status} with status in
+    {"present", "missing", "built"}.
+
+    Requires an armed store (`device.set_export_cache`) — prewarming
+    into a disabled cache would trace for nothing and warm no one.
+    """
+    from . import tensor as tensor_mod
+    from .device import get_default_device
+
+    if not export_cache.active():
+        raise RuntimeError(
+            "prewarm needs an armed export cache: call "
+            "device.set_export_cache(dir) first")
+    pol = (policy or export_cache.bucket_policy()
+           or export_cache.BucketPolicy(
+               max_batch=_pow2_ceil(max_batch
+                                    or get_config()["max_batch"])))
+    ceiling = (min(pol.max_batch, _pow2_ceil(max_batch))
+               if max_batch else pol.max_batch)
+    batches = []
+    b = 1
+    while b <= ceiling:
+        batches.append(b)
+        b <<= 1
+    seqs: List[Optional[int]] = [None]
+    if pol.seq_dim is not None:
+        seqs = []
+        s = 1
+        while s <= pol.max_seq:
+            seqs.append(s)
+            s <<= 1
+    was_training = model.training
+    model.eval()
+    dev = get_default_device()
+    rows: List[Dict] = []
+    try:
+        fwd = model._ensure_forward_exec()
+        for b in batches:
+            for s in seqs:
+                tensors = []
+                for shape, dtype in sample_spec:
+                    shape = list(shape)
+                    if s is not None and len(shape) >= pol.seq_dim:
+                        shape[pol.seq_dim - 1] = s  # seq_dim counts
+                        # the batch dim; per-sample shapes don't
+                    arr = np.zeros([b] + shape, dtype=np.dtype(dtype))
+                    tensors.append(tensor_mod.from_numpy(arr,
+                                                         device=dev))
+                key = fwd.export_key(*tensors)
+                if export_cache.artifact_exists(key):
+                    status = "present"
+                elif dry_run:
+                    status = "missing"
+                else:
+                    model.forward_graph(*tensors)  # trace + publish
+                    status = ("built" if export_cache.artifact_exists(
+                        key) else "missing")
+                rows.append({"bucket": b, "seq": s, "key": key,
+                             "status": status})
+    finally:
+        model.train(was_training)
+    return rows

@@ -1263,6 +1263,28 @@ class SONNXModel(model_mod.Model):
         self._optimizer.backward_and_update(loss)
         return out, loss
 
+    def input_specs(self):
+        """Per-sample (shape, dtype) of every graph input, batch dim
+        (dim 0) dropped — read from the graph's value-info, so the
+        serving prewarm (`tools/prewarm.py --onnx`) can enumerate the
+        (model, bucket) artifact grid without the operator re-typing
+        shapes the model already declares. Inputs with no static shape
+        info (or rank 0) are reported with shape None — the caller
+        must supply those explicitly."""
+        specs = []
+        for vi in self.rep.model_proto.graph.input:
+            if vi.name in self.rep._init_names:
+                continue
+            tt = vi.type.tensor_type
+            dtype = np.dtype(_ONNX2NP.get(tt.elem_type, np.float32))
+            dims = [d.dim_value for d in tt.shape.dim]
+            if len(dims) < 1 or any(d <= 0 for d in dims[1:]):
+                specs.append((None, str(dtype)))
+            else:
+                specs.append((tuple(int(d) for d in dims[1:]),
+                              str(dtype)))
+        return specs
+
     def topology_fingerprint(self) -> str:
         """AOT export-cache identity (ISSUE 6): everything the base
         fingerprint hashes (subclass source, param/state inventory,

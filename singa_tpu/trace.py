@@ -56,6 +56,7 @@ __all__ = [
     "get_config",
     "enabled",
     "span",
+    "record_span",
     "step_span",
     "records",
     "clear",
@@ -229,6 +230,39 @@ def span(name: str, **args):
     if not _ENABLED:
         return _NULL
     return _Span(name, args or None)
+
+
+def record_span(name: str, t0: float, t1: float, **args) -> None:
+    """Record an already-measured span from explicit `perf_counter`
+    endpoints. The context-manager `span()` times work on ONE thread;
+    a latency that starts on one thread and ends on another — a
+    serving request's `queue_wait`, measured from the submitter's
+    enqueue to the dispatcher's dequeue — can only be recorded after
+    the fact. Same ring, same drop accounting, same strict no-op while
+    tracing is disabled. Top-level by construction (no parent): the
+    two endpoint threads have different span stacks, so nesting is
+    undefined."""
+    if not _ENABLED:
+        return
+    rec = {
+        "name": name,
+        "ts": t0 * 1e6,
+        "dur": max(t1 - t0, 0.0) * 1e6,
+        "tid": threading.get_ident(),
+        "id": next(_NEXT_ID),
+        "parent": None,
+        "depth": 0,
+        "step": None,
+    }
+    if args:
+        rec["args"] = args
+    with _LOCK:
+        if not _ENABLED:
+            return
+        if len(_RING) == _RING.maxlen:
+            _STATS.dropped += 1
+        _RING.append(rec)
+        _STATS.spans += 1
 
 
 class _StepCtx:

@@ -240,6 +240,87 @@ def test_bert_stage_contract_and_slot_dtype_matrix():
         last["loss"], float)
 
 
+def test_serve_stage_contract_and_acceptance():
+    """ISSUE 7: the continuous-batching serve stage's JSON contract —
+    pinned field set, >= 3x requests/sec over the batch=1 sequential
+    baseline under the same Poisson load (the acceptance gate, CPU-
+    measurable by design), per-request replies bit-identical to the
+    unbatched forward (dyadic arithmetic), and forward traces bounded
+    by the bucket count. The metrics JSONL parses with one record per
+    dispatch carrying the occupancy/pad/percentile fields."""
+    proc, result = _run_stage(
+        ["--stage", "serve", "--requests", "300",
+         "--deadline", "150"], timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert result is not None, "no JSON result line on stdout"
+    assert result["ok"] is True
+    assert result["metric"] == "serve_requests_per_sec"
+    for k in ("serve_requests_per_sec", "sequential_requests_per_sec",
+              "speedup_vs_sequential", "p50_ms", "p95_ms", "p99_ms",
+              "sequential_p50_ms", "sequential_p99_ms", "dispatches",
+              "coalesce_mean", "occupancy_mean", "pad_fraction_mean",
+              "buckets", "replies_match", "forward_traces",
+              "n_buckets", "retrace_bound_ok", "stage_seconds",
+              "export_cache", "metrics_jsonl"):
+        assert k in result, f"serve result missing {k}"
+    assert result["serve_requests_per_sec"] > 0
+    assert result["speedup_vs_sequential"] >= 3.0, (
+        f"continuous batching only "
+        f"{result['speedup_vs_sequential']}x vs sequential")
+    assert result["replies_match"] is True
+    assert result["forward_traces"] <= result["n_buckets"]
+    assert result["retrace_bound_ok"] is True
+    assert 0.0 < result["occupancy_mean"] <= 1.0
+    assert result["dispatches"] < result["requests"], (
+        "no coalescing happened: one dispatch per request")
+    assert result["p50_ms"] <= result["p99_ms"]
+    assert result["metrics_jsonl"] == os.path.join(
+        "metrics", "bench_serve.jsonl")
+    from singa_tpu import trace
+
+    recs = trace.read_metrics(
+        os.path.join(_ROOT, result["metrics_jsonl"]))
+    assert recs, "serve stage wrote no metrics records"
+    x = recs[-1]["extra"]
+    for k in ("requests", "rows", "bucket", "occupancy",
+              "pad_fraction", "queue_depth", "p50_ms", "p99_ms"):
+        assert k in x, f"serving metrics record missing extra.{k}"
+
+
+def test_serve_row_rides_the_driver_ramp():
+    """The serving metric reaches the driver result table
+    (`serve_requests_per_sec` in result_extra), same as lm/decode/
+    bert."""
+    src = open(os.path.join(_ROOT, "bench.py")).read()
+    assert 'run_stage("serve"' in src
+    assert 'result_extra["serve_requests_per_sec"]' in src
+
+
+def test_fold_onchip_renders_serve_stage(tmp_path, capsys,
+                                         monkeypatch):
+    """ISSUE 7: tools/fold_onchip.py renders serve-stage rows
+    (req/s, SLO percentiles, occupancy, speedup, warm column)."""
+    fold = _load_module("fold_onchip_for_test", "tools/fold_onchip.py")
+    logs = tmp_path / "onchip_logs"
+    logs.mkdir()
+    (logs / "serve.out").write_text(json.dumps(
+        {"ok": True, "metric": "serve_requests_per_sec",
+         "serve_requests_per_sec": 8123.4, "p50_ms": 2.1,
+         "p99_ms": 7.9, "occupancy_mean": 0.83,
+         "speedup_vs_sequential": 4.4,
+         "stage_seconds": {"setup": 2.0, "trace": 1.0, "compile": 0.5,
+                           "load": 0.1, "steady": 3.0},
+         "export_cache": {"hits": 7, "misses": 0,
+                          "hit_rate": 1.0}}) + "\n")
+    monkeypatch.setattr(fold, "LOGS", str(logs))
+    assert fold.main() == 0
+    out = capsys.readouterr().out
+    assert "8123.4 req/s" in out
+    assert "p50 2.1 ms/p99 7.9 ms" in out
+    assert "occ 0.83" in out and "x4.4 vs seq" in out
+    assert "warm=100%" in out
+
+
 def test_byte_diet_matrix_flags_validate_in_argparse():
     """An invalid --slot-dtype/--bn-stats-dtype must die in argparse,
     before any jax/tunnel work can measure the wrong thing (the same
@@ -326,3 +407,13 @@ def test_eager_overhead_emits_stats_line_and_final_json():
         f"warm start only {ws['warm_start_speedup']}x vs cold")
     assert ws["speedup_vs_trace_only"] > 1.0, (
         "warm start must beat the trace-only (compile-cached) regime")
+    # ISSUE 7 satellite: the A/B's serving arm measures time-to-first-
+    # REPLY through the ACTUAL request path (ServingEngine), and a
+    # warm worker's serving forward loads (hits=1) without tracing,
+    # reply bit-identical to the cold process's
+    assert ws["serve_export_hits"] == 1
+    assert ws["serve_export_traces"] == 0
+    assert ws["reply_match"] is True
+    assert ws["serve_cold_first_reply_s"] > 0
+    assert ws["serve_warm_first_reply_s"] > 0
+    assert "serve_warm_speedup" in ws

@@ -78,6 +78,24 @@ def test_export_cache_row_and_readme_section_present():
     assert "export_cache_gc" in readme
 
 
+def test_serving_row_and_readme_section_present():
+    """ISSUE 7 doc contract: the P17 continuous-batching serving row
+    and the README "Serving" section exist (path rot in either is
+    caught by test_all_cited_paths_exist)."""
+    cov = open(os.path.join(_ROOT, "COVERAGE.md")).read()
+    assert "| P17 |" in cov
+    assert "singa_tpu/serve.py" in cov
+    assert "tests/test_serve.py" in cov
+    assert "tools/prewarm.py" in cov
+    readme = open(os.path.join(_ROOT, "README.md")).read()
+    assert "## Serving" in readme
+    assert "ServingEngine" in readme
+    assert "set_serving" in readme
+    assert "serve_requests_per_sec" in readme
+    assert "prewarm" in readme
+    assert "BucketOverflowError" in readme
+
+
 def test_all_cited_paths_exist():
     text = open(os.path.join(_ROOT, "COVERAGE.md")).read()
     missing = []

@@ -140,6 +140,18 @@ def main():
                          f"ms/step, bs{r['batch']}, {r.get('precision')}"
                          f"{', remat' if r.get('remat') else ''}"
                          f"{diet})" + mark))
+        elif "serve_requests_per_sec" in r:
+            # serving tier (ISSUE 7): throughput + SLO percentiles +
+            # coalescing evidence, with the shared stage breakdown
+            sx = (f", x{r['speedup_vs_sequential']} vs seq"
+                  if "speedup_vs_sequential" in r else "")
+            occ = (f", occ {r['occupancy_mean']}"
+                   if "occupancy_mean" in r else "")
+            rows.append((stage,
+                         f"{r['serve_requests_per_sec']:.1f} req/s  "
+                         f"(p50 {r.get('p50_ms')} ms/p99 "
+                         f"{r.get('p99_ms')} ms{occ}{sx}"
+                         + _stage_breakdown(r) + ")" + mark))
         elif "tokens_per_sec" in r:
             diet = ("" if r.get("slot_dtype") in (None, "fp32")
                     else f", slot_dtype={r['slot_dtype']}")

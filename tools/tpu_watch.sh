@@ -14,6 +14,58 @@
 #                                      trailing lines (a run killed
 #                                      mid-write) are skipped, matching
 #                                      singa_tpu.trace.read_metrics.
+#   tools/tpu_watch.sh serve [DIR]     same tail, serving flavor: prefer
+#                                      the newest *serve*.jsonl and render
+#                                      the per-dispatch serving record
+#                                      (requests/rows/bucket, occupancy,
+#                                      pad fraction, rolling p50/p99) the
+#                                      ServingEngine's MetricsLogger
+#                                      stream carries.
+
+if [ "$1" = "serve" ]; then
+  dir=${2:-metrics}
+  # serving streams are tagged *serve*; fall back to the newest JSONL
+  f=$(ls -t "$dir"/*serve*.jsonl 2>/dev/null | head -1)
+  [ -z "$f" ] && f=$(ls -t "$dir"/*.jsonl 2>/dev/null | head -1)
+  if [ -z "$f" ]; then
+    echo "tpu_watch: no serving metrics JSONL under $dir/ yet" >&2
+    exit 1
+  fi
+  echo "tpu_watch: tailing $f" >&2
+  tail -n +1 -F "$f" | python3 -u -c '
+import json, sys
+
+def fmt(v, nd=3):
+    if v is None:
+        return "-"
+    return str(round(v, nd))
+
+for line in sys.stdin:
+    line = line.strip()
+    if not line:
+        continue
+    try:
+        r = json.loads(line)
+    except ValueError:
+        continue  # partial trailing line from a killed writer
+    if not isinstance(r, dict):
+        continue
+    x = r.get("extra") or {}
+    bits = [
+        "dispatch " + str(r.get("step", "?")).rjust(6),
+        "req " + fmt(x.get("requests"), 0),
+        "rows " + str(x.get("rows", "-")) + "/" + str(x.get("bucket", "-")),
+        "occ " + fmt(x.get("occupancy"), 2),
+        "pad " + fmt(x.get("pad_fraction"), 2),
+        "q " + fmt(x.get("queue_depth"), 0),
+        "req/s " + fmt(r.get("examples_per_sec"), 1),
+        "p50 " + fmt(x.get("p50_ms"), 2) + "ms",
+        "p99 " + fmt(x.get("p99_ms"), 2) + "ms",
+    ]
+    print("  ".join(bits))
+'
+  exit $?
+fi
 
 if [ "$1" = "metrics" ]; then
   dir=${2:-metrics}
