@@ -707,7 +707,7 @@ def stage_decode(batch, prompt, new, deadline_s):
 
 
 def stage_serve(requests, deadline_s, rate=0.0, max_batch=64,
-                max_wait_ms=1.0):
+                max_wait_ms=1.0, chaos=False):
     """Continuous-batching serving throughput (ISSUE 7): drive
     `singa_tpu.serve.ServingEngine` with a seeded Poisson OPEN-LOOP
     load generator and report `serve_requests_per_sec` + p50/p99
@@ -727,6 +727,14 @@ def stage_serve(requests, deadline_s, rate=0.0, max_batch=64,
     sequential capacity, so the serve run is measured under
     saturation (the regime continuous batching exists for) without
     hand-tuning per machine.
+
+    `chaos=True` (ISSUE 8) adds a second engine pass over the SAME
+    arrival schedule with a seed-keyed `FaultInjector` raising
+    transient dispatch failures/hangs, poison requests, and device
+    loss at the resilience layer — reporting availability % (delivered
+    / submitted), p99 under faults, and the retry/bisect/shed counter
+    deltas in a `chaos` sub-dict next to the clean numbers
+    (`tools/fold_onchip.py` renders it on the serve row).
     """
     import numpy as np
 
@@ -879,6 +887,93 @@ def stage_serve(requests, deadline_s, rate=0.0, max_batch=64,
 
     lat = np.asarray([r.latency_s for r in replies]) * 1e3
     traces = es1["traces"] - es0["traces"]
+
+    # -- injected-fault arm (--chaos): same schedule, same model -------
+    chaos_out = None
+    if chaos:
+        from singa_tpu import resilience
+
+        t_chaos0 = time.time()
+        sc0 = stats.cache_stats()["serve"]
+        inj = resilience.FaultInjector(seed=2, schedule={
+            "dispatch_fail": 0.05,
+            "dispatch_hang": 0.03,
+            "poison_request": 0.02,
+            "device_lost_serve": 0.02,
+        }, hang_s=0.002)
+        ceng = serve.ServingEngine(
+            m, max_batch=max_batch, max_wait_ms=max_wait_ms,
+            max_retries=1, backoff_ms=0.2, max_restarts=100,
+            fault_injector=inj).start()
+        ceng.warmup(reqs[0])
+        futures = [None] * requests
+        refused = 0
+        t0 = time.perf_counter()
+        for i, x in enumerate(reqs):
+            now = time.perf_counter() - t0
+            if now < arrivals[i]:
+                time.sleep(arrivals[i] - now)
+            try:
+                futures[i] = ceng.submit(x)
+            except (serve.ServeOverloadError,
+                    serve.ServeQueueFullError):
+                refused += 1
+        delivered, failed_n, chaos_match = 0, 0, True
+        lat_c = []
+        for i, r in enumerate(futures):
+            if r is None:
+                continue
+            try:
+                got = r.result(timeout=max(hard_stop - time.time(), 5))
+            except TimeoutError:
+                ceng.stop(drain=False)
+                mlog.close()
+                print(json.dumps({"ok": False,
+                                  "error": "deadline inside chaos arm"}),
+                      flush=True)
+                return
+            except (serve.ServeDispatchError, serve.ServeDeadlineError,
+                    serve.ServeClosedError):
+                failed_n += 1
+                continue
+            # bit-identity survives retries, bisection, and restarts
+            chaos_match = chaos_match and np.array_equal(
+                got, base_out[i])
+            lat_c.append(r.latency_s)
+            delivered += 1
+        ceng.stop()
+        sc1 = stats.cache_stats()["serve"]
+        dd = {k: sc1[k] - sc0[k] for k in
+              ("requests", "replies", "expired", "shed", "dropped",
+               "overflowed", "failed", "retries", "dispatch_failures",
+               "poisoned", "restarts")}
+        lat_c = np.asarray(lat_c) * 1e3
+        chaos_out = {
+            "availability_pct": round(100.0 * delivered / requests, 2),
+            "delivered": delivered,
+            "failed": failed_n,
+            "refused": refused,
+            "p50_ms": (round(float(np.percentile(lat_c, 50)), 3)
+                       if delivered else None),
+            "p99_ms": (round(float(np.percentile(lat_c, 99)), 3)
+                       if delivered else None),
+            "replies_match": bool(chaos_match),
+            "retries": dd["retries"],
+            "dispatch_failures": dd["dispatch_failures"],
+            "poisoned": dd["poisoned"],
+            "restarts": dd["restarts"],
+            "counters_reconcile": bool(
+                dd["requests"] == dd["replies"] + dd["expired"]
+                + dd["shed"] + dd["dropped"] + dd["overflowed"]
+                + dd["failed"]),
+            "seconds": round(time.time() - t_chaos0, 2),
+        }
+        log(f"chaos arm: availability "
+            f"{chaos_out['availability_pct']}% "
+            f"p99 {chaos_out['p99_ms']} ms "
+            f"({dd['dispatch_failures']} dispatch failures, "
+            f"{dd['retries']} retries, {dd['poisoned']} poisoned)")
+
     stage_secs, export_info = _stage_obs(setup_s, compile_s, 0.0,
                                          steady_s)
     out = {
@@ -912,6 +1007,8 @@ def stage_serve(requests, deadline_s, rate=0.0, max_batch=64,
         "export_cache": export_info,
         "metrics_jsonl": os.path.relpath(mpath, HERE),
     }
+    if chaos_out is not None:
+        out["chaos"] = chaos_out
     log(f"RESULT {out}")
     print(json.dumps(out), flush=True)
 
@@ -994,6 +1091,11 @@ def main():
     p.add_argument("--serve-max-batch", type=int, default=64,
                    help="serve stage: rows per fused dispatch "
                    "(pow2; also the bucket ceiling)")
+    p.add_argument("--chaos", action="store_true",
+                   help="serve stage: add an injected-fault arm "
+                   "(seed-keyed dispatch_fail/hang/poison/device-"
+                   "lost) reporting availability %% and p99 under "
+                   "faults next to the clean row")
     p.add_argument("--smoke", action="store_true",
                    help="<=2min chip smoke test only")
     a = p.parse_args()
@@ -1016,7 +1118,7 @@ def main():
     if a.stage == "serve":
         return stage_serve(a.requests, a.deadline, rate=a.rate,
                            max_batch=a.serve_max_batch,
-                           max_wait_ms=a.max_wait_ms)
+                           max_wait_ms=a.max_wait_ms, chaos=a.chaos)
     if a.stage == "pallas":
         return stage_pallas()
     if a.stage == "decode":

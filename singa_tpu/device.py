@@ -73,8 +73,9 @@ __all__ = [
     "set_export_cache",
     "set_shape_buckets",
     # Continuous-batching serving tier (ISSUE 7; singa_tpu.serve owns
-    # the state).
+    # the state) + its resilience layer (ISSUE 8).
     "set_serving",
+    "set_serving_resilience",
     # Migration aliases (reference names):
     "create_cuda_gpu",
     "create_cuda_gpu_on",
@@ -603,6 +604,48 @@ def set_serving(max_batch=None, max_wait_ms=None,
         kw["max_queue"] = max_queue
     if kw:
         serve.configure(**kw)
+
+
+def set_serving_resilience(**kw) -> None:
+    """Process defaults for the serving-tier resilience layer
+    (`singa_tpu.serve.ServingEngine`; ISSUE 8). Only the keys given
+    change; engines constructed afterwards read them (constructor
+    args override per-engine). Keys:
+
+      deadline_ms       default per-request deadline: still queued
+                        past it ⇒ the future fails with
+                        `ServeDeadlineError` BEFORE batch assembly
+                        (counted `expired`); expired mid-dispatch ⇒
+                        delivered but counted `late` with
+                        `reply.deadline_exceeded=True`. None = off.
+      max_retries       failed fused dispatches retry the whole group
+                        this many times with exponential backoff
+                        before bisecting to isolate poison requests.
+      backoff_ms        base retry backoff (doubles per attempt).
+      backoff_jitter    ± fraction of deterministic seed-keyed jitter.
+      shed_watermark    queue depth at/above which NEW requests shed
+                        with `ServeOverloadError` (carries
+                        `retry_after_ms`). None = hard drop only.
+      adaptive_wait     shrink the coalesce window toward 0 under
+                        sustained queue depth (latency degrades
+                        before availability).
+      max_restarts      supervised dispatcher restarts before the
+                        engine gives up and fails the queue.
+      drain_timeout_s   `stop(drain=True)` bound: past it, remaining
+                        futures fail with `ServeClosedError` instead
+                        of the stop hanging on a dead dispatch.
+      unhealthy_failures  consecutive dispatch-failure streak at
+                        which `health()` turns unhealthy.
+      health_file       JSON health-snapshot path probed by
+                        `tools/serve_health.py` (exit code 0/1/2 =
+                        ready/degraded/unhealthy). None = off.
+
+    Counters: `cache_stats()["serve"]` (expired/late/shed/failed/
+    poisoned/retries/dispatch_failures/restarts)."""
+    from . import serve
+
+    if kw:
+        serve.configure_resilience(**kw)
 
 
 def set_dag_auto_flops_per_op(v: float) -> None:

@@ -68,6 +68,7 @@ __all__ = [
     "import_host_state",
     "DeviceLostError",
     "FaultInjector",
+    "backoff_delay_s",
     "run_resumable",
 ]
 
@@ -385,10 +386,22 @@ class FaultInjector:
 
     Kinds used by the in-tree tests: "nan_batch", "nan_grad",
     "opt_state", "ckpt_truncate", "device_loss".
+
+    Serving kinds (ISSUE 8; consumed by `serve.ServingEngine`'s
+    test-only `_chaos_attempt` / dispatcher-loop hooks, keyed by the
+    global dispatch-attempt / coalesce-cycle index so retries redraw):
+    "dispatch_fail" (transient dispatch error), "dispatch_hang"
+    (dispatch sleeps `hang_s` before proceeding), "poison_request"
+    (keyed by submit ordinal: the marked request fails EVERY dispatch
+    it rides in — the bisection target), "device_lost_serve"
+    (`DeviceLostError` from the dispatch), "dispatcher_kill" (the
+    dispatcher loop itself dies — the supervision target).
     """
 
-    def __init__(self, seed: int = 0, schedule: Optional[Dict] = None):
+    def __init__(self, seed: int = 0, schedule: Optional[Dict] = None,
+                 hang_s: float = 0.05):
         self.seed = int(seed)
+        self.hang_s = float(hang_s)
         self.schedule: Dict = {}
         for kind, spec in (schedule or {}).items():
             if isinstance(spec, (int, float)) and not isinstance(
@@ -477,6 +490,21 @@ class FaultInjector:
         if self.should("device_loss", step):
             raise DeviceLostError(
                 f"injected device loss at step {step}")
+
+
+def backoff_delay_s(attempt: int, base_s: float, jitter: float = 0.5,
+                    seed: int = 0, salt: str = "retry") -> float:
+    """Exponential-backoff delay for retry `attempt` (1-based):
+    `base_s * 2**(attempt-1)`, scaled by a DETERMINISTIC seed-keyed
+    jitter in [1-jitter, 1+jitter] (the FaultInjector sha256 idiom) —
+    retries decorrelate across workers without making any test run
+    nondeterministic."""
+    if base_s <= 0:
+        return 0.0
+    h = hashlib.sha256(f"{seed}/{salt}/{attempt}".encode()).digest()
+    u = int.from_bytes(h[:8], "big") / float(2 ** 64)
+    return base_s * (2.0 ** (max(int(attempt), 1) - 1)) * (
+        1.0 + float(jitter) * (2.0 * u - 1.0))
 
 
 # ---------------------------------------------------------------------------

@@ -1,5 +1,7 @@
 """Continuous-batching inference serving tier (ISSUE 7; ROADMAP
-item 1 — the "millions of users" leg).
+item 1 — the "millions of users" leg) + the serving resilience layer
+(ISSUE 8: deadlines, retry/backoff with poison isolation, load
+shedding, dispatcher supervision, health).
 
 Production traffic is mostly forward passes, and the per-dispatch cost
 on an accelerator is dominated by fixed overhead (host dispatch, the
@@ -38,20 +40,67 @@ many small concurrent requests into a few large fused dispatches.
       (pad rows dropped first) and delivered through the futures as
       host numpy arrays.
 
+Resilience (ISSUE 8) — the serving analogue of PR 3's training-side
+StepGuard discipline: every failure mode has a bounded, counted,
+LOUD recovery path, proven by seed-keyed fault injection:
+
+  deadlines        — `submit(*arrays, deadline_ms=...)` (or the
+      `deadline_ms` default knob): a request whose deadline passes
+      while still QUEUED is expired before batch assembly — its
+      future fails with `ServeDeadlineError`, counted `expired`, and
+      the dispatch is never padded with rows nobody is waiting for.
+      A request that expires after assembly (mid-dispatch) still
+      completes, counted `late`, its reply marked
+      `deadline_exceeded=True`.
+  retry + poison isolation — a failed fused dispatch retries the
+      whole group up to `max_retries` times with exponential backoff
+      + seed-keyed jitter (`resilience.backoff_delay_s`); when the
+      retries are exhausted the group is BISECTED to isolate poison
+      requests — only the requests that fail alone fail their
+      futures (counted `poisoned`), the rest re-dispatch and
+      succeed. One bad input cannot fail a coalesced batch of 64.
+  load shedding    — beyond the hard `max_queue` drop: a
+      `shed_watermark` sheds NEWEST requests with a structured
+      `ServeOverloadError` carrying `retry_after_ms` (estimated from
+      the rolling dispatch time × queue depth), and `adaptive_wait`
+      shrinks the coalesce window toward 0 under sustained depth —
+      latency degrades before availability does.
+  supervision      — the dispatcher thread runs under a supervisor:
+      an unexpected death fails the in-flight futures loudly and
+      restarts the loop (bounded by `max_restarts`, counted
+      `restarts`); `engine.health()` reports
+      `ready`/`degraded`/`unhealthy` with reasons, `health_file`
+      snapshots it to disk for fleet probes
+      (`tools/serve_health.py` maps state → exit code).
+  chaos harness    — `ServingEngine(..., fault_injector=...)` wires a
+      seed-keyed `resilience.FaultInjector` through a test-only hook
+      in the dispatch path (`dispatch_fail`, `dispatch_hang`,
+      `poison_request`, `device_lost_serve`, `dispatcher_kill`); the
+      chaos soak in `tests/test_serve_resilience.py` proves no reply
+      is ever silently lost and the counters reconcile exactly
+      (requests == replies + expired + shed + dropped + overflowed
+      + failed).
+
 Observability: per-request spans thread the PR 5 tracer (`queue_wait`
 via `trace.record_span` — it crosses threads — plus per-dispatch
-`batch_assemble` / `dispatch` / `reply` spans), a `MetricsLogger`
-JSONL stream records one record per dispatch (batch occupancy, pad
-fraction, rolling p50/p95/p99 request latency), and
+`batch_assemble` / `dispatch` / `reply` and per-retry
+`dispatch_retry` spans), a `MetricsLogger` JSONL stream records one
+record per dispatch (batch occupancy, pad fraction, rolling
+p50/p95/p99, cumulative expired/shed/retries/failed), and
 `cache_stats()["serve"]` exposes queue depth, coalesce sizes, the
-bucket hit histogram, and dropped/overflowed request counters.
+bucket hit histogram, and every resilience counter.
 
 Knobs: `device.set_serving(max_batch=..., max_wait_ms=...,
-max_queue=...)` sets the process defaults; `ServingEngine(...)`
+max_queue=...)` and `device.set_serving_resilience(deadline_ms=...,
+max_retries=..., backoff_ms=..., shed_watermark=...,
+adaptive_wait=..., max_restarts=..., drain_timeout_s=...,
+health_file=...)` set the process defaults; `ServingEngine(...)`
 overrides per-engine. Bench: `bench.py --stage serve` drives the
 engine with a seeded Poisson open-loop load generator and reports
 `serve_requests_per_sec` + p50/p99 — CPU-runnable, so CI measures the
-continuous-batching speedup and the chip only confirms it.
+continuous-batching speedup and the chip only confirms it;
+`--chaos` adds an injected-fault arm reporting availability % and
+p99-under-faults.
 """
 from __future__ import annotations
 
@@ -69,8 +118,13 @@ __all__ = [
     "ServeReply",
     "ServeQueueFullError",
     "ServeClosedError",
+    "ServeDeadlineError",
+    "ServeOverloadError",
+    "ServeDispatchError",
     "configure",
     "get_config",
+    "configure_resilience",
+    "get_resilience_config",
     "prewarm_forward",
 ]
 
@@ -87,6 +141,34 @@ class ServeClosedError(RuntimeError):
     """The engine is stopped (or stopping): no new requests are
     admitted, and requests still queued at stop() are failed with
     this."""
+
+
+class ServeDeadlineError(RuntimeError):
+    """The request's deadline passed while it was still queued: it was
+    expired BEFORE batch assembly (counted `expired`) — nobody was
+    going to read the reply, so no dispatch capacity is spent
+    producing it. A request that expires after assembly still
+    completes (counted `late`, reply marked `deadline_exceeded`)."""
+
+
+class ServeOverloadError(RuntimeError):
+    """The engine is shedding load: queue depth reached the
+    `shed_watermark` and the NEWEST request is refused (counted
+    `shed`) so already-accepted requests keep their latency. Carries
+    `retry_after_ms` — the rolling-dispatch-time × queue-depth
+    estimate of when capacity frees up — so callers can back off
+    intelligently instead of hammering."""
+
+    def __init__(self, msg: str, retry_after_ms: float):
+        super().__init__(msg)
+        self.retry_after_ms = float(retry_after_ms)
+
+
+class ServeDispatchError(RuntimeError):
+    """A fused dispatch failed after exhausting `max_retries` retries
+    (and, for the isolated requests of a bisected group, failed alone
+    too). Wraps the final underlying error; the per-request future
+    re-raises this."""
 
 
 # ---------------------------------------------------------------------------
@@ -129,6 +211,95 @@ def get_config() -> Dict:
 
 
 # ---------------------------------------------------------------------------
+# Resilience knobs (ISSUE 8; user-facing setter:
+# device.set_serving_resilience). Engines snapshot these at
+# construction — same read-at-build contract as every other knob.
+# ---------------------------------------------------------------------------
+_RES_CONFIG: Dict = {
+    # Default per-request deadline (ms) applied when submit() passes
+    # none. None = requests never expire.
+    "deadline_ms": None,
+    # Dispatch retries after the first attempt (exponential backoff +
+    # seed-keyed jitter between attempts). 0 = fail fast to bisection.
+    "max_retries": 2,
+    # Base backoff before the first retry; doubles per attempt.
+    "backoff_ms": 5.0,
+    # +/- fraction of deterministic jitter on each backoff delay.
+    "backoff_jitter": 0.5,
+    # Queue depth at/above which NEW requests shed with
+    # ServeOverloadError (None = only the hard max_queue drop).
+    "shed_watermark": None,
+    # Shrink the coalesce wait toward 0 under sustained queue depth
+    # (latency degrades before availability).
+    "adaptive_wait": False,
+    # Supervised dispatcher restarts before the engine gives up and
+    # fails the remaining queue.
+    "max_restarts": 3,
+    # stop(drain=True) bound: a dispatch hung longer than this stops
+    # blocking stop(); remaining futures fail with ServeClosedError.
+    "drain_timeout_s": 30.0,
+    # Consecutive whole-group dispatch failures before health() turns
+    # degraded -> unhealthy.
+    "unhealthy_failures": 5,
+    # Path for the JSON health snapshot tools/serve_health.py probes
+    # (written atomically on every state transition). None = off.
+    "health_file": None,
+}
+
+
+def configure_resilience(**kw) -> Dict:
+    """Update serving-resilience defaults. User-facing setter:
+    `device.set_serving_resilience`."""
+    for k, v in kw.items():
+        if k not in _RES_CONFIG:
+            raise KeyError(
+                f"unknown serving resilience key {k!r}; known: "
+                f"{sorted(_RES_CONFIG)}")
+        if k in ("deadline_ms", "shed_watermark", "drain_timeout_s",
+                 "health_file") and v is None:
+            pass
+        elif k == "deadline_ms":
+            v = float(v)
+            if v <= 0:
+                raise ValueError("deadline_ms must be > 0 (or None)")
+        elif k in ("backoff_ms",):
+            v = float(v)
+            if v < 0:
+                raise ValueError(f"{k} must be >= 0")
+        elif k == "backoff_jitter":
+            v = float(v)
+            if not 0.0 <= v <= 1.0:
+                raise ValueError("backoff_jitter must be in [0, 1]")
+        elif k == "drain_timeout_s":
+            v = float(v)
+            if v <= 0:
+                raise ValueError("drain_timeout_s must be > 0 (or None"
+                                 " to wait forever)")
+        elif k == "shed_watermark":
+            v = int(v)
+            if v < 1:
+                raise ValueError("shed_watermark must be >= 1")
+        elif k == "adaptive_wait":
+            v = bool(v)
+        elif k == "health_file":
+            v = str(v)
+        elif k == "unhealthy_failures":
+            v = int(v)
+            if v < 1:
+                raise ValueError("unhealthy_failures must be >= 1")
+        else:  # max_retries, max_restarts
+            v = int(v)
+            if v < 0:
+                raise ValueError(f"{k} must be >= 0")
+        _RES_CONFIG[k] = v
+    return dict(_RES_CONFIG)
+
+
+def get_resilience_config() -> Dict:
+    return dict(_RES_CONFIG)
+
+
+# ---------------------------------------------------------------------------
 # Observability: cache_stats()["serve"]
 # ---------------------------------------------------------------------------
 class _ServeStats:
@@ -136,7 +307,18 @@ class _ServeStats:
     requests waiting right now); `buckets` is the bucket-size hit
     histogram — together with `coalesce_mean` it says whether traffic
     actually fuses (occupancy near 1 at big buckets) or the wait
-    window is too short (many size-1 dispatches)."""
+    window is too short (many size-1 dispatches).
+
+    Resilience accounting (ISSUE 8): every submitted request ends in
+    exactly one terminal bucket — `replies` (delivered, incl. `late`),
+    `expired` (deadline passed while queued), `shed` (overload
+    watermark), `dropped` (hard queue-full), `overflowed` (above the
+    bucket ladder), or `failed` (future failed: dispatch error after
+    retries, poison, engine closed) — so
+    requests == replies + expired + shed + dropped + overflowed +
+    failed holds exactly at quiescence. `errors` stays the legacy
+    every-failed-future count (expired + failed + bookkeeping
+    errors)."""
 
     def __init__(self):
         self.reset()
@@ -152,11 +334,22 @@ class _ServeStats:
         self.coalesced_rows = 0
         self.pad_rows = 0
         self.max_coalesce = 0
-        # queue_depth is LIVE state (requests waiting right now), not
-        # a counter — reset keeps it and restarts its high-water mark
-        # (the resilience-scaler reset convention).
+        # resilience counters (ISSUE 8)
+        self.expired = 0
+        self.late = 0
+        self.shed = 0
+        self.failed = 0
+        self.poisoned = 0
+        self.retries = 0
+        self.dispatch_failures = 0
+        self.restarts = 0
+        # queue_depth / effective_wait_ms are LIVE state, not
+        # counters — reset keeps them and restarts the high-water
+        # mark (the resilience-scaler reset convention).
         self.queue_depth = getattr(self, "queue_depth", 0)
         self.max_queue_depth = self.queue_depth
+        self.effective_wait_ms = getattr(self, "effective_wait_ms",
+                                         None)
         self._buckets: Dict[int, int] = {}
 
     def note_dispatch(self, n_requests: int, n_rows: int,
@@ -177,6 +370,14 @@ class _ServeStats:
             "errors": self.errors,
             "dropped": self.dropped,
             "overflowed": self.overflowed,
+            "expired": self.expired,
+            "late": self.late,
+            "shed": self.shed,
+            "failed": self.failed,
+            "poisoned": self.poisoned,
+            "retries": self.retries,
+            "dispatch_failures": self.dispatch_failures,
+            "restarts": self.restarts,
             "dispatches": self.dispatches,
             "coalesce_mean": round(self.coalesced_requests / d, 3),
             "max_coalesce": self.max_coalesce,
@@ -187,6 +388,7 @@ class _ServeStats:
                 / max(self.coalesced_rows + self.pad_rows, 1), 4),
             "queue_depth": self.queue_depth,
             "max_queue_depth": self.max_queue_depth,
+            "effective_wait_ms": self.effective_wait_ms,
             "buckets": {str(k): v
                         for k, v in sorted(self._buckets.items())},
         }
@@ -208,15 +410,27 @@ class ServeReply:
     the reply (host numpy array, or pytree of them, with the request's
     REAL row count) and re-raises the per-request error if the
     dispatch failed — a `BucketOverflowError` request fails ITS future
-    loudly without poisoning the batch it would have ridden in."""
+    loudly without poisoning the batch it would have ridden in.
 
-    __slots__ = ("_ev", "_value", "_error", "n", "t_submit", "t_reply")
+    `state` tracks the request through the engine —
+    `queued` (admitted, waiting; also after a requeue-at-front) →
+    `dispatching` (joined a dispatch group; retries/bisection keep it
+    here) → `done` / `failed` — so a `result(timeout=...)` that times
+    out can tell "still queued" from "dispatch in flight".
+    `deadline_exceeded` is True on a delivered reply whose deadline
+    passed mid-dispatch (counted `late`)."""
+
+    __slots__ = ("_ev", "_wlock", "_value", "_error", "n", "t_submit",
+                 "t_reply", "state", "deadline_exceeded")
 
     def __init__(self, n: int):
         self._ev = threading.Event()
+        self._wlock = threading.Lock()  # serializes the first write
         self._value = None
         self._error: Optional[BaseException] = None
         self.n = n
+        self.state = "queued"
+        self.deadline_exceeded = False
         self.t_submit = time.perf_counter()
         self.t_reply: Optional[float] = None
 
@@ -225,7 +439,8 @@ class ServeReply:
 
     def result(self, timeout: Optional[float] = None):
         if not self._ev.wait(timeout):
-            raise TimeoutError("serve reply not ready")
+            raise TimeoutError(
+                f"serve reply not ready (state: {self.state})")
         if self._error is not None:
             raise self._error
         return self._value
@@ -236,25 +451,47 @@ class ServeReply:
                 else self.t_reply - self.t_submit)
 
     # -- engine side -----------------------------------------------------
-    def _deliver(self, value) -> None:
-        self.t_reply = time.perf_counter()
-        self._value = value
-        self._ev.set()
+    def _deliver(self, value) -> bool:
+        """First write wins (a hung dispatch completing after stop()
+        already failed the future must not flip it). Returns whether
+        THIS write won — callers count toward the reconciliation
+        invariant only on a win, so a dropped late delivery can't be
+        double-counted against the `failed` the stop() path already
+        recorded."""
+        with self._wlock:  # atomic test-and-set: a delivery and a
+            # failure racing (stop()'s drain timeout vs a hung
+            # dispatch completing) must produce exactly ONE winner
+            if self._ev.is_set():
+                return False
+            self.t_reply = time.perf_counter()
+            self._value = value
+            self.state = "done"
+            self._ev.set()
+            return True
 
-    def _fail(self, err: BaseException) -> None:
-        self.t_reply = time.perf_counter()
-        self._error = err
-        self._ev.set()
+    def _fail(self, err: BaseException) -> bool:
+        with self._wlock:
+            if self._ev.is_set():
+                return False  # first write wins
+            self.t_reply = time.perf_counter()
+            self._error = err
+            self.state = "failed"
+            self._ev.set()
+            return True
 
 
 class _Request:
-    __slots__ = ("arrays", "n", "sig", "reply", "t_enqueue")
+    __slots__ = ("arrays", "n", "sig", "reply", "t_enqueue",
+                 "deadline", "poison")
 
-    def __init__(self, arrays: List[np.ndarray], n: int, sig, reply):
+    def __init__(self, arrays: List[np.ndarray], n: int, sig, reply,
+                 deadline: Optional[float] = None):
         self.arrays = arrays
         self.n = n
         self.sig = sig
         self.reply = reply
+        self.deadline = deadline  # absolute perf_counter time, or None
+        self.poison = False  # set by the chaos harness only
         self.t_enqueue = time.perf_counter()
 
 
@@ -281,7 +518,14 @@ class ServingEngine:
 
     All dispatching happens on ONE daemon thread: jax dispatch and the
     device RNG key stay single-writer, and `submit()` is safe from any
-    number of caller threads.
+    number of caller threads. The thread runs under a supervisor
+    (`_supervised_loop`): if the loop dies unexpectedly, in-flight
+    futures fail loudly and the loop restarts (bounded by
+    `max_restarts`).
+
+    `fault_injector` (test-only) wires a `resilience.FaultInjector`
+    through the dispatch path — see the module docstring's chaos
+    harness notes.
     """
 
     def __init__(self, model, max_batch: Optional[int] = None,
@@ -290,8 +534,20 @@ class ServingEngine:
                  bucket_policy: Optional["export_cache.BucketPolicy"]
                  = None,
                  metrics: Optional["trace_mod.MetricsLogger"] = None,
-                 latency_window: int = 2048):
+                 latency_window: int = 2048,
+                 deadline_ms: Optional[float] = None,
+                 max_retries: Optional[int] = None,
+                 backoff_ms: Optional[float] = None,
+                 backoff_jitter: Optional[float] = None,
+                 shed_watermark: Optional[int] = None,
+                 adaptive_wait: Optional[bool] = None,
+                 max_restarts: Optional[int] = None,
+                 drain_timeout_s: Optional[float] = None,
+                 unhealthy_failures: Optional[int] = None,
+                 health_file: Optional[str] = None,
+                 fault_injector=None):
         cfg = get_config()
+        res = get_resilience_config()
         self.model = model
         self.max_batch = int(max_batch if max_batch is not None
                              else cfg["max_batch"])
@@ -301,6 +557,62 @@ class ServingEngine:
                              else cfg["max_queue"])
         if self.max_batch < 1 or self.max_queue < 1:
             raise ValueError("max_batch and max_queue must be >= 1")
+        # Resilience knobs (per-engine overrides win over the process
+        # defaults; None per-engine means "use the default").
+        self.deadline_ms = (deadline_ms if deadline_ms is not None
+                            else res["deadline_ms"])
+        self.max_retries = int(max_retries if max_retries is not None
+                               else res["max_retries"])
+        self.backoff_s = float(backoff_ms if backoff_ms is not None
+                               else res["backoff_ms"]) / 1e3
+        self.backoff_jitter = float(backoff_jitter
+                                    if backoff_jitter is not None
+                                    else res["backoff_jitter"])
+        if not 0.0 <= self.backoff_jitter <= 1.0:
+            raise ValueError("backoff_jitter must be in [0, 1]")
+        self.shed_watermark = (shed_watermark
+                               if shed_watermark is not None
+                               else res["shed_watermark"])
+        if self.shed_watermark is not None and int(
+                self.shed_watermark) < 1:
+            raise ValueError(
+                "shed_watermark must be >= 1 (use None to disable "
+                "shedding) — 0 would shed every request on an empty "
+                "queue")
+        if (self.shed_watermark is not None
+                and int(self.shed_watermark) > self.max_queue):
+            raise ValueError(
+                f"shed_watermark {self.shed_watermark} above max_queue "
+                f"{self.max_queue}: the hard drop would always fire "
+                "first and the structured overload path never would")
+        self.adaptive_wait = bool(adaptive_wait
+                                  if adaptive_wait is not None
+                                  else res["adaptive_wait"])
+        self.max_restarts = int(max_restarts
+                                if max_restarts is not None
+                                else res["max_restarts"])
+        self.drain_timeout_s = (drain_timeout_s
+                                if drain_timeout_s is not None
+                                else res["drain_timeout_s"])
+        self.unhealthy_failures = int(
+            unhealthy_failures if unhealthy_failures is not None
+            else res["unhealthy_failures"])
+        if self.unhealthy_failures < 1:
+            raise ValueError("unhealthy_failures must be >= 1")
+        self.health_file = (health_file if health_file is not None
+                            else res["health_file"])
+        self.fault_injector = fault_injector
+        # Backoff jitter seed: the injector's seed under test (the
+        # chaos runs stay reproducible), else a per-process/per-engine
+        # value — a constant here would make every worker in a fleet
+        # sleep the same delays and retry in lockstep, which is the
+        # thundering herd the jitter exists to break.
+        if fault_injector is not None:
+            self._jitter_seed = int(getattr(fault_injector, "seed", 0))
+        else:
+            import os
+            self._jitter_seed = (os.getpid() << 20) ^ (id(self)
+                                                       & 0xFFFFF)
         # Bucket ladder: an explicit policy wins, else the process
         # policy (device.set_shape_buckets), else a private pow2
         # ladder capped at max_batch — the engine ALWAYS dispatches
@@ -336,6 +648,26 @@ class ServingEngine:
         self._thread: Optional[threading.Thread] = None
         self._running = False
         self._dispatch_idx = 0
+        self._submit_idx = 0  # per-engine submit ordinal (poison key)
+        self._attempt_idx = 0  # per dispatch ATTEMPT (retries advance)
+        self._cycle_idx = 0  # per coalesce cycle (dispatcher_kill key)
+        self._inflight: List[_Request] = []
+        self._restarts = 0
+        self._consec_failures = 0
+        self._depth_ema = 0.0
+        self._ema_dispatch_s = 0.0
+        self._hung_at_stop = False
+        self._health_state: Optional[str] = None
+        # Serializes transition detection + the snapshot-file write:
+        # a monitoring thread polling health() races the dispatcher's
+        # _update_health()/_note_health — without it both see the
+        # same change (duplicate transitions) and truncate each
+        # other's tmp file mid-write.
+        self._health_lock = threading.Lock()
+        # (state, reason) tuples, appended whenever the computed
+        # health state changes — the unhealthy -> ready transition the
+        # acceptance test asserts reads from here.
+        self.health_transitions: List = []
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> "ServingEngine":
@@ -345,17 +677,24 @@ class ServingEngine:
         # must have been compile()d (lazy params initialized) first.
         self.model.eval()
         self._running = True
-        self._thread = threading.Thread(target=self._loop,
+        self._restarts = 0
+        self._hung_at_stop = False
+        self._thread = threading.Thread(target=self._supervised_loop,
                                         name="singa_tpu-serve",
                                         daemon=True)
         self._thread.start()
+        self._update_health()
         return self
 
     def stop(self, drain: bool = True,
-             timeout: Optional[float] = 30.0) -> None:
+             drain_timeout_s: Optional[float] = None) -> None:
         """Stop the dispatcher. `drain=True` (default) serves what is
-        already queued first; `drain=False` fails queued requests with
-        `ServeClosedError` (counted as errors)."""
+        already queued first, but only up to `drain_timeout_s`
+        (default: the engine/`set_serving_resilience` knob) — a hung
+        dispatch must not block stop() forever; past the timeout the
+        remaining futures (queued AND in-flight) fail with
+        `ServeClosedError` and the hung daemon thread is abandoned.
+        `drain=False` fails queued requests immediately."""
         if not self._running:
             return
         if not drain:
@@ -364,14 +703,28 @@ class ServingEngine:
                 self._queue.clear()
                 _STATS.queue_depth = 0
             for req in victims:
-                _STATS.errors += 1
-                req.reply._fail(ServeClosedError("engine stopped"))
+                self._fail_request(req, ServeClosedError(
+                    "engine stopped"))
         with self._lock:  # atomic vs submit()'s admission check
             self._running = False
         self._have_work.set()  # wake the dispatcher to exit
-        if self._thread is not None:
-            self._thread.join(timeout)
-            self._thread = None
+        t, self._thread = self._thread, None
+        if t is not None:
+            timeout = (drain_timeout_s if drain_timeout_s is not None
+                       else self.drain_timeout_s)
+            t.join(timeout)
+            if t.is_alive():
+                # Hung mid-dispatch: abandon the daemon thread and
+                # fail its in-flight futures loudly — a caller blocked
+                # on result() must not outwait a dead device. The
+                # thread may eventually finish its dispatch; the
+                # replies land on already-failed futures and are
+                # dropped (first write wins).
+                self._hung_at_stop = True
+                for req in self._take_inflight():
+                    self._fail_request(req, ServeClosedError(
+                        f"engine stopped: dispatch still hung after "
+                        f"the {timeout}s drain timeout"))
         # Fail any straggler that slipped in while the dispatcher was
         # exiting — a queued request with no thread to serve it would
         # otherwise hang its caller until their own timeout.
@@ -380,8 +733,8 @@ class ServingEngine:
             self._queue.clear()
             _STATS.queue_depth = 0
         for req in victims:
-            _STATS.errors += 1
-            req.reply._fail(ServeClosedError("engine stopped"))
+            self._fail_request(req, ServeClosedError("engine stopped"))
+        self._update_health()
 
     def warmup(self, *arrays) -> int:
         """Execute the forward once per dispatchable bucket, padding
@@ -437,10 +790,24 @@ class ServingEngine:
             out.append(a)
         return out
 
-    def submit(self, *arrays) -> ServeReply:
+    def _estimate_retry_after_ms(self, depth: int) -> float:
+        """Overload back-off hint: rolling dispatch seconds × the
+        dispatch cycles needed to drain `depth` queued requests. The
+        EMA starts at 0 (no dispatch yet) — fall back to the coalesce
+        window, the floor any request pays."""
+        per_dispatch = self._ema_dispatch_s or self.max_wait_s or 1e-3
+        cycles = max(1, -(-depth // max(self.max_batch, 1)))  # ceil
+        return max(1.0, round(per_dispatch * cycles * 1e3, 3))
+
+    def submit(self, *arrays, deadline_ms: Optional[float] = None
+               ) -> ServeReply:
         """Enqueue one request (numpy arrays or Tensors; every array
         batched along dim 0 with a shared row count) and return its
-        `ServeReply` future. Raises `ServeQueueFullError` /
+        `ServeReply` future. `deadline_ms` (default: the engine's
+        `deadline_ms` knob) bounds how long the caller will wait:
+        still queued past it ⇒ the future fails with
+        `ServeDeadlineError` before any dispatch capacity is spent.
+        Raises `ServeQueueFullError` / `ServeOverloadError` /
         `ServeClosedError` / `BucketOverflowError` at admission —
         requests the engine could never serve are refused while the
         caller can still act, not parked."""
@@ -455,6 +822,9 @@ class ServingEngine:
                 raise ValueError(
                     "serve request inputs disagree on the batch dim: "
                     f"{[int(x.shape[0]) for x in batch]}")
+        dl = deadline_ms if deadline_ms is not None else self.deadline_ms
+        if dl is not None and float(dl) <= 0:
+            raise ValueError("deadline_ms must be > 0")
         _STATS.requests += 1
         if n > self.policy.max_batch or n > self.max_batch:
             _STATS.overflowed += 1
@@ -477,14 +847,44 @@ class ServingEngine:
         sig = tuple((tuple(int(d) for d in a.shape[1:]),
                      str(a.dtype)) for a in batch)
         reply = ServeReply(n)
-        req = _Request(batch, n, sig, reply)
+        deadline = (None if dl is None
+                    else time.perf_counter() + float(dl) / 1e3)
+        req = _Request(batch, n, sig, reply, deadline=deadline)
+        inj = self.fault_injector
+        if inj is not None:
+            # keyed by the per-ENGINE submit ordinal (1-based), so a
+            # schedule like {"poison_request": {3}} marks this
+            # engine's 3rd request regardless of process history
+            with self._lock:
+                self._submit_idx += 1
+                idx = self._submit_idx
+            if inj.should("poison_request", idx):
+                req.poison = True
         with self._lock:
             # re-checked under the lock stop() takes: past this point
             # the dispatcher is guaranteed to drain the queue once
             # more before exiting, so the request cannot strand
             if not self._running:
-                raise ServeClosedError("engine stopped")
-            if len(self._queue) >= self.max_queue:
+                # the future was never enqueued: fail it too so the
+                # terminal-outcome reconciliation stays exact even
+                # for submits racing stop()
+                err = ServeClosedError("engine stopped")
+                self._fail_request(req, err)
+                raise err
+            depth = len(self._queue)
+            if (self.shed_watermark is not None
+                    and depth >= int(self.shed_watermark)):
+                # Shed the NEWEST request: already-accepted requests
+                # keep their latency; this caller gets a structured
+                # back-off hint instead of a collapsing queue.
+                _STATS.shed += 1
+                raise ServeOverloadError(
+                    f"shedding load: queue depth {depth} at the "
+                    f"shed watermark ({self.shed_watermark}); retry "
+                    "after the hinted backoff",
+                    retry_after_ms=self._estimate_retry_after_ms(
+                        depth))
+            if depth >= self.max_queue:
                 _STATS.dropped += 1
                 raise ServeQueueFullError(
                     f"admission queue full ({self.max_queue} "
@@ -498,19 +898,108 @@ class ServingEngine:
         self._have_work.set()
         return reply
 
-    def infer(self, *arrays, timeout: Optional[float] = None):
+    def infer(self, *arrays, timeout: Optional[float] = None,
+              deadline_ms: Optional[float] = None):
         """Synchronous submit+wait — one request's reply."""
-        return self.submit(*arrays).result(timeout)
+        return self.submit(*arrays,
+                           deadline_ms=deadline_ms).result(timeout)
 
     # -- dispatcher -------------------------------------------------------
-    def _pop(self) -> Optional[_Request]:
+    def _fail_request(self, req: _Request, err: BaseException,
+                      expired: bool = False) -> bool:
+        """Terminal failure accounting: every failed future bumps the
+        legacy `errors` counter plus exactly one of
+        `expired`/`failed` — the reconciliation invariant. Counts only
+        when this write actually resolves the future (first write
+        wins), so a request can never land in two terminal buckets;
+        returns whether it did."""
+        if not req.reply._fail(err):
+            return False
+        _STATS.errors += 1
+        if expired:
+            _STATS.expired += 1
+        else:
+            _STATS.failed += 1
+        return True
+
+    def _take_inflight(self) -> List[_Request]:
         with self._lock:
-            if self._queue:
+            taken = [r for r in self._inflight if not r.reply.done()]
+            self._inflight = []
+        return taken
+
+    def _pop(self) -> Optional[_Request]:
+        """Pop the oldest LIVE request: queued requests whose deadline
+        already passed are expired here — before batch assembly, so a
+        dispatch is never padded with rows nobody is waiting for."""
+        while True:
+            with self._lock:
+                if not self._queue:
+                    self._have_work.clear()
+                    return None
                 req = self._queue.popleft()
                 _STATS.queue_depth = len(self._queue)
-                return req
-            self._have_work.clear()
-            return None
+            if (req.deadline is not None
+                    and time.perf_counter() >= req.deadline):
+                self._fail_request(req, ServeDeadlineError(
+                    f"request expired in queue after "
+                    f"{(time.perf_counter() - req.t_enqueue) * 1e3:.1f}"
+                    " ms (deadline passed before batch assembly)"),
+                    expired=True)
+                continue
+            return req
+
+    def _effective_wait_s(self) -> float:
+        """The coalesce window for this cycle. Adaptive mode shrinks
+        it toward 0 as the smoothed queue depth approaches the shed
+        watermark (or max_queue when none is set): under sustained
+        backlog the engine stops paying latency for occupancy —
+        latency degrades gracefully before availability does."""
+        if not self.adaptive_wait:
+            return self.max_wait_s
+        wm = float(self.shed_watermark or self.max_queue)
+        self._depth_ema = (0.8 * self._depth_ema
+                           + 0.2 * _STATS.queue_depth)
+        wait = self.max_wait_s * max(0.0, 1.0 - self._depth_ema / wm)
+        _STATS.effective_wait_ms = round(wait * 1e3, 4)
+        return wait
+
+    def _supervised_loop(self) -> None:
+        """The dispatcher thread target: `_loop` under a supervisor.
+        An exception escaping the loop (a dispatcher bug, an injected
+        `dispatcher_kill`) fails the in-flight futures LOUDLY and
+        restarts the loop — bounded by `max_restarts`, after which the
+        engine stops admitting and fails the remaining queue instead
+        of flapping forever."""
+        while True:
+            try:
+                self._loop()
+                return  # clean exit (stop())
+            except BaseException as e:  # noqa: BLE001 — supervisor
+                for req in self._take_inflight():
+                    self._fail_request(req, ServeDispatchError(
+                        f"dispatcher died mid-dispatch: {e!r}"))
+                _STATS.restarts += 1
+                self._restarts += 1
+                self._note_health(
+                    "unhealthy", f"dispatcher died: {e!r}")
+                if not self._running:
+                    return
+                if self._restarts > self.max_restarts:
+                    with self._lock:
+                        self._running = False
+                        victims = list(self._queue)
+                        self._queue.clear()
+                        _STATS.queue_depth = 0
+                    for req in victims:
+                        self._fail_request(req, ServeClosedError(
+                            f"dispatcher restarts exhausted "
+                            f"({self.max_restarts}); engine stopped"))
+                    self._note_health(
+                        "unhealthy",
+                        f"dispatcher restarts exhausted after {e!r}")
+                    return
+                # else: fall through — the while loop IS the restart
 
     def _loop(self) -> None:
         while True:
@@ -521,17 +1010,22 @@ class ServingEngine:
                 self._have_work.wait(0.05)
                 continue
             # Coalesce window: from the FIRST request of this batch,
-            # wait up to max_wait_s for more work, stopping early when
-            # the batch is full. A request that does not fit (wrong
-            # signature, or it would overflow max_batch) is requeued
-            # at the FRONT below — never reordered behind later
-            # requests of its own signature. The scan stops once a
-            # full cycle's worth of mismatches piled up: under deep
-            # alternating-signature queues an unbounded scan would
-            # churn the whole deque every dispatch.
+            # wait up to the (possibly adaptively shrunk) window for
+            # more work, stopping early when the batch is full. A
+            # request that does not fit (wrong signature, or it would
+            # overflow max_batch) is requeued at the FRONT below —
+            # never reordered behind later requests of its own
+            # signature. The scan stops once a full cycle's worth of
+            # mismatches piled up: under deep alternating-signature
+            # queues an unbounded scan would churn the whole deque
+            # every dispatch.
+            self._cycle_idx += 1
             group = [req]
+            with self._lock:
+                self._inflight = group
+            req.reply.state = "dispatching"
             rows = req.n
-            deadline = req.t_enqueue + self.max_wait_s
+            deadline = req.t_enqueue + self._effective_wait_s()
             pending: List[_Request] = []
             while rows < self.max_batch:
                 nxt = self._pop()
@@ -550,6 +1044,7 @@ class ServingEngine:
                         break
                     continue
                 group.append(nxt)
+                nxt.reply.state = "dispatching"
                 rows += nxt.n
             # requeue the leftovers at the FRONT, preserving order
             if pending:
@@ -558,47 +1053,179 @@ class ServingEngine:
                         self._queue.appendleft(p)
                     _STATS.queue_depth = len(self._queue)
                 self._have_work.set()
+            inj = self.fault_injector
+            if inj is not None and inj.should("dispatcher_kill",
+                                              self._cycle_idx):
+                raise RuntimeError(
+                    f"injected dispatcher kill (cycle "
+                    f"{self._cycle_idx})")
+            # Cleared only on successful return: if _dispatch escapes
+            # with an exception, the supervisor must still find the
+            # group in _inflight to fail its futures loudly — a
+            # `finally` here would wipe it first and leave the
+            # callers hanging until their own result() timeouts.
+            # (_take_inflight skips futures _dispatch already
+            # resolved, so nothing is double-failed.)
             self._dispatch(group, rows)
+            with self._lock:
+                self._inflight = []
 
     def _dispatch(self, group: List[_Request], rows: int) -> None:
-        from . import tensor as tensor_mod
-
+        """One coalesced group: expire stale members, then dispatch
+        with retry/backoff and poison bisection."""
         t_deq = time.perf_counter()
+        live: List[_Request] = []
         for r in group:
+            if r.deadline is not None and t_deq >= r.deadline:
+                # Expired between pop and assembly: same pre-assembly
+                # guarantee as the queue-side expiry in _pop.
+                self._fail_request(r, ServeDeadlineError(
+                    "request expired before batch assembly"),
+                    expired=True)
+                continue
+            live.append(r)
             trace_mod.record_span("queue_wait", r.t_enqueue, t_deq,
                                   rows=r.n)
-        self._dispatch_idx += 1
-        try:
-            with trace_mod.span("batch_assemble", requests=len(group),
-                                rows=rows):
-                if len(group) == 1:
-                    batch = list(group[0].arrays)
-                else:
-                    batch = [np.concatenate([g.arrays[i]
-                                             for g in group])
-                             for i in range(len(group[0].arrays))]
-                padded, info = export_cache.pad_batch_to_bucket(
-                    batch, self.policy)
-                n_bucket = info["n_bucket"]
-                dev = self._device()
-                tensors = [tensor_mod.from_numpy(np.ascontiguousarray(a),
-                                                 device=dev)
-                           for a in padded]
-            t0 = time.perf_counter()
-            with trace_mod.span("dispatch", bucket=n_bucket):
-                out = self.model._ensure_forward_exec()(*tensors)
-            with trace_mod.span("reply", requests=len(group)):
-                host = self._to_host(out, info)
-                self._scatter(group, host, rows)
-            dispatch_s = time.perf_counter() - t0
-        except BaseException as e:  # fail the whole group, keep serving
-            for r in group:
-                _STATS.errors += 1
-                r.reply._fail(e)
+        if not live:
             return
+        with self._lock:
+            self._inflight = live
+        rows = sum(r.n for r in live)
+        err = self._dispatch_with_retry(live, rows)
+        if err is None:
+            self._consec_failures = 0
+            self._update_health()
+            return
+        # Retries exhausted on the whole group: bisect to isolate the
+        # poison request(s) — fail only what fails ALONE, re-dispatch
+        # and deliver the rest. One bad input can't take out a
+        # coalesced batch of 64.
+        self._bisect(live, err)
+        self._consec_failures += 1
+        self._update_health()
+
+    def _dispatch_with_retry(self, group: List[_Request],
+                             rows: int) -> Optional[BaseException]:
+        """Try the fused dispatch up to 1 + max_retries times with
+        exponential backoff + seed-keyed jitter. Returns None on
+        success, the final exception on exhaustion."""
+        from . import resilience
+
+        attempt = 0
+        while True:
+            try:
+                self._dispatch_once(group, rows)
+                return None
+            except BaseException as e:  # noqa: BLE001 — isolate below
+                _STATS.dispatch_failures += 1
+                if attempt >= self.max_retries:
+                    return e
+                attempt += 1
+                _STATS.retries += 1
+                delay = resilience.backoff_delay_s(
+                    attempt, self.backoff_s,
+                    jitter=self.backoff_jitter,
+                    seed=self._jitter_seed)
+                t0 = time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                trace_mod.record_span(
+                    "dispatch_retry", t0, time.perf_counter(),
+                    attempt=attempt, error=repr(e))
+
+    def _bisect(self, group: List[_Request], err: BaseException
+                ) -> None:
+        """Poison isolation: split the failed group and give each half
+        ONE attempt (transient faults already had their retries);
+        halves that still fail recurse down to single requests, which
+        fail their own futures (counted `poisoned`). Everything else
+        re-dispatches and delivers."""
+        if len(group) == 1:
+            r = group[0]
+            # `poisoned` tracks a subset of `failed`: bump it only
+            # when this fail actually resolves the future (the stop()
+            # drain-timeout path may have beaten us to it).
+            if self._fail_request(r, ServeDispatchError(
+                    f"request failed dispatch alone after group "
+                    f"bisection (poison input?): {err!r}")):
+                _STATS.poisoned += 1
+            return
+        mid = len(group) // 2
+        for half in (group[:mid], group[mid:]):
+            try:
+                self._dispatch_once(half, sum(r.n for r in half))
+            except BaseException as e:  # noqa: BLE001
+                _STATS.dispatch_failures += 1
+                self._bisect(half, e)
+
+    def _chaos_attempt(self, group: List[_Request]) -> None:
+        """Test-only fault hook on the dispatch path (the serving
+        chaos harness). No-op without an injector. Poison requests
+        fail DETERMINISTICALLY on every attempt (the bisection
+        target); the transient kinds are keyed by the global attempt
+        index, so a retry redraws."""
+        inj = self.fault_injector
+        if inj is None:
+            return
+        for r in group:
+            if r.poison:
+                raise ServeDispatchError(
+                    "injected poison request: this input fails every "
+                    "dispatch it rides in")
+        idx = self._attempt_idx
+        if inj.should("dispatch_hang", idx):
+            time.sleep(inj.hang_s)
+        if inj.should("dispatch_fail", idx):
+            raise RuntimeError(
+                f"injected transient dispatch failure (attempt {idx})")
+        if inj.should("device_lost_serve", idx):
+            from .resilience import DeviceLostError
+
+            raise DeviceLostError(
+                f"injected serving device loss (attempt {idx})")
+
+    def _dispatch_once(self, group: List[_Request], rows: int) -> None:
+        """One dispatch ATTEMPT: assemble, execute, scatter. Raises on
+        failure (the retry/bisect layers above decide what happens
+        next); on success the replies are delivered before this
+        returns, and post-reply bookkeeping can't kill the thread."""
+        from . import tensor as tensor_mod
+
+        self._attempt_idx += 1
+        self._chaos_attempt(group)
+        t_dispatch0 = time.perf_counter()
+        with trace_mod.span("batch_assemble", requests=len(group),
+                            rows=rows):
+            if len(group) == 1:
+                batch = list(group[0].arrays)
+            else:
+                batch = [np.concatenate([g.arrays[i]
+                                         for g in group])
+                         for i in range(len(group[0].arrays))]
+            padded, info = export_cache.pad_batch_to_bucket(
+                batch, self.policy)
+            n_bucket = info["n_bucket"]
+            dev = self._device()
+            tensors = [tensor_mod.from_numpy(np.ascontiguousarray(a),
+                                             device=dev)
+                       for a in padded]
+        t0 = time.perf_counter()
+        with trace_mod.span("dispatch", bucket=n_bucket):
+            out = self.model._ensure_forward_exec()(*tensors)
+        with trace_mod.span("reply", requests=len(group)):
+            host = self._to_host(out, info)
+            delivered = self._scatter(group, host, rows)
+        dispatch_s = time.perf_counter() - t0
+        self._dispatch_idx += 1
+        # Rolling dispatch time (attempt start -> replies out) feeds
+        # the overload retry_after_ms estimate.
+        whole_s = time.perf_counter() - t_dispatch0
+        self._ema_dispatch_s = (whole_s if not self._ema_dispatch_s
+                                else 0.8 * self._ema_dispatch_s
+                                + 0.2 * whole_s)
         try:  # replies are out — bookkeeping must not kill the thread
             _STATS.note_dispatch(len(group), rows, n_bucket)
-            _STATS.replies += len(group)
+            _STATS.replies += delivered
             with self._lock:  # percentiles() reads from caller threads
                 for r in group:
                     self._latencies.append(r.reply.latency_s)
@@ -612,7 +1239,9 @@ class ServingEngine:
                     pad_fraction=round((n_bucket - rows) / n_bucket, 4),
                     queue_depth=_STATS.queue_depth,
                     p50_ms=p["p50_ms"], p95_ms=p["p95_ms"],
-                    p99_ms=p["p99_ms"])
+                    p99_ms=p["p99_ms"],
+                    expired=_STATS.expired, shed=_STATS.shed,
+                    retries=_STATS.retries, failed=_STATS.failed)
         except Exception:
             _STATS.errors += 1  # e.g. metrics stream closed mid-serve
 
@@ -638,10 +1267,15 @@ class ServingEngine:
             is_leaf=lambda t: hasattr(t, "data") or hasattr(t, "shape"))
         return export_cache.slice_bucket_out(host, info)
 
-    @staticmethod
-    def _scatter(group: List[_Request], host, rows: int) -> None:
+    def _scatter(self, group: List[_Request], host, rows: int) -> int:
+        """Deliver per-request reply rows. Returns how many futures
+        this dispatch actually resolved — a delivery racing a future
+        the stop() drain-timeout path already failed loses (first
+        write wins) and must not count as a reply."""
         import jax
 
+        now = time.perf_counter()
+        delivered = 0
         off = 0
         for r in group:
             lo, hi = off, off + r.n
@@ -653,7 +1287,110 @@ class ServingEngine:
                     return a[lo:hi]
                 return a  # non-batch leaf: shared across requests
 
-            r.reply._deliver(jax.tree_util.tree_map(cut, host))
+            late = r.deadline is not None and now >= r.deadline
+            if late:
+                r.reply.deadline_exceeded = True
+            if r.reply._deliver(jax.tree_util.tree_map(cut, host)):
+                delivered += 1
+                if late:
+                    # Expired mid-dispatch: the work is done and the
+                    # reply delivered — count it `late` so the caller
+                    # knows the SLO was missed.
+                    _STATS.late += 1
+        return delivered
+
+    # -- health -----------------------------------------------------------
+    def _note_health(self, state: str, reason: str) -> None:
+        """Force-record a health transition from an internal event
+        (the supervisor catching a dead loop) — `health()` computed
+        from live signals would miss it, because the supervisor IS the
+        dispatcher thread and restarts immediately."""
+        with self._health_lock:
+            if state != self._health_state:
+                self._health_state = state
+                self.health_transitions.append((state, reason))
+            self._write_health_file({"state": state,
+                                     "reasons": [reason]})
+
+    def _update_health(self) -> None:
+        self.health()
+
+    def health(self) -> Dict:
+        """Liveness/readiness snapshot for fleet probes:
+        `state` in {"ready", "degraded", "unhealthy"} plus the reasons
+        and the load-bearing counters. `degraded` = still serving but
+        under pressure (queue at/above the watermark, a dispatch
+        failure streak below the unhealthy threshold); `unhealthy` =
+        not serving (stopped, dispatcher dead/hung, restarts
+        exhausted) or failing every dispatch. Calling it records a
+        transition in `health_transitions` when the state changed and
+        refreshes `health_file` (the `tools/serve_health.py` probe
+        surface)."""
+        reasons: List[str] = []
+        thread = self._thread
+        alive = thread is not None and thread.is_alive()
+        if self._hung_at_stop:
+            state = "unhealthy"
+            reasons.append("dispatcher hung past the stop drain "
+                           "timeout (thread abandoned)")
+        elif not self._running:
+            state = "unhealthy"
+            reasons.append("engine not running")
+        elif not alive:
+            state = "unhealthy"
+            reasons.append("dispatcher thread dead")
+        elif self._consec_failures >= self.unhealthy_failures:
+            state = "unhealthy"
+            reasons.append(
+                f"{self._consec_failures} consecutive dispatch "
+                f"failures (threshold {self.unhealthy_failures})")
+        else:
+            state = "ready"
+            if self._consec_failures > 0:
+                state = "degraded"
+                reasons.append(
+                    f"{self._consec_failures} consecutive dispatch "
+                    "failure(s)")
+            wm = self.shed_watermark or self.max_queue
+            if _STATS.queue_depth >= int(wm):
+                state = "degraded"
+                reasons.append(
+                    f"queue depth {_STATS.queue_depth} at the shed "
+                    f"watermark ({wm})")
+        snap = {
+            "state": state,
+            "reasons": reasons,
+            "queue_depth": _STATS.queue_depth,
+            "consecutive_failures": self._consec_failures,
+            "restarts": self._restarts,
+            "expired": _STATS.expired,
+            "shed": _STATS.shed,
+            "retries": _STATS.retries,
+            "failed": _STATS.failed,
+        }
+        with self._health_lock:
+            if state != self._health_state:
+                self._health_state = state
+                self.health_transitions.append(
+                    (state, "; ".join(reasons) or "ok"))
+                self._write_health_file(snap)
+        return snap
+
+    def _write_health_file(self, snap: Dict) -> None:
+        if not self.health_file:
+            return
+        import json
+        import os
+
+        payload = dict(snap)
+        payload["time"] = round(time.time(), 3)
+        tmp = f"{self.health_file}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(payload, f)
+            os.replace(tmp, self.health_file)
+        except OSError:
+            _STATS.errors += 1  # health probe rot is loud in counters
 
     # -- SLO percentiles --------------------------------------------------
     def percentiles(self) -> Dict[str, Optional[float]]:

@@ -250,7 +250,7 @@ def test_serve_stage_contract_and_acceptance():
     dispatch carrying the occupancy/pad/percentile fields."""
     proc, result = _run_stage(
         ["--stage", "serve", "--requests", "300",
-         "--deadline", "150"], timeout=300)
+         "--deadline", "150", "--chaos"], timeout=300)
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert result is not None, "no JSON result line on stdout"
     assert result["ok"] is True
@@ -283,8 +283,22 @@ def test_serve_stage_contract_and_acceptance():
     assert recs, "serve stage wrote no metrics records"
     x = recs[-1]["extra"]
     for k in ("requests", "rows", "bucket", "occupancy",
-              "pad_fraction", "queue_depth", "p50_ms", "p99_ms"):
+              "pad_fraction", "queue_depth", "p50_ms", "p99_ms",
+              "expired", "shed", "retries", "failed"):
         assert k in x, f"serving metrics record missing extra.{k}"
+    # ISSUE 8: the --chaos arm's contract — availability + SLO under
+    # injected faults, counters that reconcile, and the same
+    # bit-identity gate the clean arm pins
+    c = result["chaos"]
+    for k in ("availability_pct", "delivered", "failed", "p50_ms",
+              "p99_ms", "replies_match", "retries",
+              "dispatch_failures", "poisoned", "restarts",
+              "counters_reconcile"):
+        assert k in c, f"chaos sub-dict missing {k}"
+    assert c["replies_match"] is True
+    assert c["counters_reconcile"] is True
+    assert c["dispatch_failures"] > 0, "chaos arm injected nothing"
+    assert 0.0 < c["availability_pct"] <= 100.0
 
 
 def test_serve_row_rides_the_driver_ramp():
@@ -319,6 +333,35 @@ def test_fold_onchip_renders_serve_stage(tmp_path, capsys,
     assert "p50 2.1 ms/p99 7.9 ms" in out
     assert "occ 0.83" in out and "x4.4 vs seq" in out
     assert "warm=100%" in out
+    assert "chaos" not in out  # pre-chaos logs fold unchanged
+
+
+def test_fold_onchip_renders_serve_chaos_arm(tmp_path, capsys,
+                                             monkeypatch):
+    """ISSUE 8: the bench `--chaos` arm (availability %, p99 under
+    faults, retries) renders next to the clean serve numbers; a
+    mismatch in either gate is flagged loudly."""
+    fold = _load_module("fold_onchip_for_test", "tools/fold_onchip.py")
+    logs = tmp_path / "onchip_logs"
+    logs.mkdir()
+    row = {"ok": True, "metric": "serve_requests_per_sec",
+           "serve_requests_per_sec": 8123.4, "p50_ms": 2.1,
+           "p99_ms": 7.9, "occupancy_mean": 0.83,
+           "speedup_vs_sequential": 4.4,
+           "chaos": {"availability_pct": 98.75, "p99_ms": 12.3,
+                     "retries": 7, "replies_match": True,
+                     "counters_reconcile": True}}
+    (logs / "serve.out").write_text(json.dumps(row) + "\n")
+    monkeypatch.setattr(fold, "LOGS", str(logs))
+    assert fold.main() == 0
+    out = capsys.readouterr().out
+    assert "chaos: 98.75% avail, p99 12.3 ms, 7 retries" in out
+    assert "MISMATCH" not in out
+    # a failed bit-identity or reconciliation gate is loud
+    row["chaos"]["replies_match"] = False
+    (logs / "serve.out").write_text(json.dumps(row) + "\n")
+    assert fold.main() == 0
+    assert "MISMATCH" in capsys.readouterr().out
 
 
 def test_byte_diet_matrix_flags_validate_in_argparse():

@@ -96,6 +96,30 @@ def test_serving_row_and_readme_section_present():
     assert "BucketOverflowError" in readme
 
 
+def test_serving_resilience_row_and_readme_section_present():
+    """ISSUE 8 doc contract: the P18 serving-resilience row and the
+    README "Serving resilience" section exist (path rot in either is
+    caught by test_all_cited_paths_exist)."""
+    cov = open(os.path.join(_ROOT, "COVERAGE.md")).read()
+    assert "| P18 |" in cov
+    assert "tests/test_serve_resilience.py" in cov
+    assert "tools/serve_health.py" in cov
+    assert "set_serving_resilience" in cov
+    readme = open(os.path.join(_ROOT, "README.md")).read()
+    assert "## Serving resilience" in readme
+    assert "set_serving_resilience" in readme
+    assert "ServeDeadlineError" in readme
+    assert "ServeOverloadError" in readme
+    assert "retry_after_ms" in readme
+    assert "serve_health" in readme
+    # the full error taxonomy + health states are documented
+    for err in ("ServeDispatchError", "ServeClosedError",
+                "ServeQueueFullError"):
+        assert err in readme, err
+    for state in ("ready", "degraded", "unhealthy"):
+        assert state in readme, state
+
+
 def test_all_cited_paths_exist():
     text = open(os.path.join(_ROOT, "COVERAGE.md")).read()
     missing = []

@@ -1,0 +1,980 @@
+"""Serving-tier resilience (ISSUE 8).
+
+Acceptance pins:
+  - per-request deadlines: expired-while-queued requests fail BEFORE
+    batch assembly (`ServeDeadlineError`, counted `expired` — a
+    dispatch is never padded with rows nobody is waiting for); a
+    request that expires mid-dispatch still completes, counted `late`
+    with `reply.deadline_exceeded=True`;
+  - dispatch retry with exponential backoff + seed-keyed jitter, and
+    group BISECTION on exhaustion: one poison input fails only its
+    own future, the rest of the coalesced batch re-dispatches and
+    delivers bit-identical replies;
+  - load shedding: at the `shed_watermark` the NEWEST request is
+    refused with a structured `ServeOverloadError` carrying
+    `retry_after_ms`; under 4x overload the engine sheds instead of
+    queue-collapsing and accepted-request p99 stays bounded;
+    `adaptive_wait` shrinks the coalesce window toward 0 under
+    sustained depth;
+  - dispatcher supervision: an injected loop death fails in-flight
+    futures loudly, restarts the loop (bounded, counted), and
+    `health()` reports the unhealthy -> ready transition;
+    `tools/serve_health.py` maps the health snapshot to exit codes;
+  - `ServeReply.state` (queued/dispatching/done/failed) stays
+    accurate, incl. across requeue-at-front under concurrent
+    mixed-signature load (8 threads x 200 requests, seeded);
+  - `stop(drain=True)` respects `drain_timeout_s`: a hung dispatch
+    cannot block stop forever — remaining futures fail with
+    `ServeClosedError`;
+  - the chaos soak: under >=5% injected dispatch-fail/hang/poison/
+    device-loss (+ dispatcher kills), EVERY submitted request's
+    future resolves (zero silent losses), successful replies stay
+    bit-identical to the unbatched forward, and the
+    `cache_stats()["serve"]` counters reconcile exactly
+    (requests == replies + expired + shed + dropped + overflowed +
+    failed).
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from singa_tpu import device, export_cache, layer, model, resilience, \
+    serve, stats, tensor
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+@pytest.fixture(autouse=True)
+def _clean_serving_config():
+    """Serving + resilience defaults are process knobs — leaving them
+    armed would reroute later tests."""
+    saved = serve.get_config()
+    saved_res = serve.get_resilience_config()
+    yield
+    serve.configure(**saved)
+    serve._RES_CONFIG.update(saved_res)
+    export_cache.configure(directory=None, buckets=None)
+    device.set_tracing(False)
+
+
+class TwoLayer(model.Model):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = layer.Linear(16)
+        self.r1 = layer.ReLU()
+        self.fc2 = layer.Linear(4)
+
+    def forward(self, x):
+        return self.fc2(self.r1(self.fc1(x)))
+
+
+def _serving_model(feats=8, seed=0):
+    """Eval-compiled TwoLayer with dyadic params (multiples of 1/16)
+    so batched and unbatched forwards are EXACT in fp32 — bit-identity
+    by arithmetic, not by luck (the test_serve idiom)."""
+    import jax.numpy as jnp
+
+    dev = device.get_default_device()
+    dev.SetRandSeed(seed)
+    m = TwoLayer()
+    m.compile([tensor.from_numpy(np.zeros((8, feats), np.float32),
+                                 device=dev)],
+              is_train=False, use_graph=True)
+    m.eval()
+    for p in m.param_tensors():
+        p.data = jnp.round(p.data * 16.0) / 16.0
+    return m
+
+
+def _dyadic_requests(rs, n, feats=8, max_rows=4):
+    return [(rs.randint(-16, 16,
+                        (int(rs.randint(1, max_rows + 1)), feats))
+             / 8.0).astype(np.float32) for _ in range(n)]
+
+
+def _snap():
+    return stats.cache_stats()["serve"]
+
+
+def _reconciles(s0, s1):
+    """The terminal-outcome invariant over a counter delta window."""
+    d = {k: s1[k] - s0[k] for k in
+         ("requests", "replies", "expired", "shed", "dropped",
+          "overflowed", "failed")}
+    assert d["requests"] == (d["replies"] + d["expired"] + d["shed"]
+                             + d["dropped"] + d["overflowed"]
+                             + d["failed"]), d
+    return d
+
+
+# ---------------------------------------------------------------------------
+# Knobs
+# ---------------------------------------------------------------------------
+def test_set_serving_resilience_knob_feeds_engine_defaults():
+    device.set_serving_resilience(
+        deadline_ms=75.0, max_retries=5, backoff_ms=2.5,
+        shed_watermark=33, adaptive_wait=True, max_restarts=7,
+        drain_timeout_s=4.0, health_file="/tmp/_h.json")
+    cfg = serve.get_resilience_config()
+    assert cfg["deadline_ms"] == 75.0
+    assert cfg["max_retries"] == 5
+    assert cfg["shed_watermark"] == 33
+    m = _serving_model()
+    eng = serve.ServingEngine(m)
+    assert eng.deadline_ms == 75.0
+    assert eng.max_retries == 5
+    assert eng.backoff_s == pytest.approx(0.0025)
+    assert eng.shed_watermark == 33
+    assert eng.adaptive_wait is True
+    assert eng.max_restarts == 7
+    assert eng.drain_timeout_s == 4.0
+    assert eng.health_file == "/tmp/_h.json"
+    # per-engine override wins
+    eng2 = serve.ServingEngine(m, max_retries=0, adaptive_wait=False)
+    assert eng2.max_retries == 0 and eng2.adaptive_wait is False
+    with pytest.raises(KeyError):
+        serve.configure_resilience(bogus=1)
+    with pytest.raises(ValueError):
+        serve.configure_resilience(deadline_ms=0)
+    with pytest.raises(ValueError):
+        serve.configure_resilience(max_retries=-1)
+    with pytest.raises(ValueError):
+        serve.configure_resilience(backoff_jitter=1.5)
+
+
+def test_shed_watermark_above_max_queue_is_refused():
+    m = _serving_model()
+    with pytest.raises(ValueError, match="shed_watermark"):
+        serve.ServingEngine(m, max_queue=8, shed_watermark=9)
+
+
+def test_backoff_delay_is_deterministic_and_exponential():
+    a1 = resilience.backoff_delay_s(1, 0.01, jitter=0.5, seed=3)
+    assert a1 == resilience.backoff_delay_s(1, 0.01, jitter=0.5,
+                                            seed=3)
+    a3 = resilience.backoff_delay_s(3, 0.01, jitter=0.0, seed=3)
+    assert a3 == pytest.approx(0.04)  # base * 2**(3-1), no jitter
+    assert 0.005 <= a1 <= 0.015  # jitter stays in [1-j, 1+j] * base
+    assert resilience.backoff_delay_s(5, 0.0) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Deadlines
+# ---------------------------------------------------------------------------
+def test_queued_request_expires_before_batch_assembly():
+    m = _serving_model()
+    eng = serve.ServingEngine(m, max_batch=8, max_wait_ms=1.0)
+    eng._running = True  # queue without a dispatcher: deterministic
+    s0 = _snap()
+    r = eng.submit(np.ones((1, 8), np.float32), deadline_ms=5.0)
+    assert r.state == "queued"
+    time.sleep(0.02)
+    assert eng._pop() is None  # the expired request never pops
+    assert r.done() and r.state == "failed"
+    with pytest.raises(serve.ServeDeadlineError, match="expired"):
+        r.result(0)
+    s1 = _snap()
+    assert s1["expired"] - s0["expired"] == 1
+    assert s1["failed"] - s0["failed"] == 0  # expired, not failed
+    _reconciles(s0, s1)
+    eng._running = False
+
+
+def test_default_deadline_knob_applies_and_live_requests_serve():
+    m = _serving_model()
+    s0 = _snap()
+    with serve.ServingEngine(m, max_batch=8, max_wait_ms=1.0,
+                             deadline_ms=10_000.0) as eng:
+        out = eng.infer(np.ones((2, 8), np.float32), timeout=30)
+    assert out.shape == (2, 4)
+    s1 = _snap()
+    assert s1["expired"] - s0["expired"] == 0
+    assert s1["late"] - s0["late"] == 0
+
+
+def test_expiry_during_coalesce_window_skips_dispatch():
+    """A lone request whose deadline lands INSIDE the coalesce window
+    is expired at assembly time — no dispatch fires for it."""
+    m = _serving_model()
+    s0 = _snap()
+    with serve.ServingEngine(m, max_batch=64,
+                             max_wait_ms=300.0) as eng:
+        r = eng.submit(np.ones((1, 8), np.float32), deadline_ms=20.0)
+        with pytest.raises(serve.ServeDeadlineError):
+            r.result(10)
+    s1 = _snap()
+    assert s1["expired"] - s0["expired"] == 1
+    assert s1["dispatches"] - s0["dispatches"] == 0, (
+        "an expired-only group must not dispatch")
+
+
+def test_mid_dispatch_expiry_delivers_late_with_flag():
+    """Deadline passes while the dispatch is (injected-)hung: the work
+    completes and is delivered, counted `late`, reply flagged."""
+    m = _serving_model()
+    inj = resilience.FaultInjector(
+        seed=0, schedule={"dispatch_hang": 1.0}, hang_s=0.08)
+    s0 = _snap()
+    with serve.ServingEngine(m, max_batch=8, max_wait_ms=1.0,
+                             fault_injector=inj) as eng:
+        r = eng.submit(np.ones((1, 8), np.float32), deadline_ms=30.0)
+        out = r.result(30)
+    assert out.shape == (1, 4)
+    assert r.deadline_exceeded is True
+    assert r.state == "done"
+    s1 = _snap()
+    assert s1["late"] - s0["late"] == 1
+    assert s1["replies"] - s0["replies"] == 1  # late is a reply subset
+    _reconciles(s0, s1)
+
+
+# ---------------------------------------------------------------------------
+# Retry + poison isolation
+# ---------------------------------------------------------------------------
+def test_transient_dispatch_failure_retries_and_delivers():
+    m = _serving_model()
+    rs = np.random.RandomState(1)
+    x = _dyadic_requests(rs, 1)[0]
+    ref = np.asarray(m.forward_graph(tensor.from_numpy(x)).data).copy()
+    inj = resilience.FaultInjector(
+        seed=0, schedule={"dispatch_fail": {1}})  # first attempt only
+    s0 = _snap()
+    with serve.ServingEngine(m, max_batch=8, max_wait_ms=1.0,
+                             max_retries=2, backoff_ms=0.5,
+                             fault_injector=inj) as eng:
+        out = eng.infer(x, timeout=30)
+    assert out.tobytes() == ref.tobytes()
+    s1 = _snap()
+    assert s1["retries"] - s0["retries"] == 1
+    assert s1["dispatch_failures"] - s0["dispatch_failures"] == 1
+    assert s1["failed"] - s0["failed"] == 0
+    _reconciles(s0, s1)
+
+
+def test_injected_device_loss_is_retried_as_transient():
+    m = _serving_model()
+    inj = resilience.FaultInjector(
+        seed=0, schedule={"device_lost_serve": {1}})
+    with serve.ServingEngine(m, max_batch=8, max_wait_ms=1.0,
+                             max_retries=1, backoff_ms=0.1,
+                             fault_injector=inj) as eng:
+        out = eng.infer(np.ones((2, 8), np.float32), timeout=30)
+    assert out.shape == (2, 4)
+
+
+def test_retry_exhaustion_fails_single_request_loudly():
+    m = _serving_model()
+    inj = resilience.FaultInjector(
+        seed=0, schedule={"dispatch_fail": {1, 2, 3}})
+    s0 = _snap()
+    with serve.ServingEngine(m, max_batch=8, max_wait_ms=1.0,
+                             max_retries=2, backoff_ms=0.1,
+                             fault_injector=inj) as eng:
+        r = eng.submit(np.ones((1, 8), np.float32))
+        with pytest.raises(serve.ServeDispatchError,
+                           match="failed dispatch alone"):
+            r.result(30)
+        # the engine keeps serving after the failed group
+        out = eng.infer(np.ones((2, 8), np.float32), timeout=30)
+    assert out.shape == (2, 4)
+    s1 = _snap()
+    assert s1["retries"] - s0["retries"] == 2
+    assert s1["poisoned"] - s0["poisoned"] == 1
+    assert s1["failed"] - s0["failed"] == 1
+    _reconciles(s0, s1)
+
+
+def test_poison_request_is_bisected_out_of_the_batch():
+    """The isolation gate: one poison input in a coalesced batch fails
+    ONLY its own future; every other request re-dispatches through the
+    bisection and delivers bit-identical replies."""
+    m = _serving_model()
+    rs = np.random.RandomState(2)
+    reqs = _dyadic_requests(rs, 6, max_rows=1)
+    refs = [np.asarray(m.forward_graph(
+        tensor.from_numpy(x)).data).copy() for x in reqs]
+    inj = resilience.FaultInjector(
+        seed=0, schedule={"poison_request": {3}})  # 3rd submit
+    s0 = _snap()
+    with serve.ServingEngine(m, max_batch=16, max_wait_ms=60.0,
+                             max_retries=0, backoff_ms=0.0,
+                             fault_injector=inj) as eng:
+        replies = [eng.submit(x) for x in reqs]
+        outs = []
+        for i, r in enumerate(replies):
+            if i == 2:
+                with pytest.raises(serve.ServeDispatchError,
+                                   match="poison"):
+                    r.result(30)
+                outs.append(None)
+            else:
+                outs.append(r.result(30))
+    for i, (got, ref) in enumerate(zip(outs, refs)):
+        if i == 2:
+            continue
+        assert got.tobytes() == ref.tobytes(), f"request {i}"
+    s1 = _snap()
+    assert s1["poisoned"] - s0["poisoned"] == 1
+    assert s1["failed"] - s0["failed"] == 1
+    assert s1["replies"] - s0["replies"] == 5
+    _reconciles(s0, s1)
+
+
+# ---------------------------------------------------------------------------
+# Load shedding + adaptive degradation
+# ---------------------------------------------------------------------------
+def test_shed_watermark_refuses_newest_with_retry_after():
+    m = _serving_model()
+    eng = serve.ServingEngine(m, max_batch=4, max_wait_ms=1.0,
+                              max_queue=16, shed_watermark=2)
+    eng._running = True  # admission-only, no dispatcher race
+    eng._ema_dispatch_s = 0.01  # a rolling dispatch time to estimate from
+    s0 = _snap()
+    x = np.ones((1, 8), np.float32)
+    eng.submit(x)
+    eng.submit(x)
+    with pytest.raises(serve.ServeOverloadError,
+                       match="shedding") as ei:
+        eng.submit(x)
+    assert ei.value.retry_after_ms > 0
+    s1 = _snap()
+    assert s1["shed"] - s0["shed"] == 1
+    assert s1["dropped"] - s0["dropped"] == 0  # structured, not hard
+    # no reconcile here: two requests are deliberately still queued
+    # (the invariant holds at quiescence, not mid-flight)
+    eng._running = False
+
+
+def test_retry_after_estimate_scales_with_depth():
+    m = _serving_model()
+    eng = serve.ServingEngine(m, max_batch=16, shed_watermark=None)
+    eng._ema_dispatch_s = 0.01
+    # 64 queued / 16 per dispatch = 4 cycles x 10 ms
+    assert eng._estimate_retry_after_ms(64) == pytest.approx(40.0)
+    assert eng._estimate_retry_after_ms(1) == pytest.approx(10.0)
+    # no dispatch observed yet: falls back to the coalesce window
+    eng2 = serve.ServingEngine(m, max_batch=16, max_wait_ms=2.0)
+    assert eng2._estimate_retry_after_ms(16) >= 1.0
+
+
+def test_adaptive_wait_shrinks_toward_zero_under_sustained_depth():
+    m = _serving_model()
+    eng = serve.ServingEngine(m, max_batch=8, max_wait_ms=10.0,
+                              shed_watermark=10, adaptive_wait=True)
+    st = serve.serve_stats()
+    saved_depth = st.queue_depth
+    try:
+        st.queue_depth = 0
+        assert eng._effective_wait_s() == pytest.approx(0.010, rel=0.3)
+        st.queue_depth = 10  # sustained at the watermark
+        waits = [eng._effective_wait_s() for _ in range(40)]
+        assert waits[0] > waits[-1]
+        assert waits[-1] < 0.001  # shrunk toward 0
+        assert _snap()["effective_wait_ms"] is not None
+    finally:
+        st.queue_depth = saved_depth
+
+
+def test_overload_sheds_instead_of_queue_collapsing():
+    """The overload acceptance gate: at ~4x the calibrated sustainable
+    rate the engine sheds with retry_after_ms instead of letting the
+    queue grow without bound, every ACCEPTED request resolves, and
+    accepted-request p99 stays within 2x the clean-load p99 (with a
+    25 ms noise floor — clean p99 on a tiny CPU model is sub-ms, where
+    a 2x pin would measure scheduler jitter, not the engine)."""
+    m = _serving_model()
+    rs = np.random.RandomState(7)
+    reqs = _dyadic_requests(rs, 400, max_rows=1)
+    st = serve.serve_stats()
+
+    def drive(eng, n, rate):
+        """Open-loop Poisson submitter (seeded); returns accepted
+        latencies (ms) + shed count. Calibration and both measured
+        arms go through this same path so the sustainable-rate
+        estimate includes the submit-loop's own overhead."""
+        lat, shed, accepted = [], 0, []
+        gaps = np.random.RandomState(8).exponential(1.0 / rate, n)
+        t0 = time.perf_counter()
+        due = 0.0
+        for i in range(n):
+            due += gaps[i]
+            now = time.perf_counter() - t0
+            if now < due:
+                time.sleep(due - now)
+            try:
+                accepted.append(eng.submit(reqs[i % len(reqs)]))
+            except serve.ServeOverloadError as e:
+                assert e.retry_after_ms > 0
+                shed += 1
+        for r in accepted:
+            r.result(60)
+            lat.append(r.latency_s * 1e3)
+        makespan = time.perf_counter() - t0
+        return np.asarray(lat), shed, n / makespan
+
+    # Every arm serves with a deterministic 2 ms per-dispatch floor
+    # (injected hang): service rate becomes stable and the submit
+    # loop can always outrun it, so "overload" is reachable and the
+    # latency comparison measures the ENGINE, not scheduler jitter.
+    def _engine(**kw):
+        inj = resilience.FaultInjector(
+            seed=0, schedule={"dispatch_hang": 1.0}, hang_s=0.002)
+        return serve.ServingEngine(m, max_batch=16, max_wait_ms=1.0,
+                                   fault_injector=inj, **kw)
+
+    # Calibrate the sustainable rate by halving from a flood: the
+    # highest probed rate the watermarked engine serves without
+    # sustained shedding. Occupancy (and so capacity) depends on the
+    # rate itself, so the probe must run the same open-loop path.
+    with _engine() as eng:
+        eng.warmup(reqs[0])
+        _, _, rate = drive(eng, 150, 1e9)
+    clean_lat = clean_shed = None
+    s_clean0 = _snap()
+    for _ in range(8):
+        st.max_queue_depth = st.queue_depth
+        s_clean0 = _snap()
+        with _engine(shed_watermark=32, adaptive_wait=True) as eng:
+            eng.warmup(reqs[0])
+            clean_lat, clean_shed, _ = drive(eng, 150, rate)
+        if clean_shed <= 3:
+            break
+        rate *= 0.5
+    sustainable_rps = rate
+    assert clean_shed <= 3, (
+        f"still shedding {clean_shed}/150 at {rate:.0f} req/s")
+    clean_p99 = float(np.percentile(clean_lat, 99))
+
+    # 4x overload: shedding bounds both the queue and accepted p99.
+    # (escalate 4x -> 8x -> 16x: on a fast box the 4x NOMINAL rate can
+    # be submit-loop-limited below real capacity; the pin is that
+    # overload sheds, not the exact multiple that first reaches it)
+    for mult in (4, 8, 16):
+        st.max_queue_depth = st.queue_depth
+        s0 = _snap()
+        with _engine(shed_watermark=32, adaptive_wait=True) as eng:
+            eng.warmup(reqs[0])
+            over_lat, over_shed, _ = drive(eng, 300,
+                                           sustainable_rps * mult)
+        if over_shed > 0:
+            break
+    s1 = _snap()
+    assert over_shed > 0, "16x overload never shed"
+    assert s1["shed"] - s0["shed"] == over_shed
+    assert s1["max_queue_depth"] <= 32, "queue grew past the watermark"
+    assert s1["dropped"] - s0["dropped"] == 0, (
+        "hard queue-full drop fired: shedding failed to bound depth")
+    over_p99 = float(np.percentile(over_lat, 99))
+    assert over_p99 <= 2.0 * max(clean_p99, 25.0), (
+        f"accepted p99 {over_p99:.1f} ms vs clean {clean_p99:.1f} ms")
+    _reconciles(s_clean0, s1)
+
+
+# ---------------------------------------------------------------------------
+# Supervision + health
+# ---------------------------------------------------------------------------
+def test_dispatcher_kill_restarts_and_health_transitions():
+    """The supervision acceptance gate: an injected dispatcher death
+    mid-load fails the in-flight future loudly, the supervisor
+    restarts the loop, subsequent requests serve normally, and
+    health() reports the unhealthy -> ready transition."""
+    m = _serving_model()
+    inj = resilience.FaultInjector(
+        seed=0, schedule={"dispatcher_kill": {2}})  # second cycle dies
+    s0 = _snap()
+    with serve.ServingEngine(m, max_batch=8, max_wait_ms=1.0,
+                             max_restarts=3,
+                             fault_injector=inj) as eng:
+        out = eng.infer(np.ones((1, 8), np.float32), timeout=30)
+        assert out.shape == (1, 4)
+        r2 = eng.submit(np.ones((1, 8), np.float32))
+        with pytest.raises(serve.ServeDispatchError,
+                           match="dispatcher died"):
+            r2.result(30)
+        # the supervisor restarted the loop: traffic serves again
+        out3 = eng.infer(np.ones((2, 8), np.float32), timeout=30)
+        assert out3.shape == (2, 4)
+        h = eng.health()
+        assert h["state"] == "ready"
+        assert h["restarts"] == 1
+        states = [s for s, _ in eng.health_transitions]
+        iu = states.index("unhealthy")
+        assert "ready" in states[iu + 1:], (
+            f"no unhealthy -> ready transition in {states}")
+    s1 = _snap()
+    assert s1["restarts"] - s0["restarts"] == 1
+    assert s1["failed"] - s0["failed"] == 1  # the in-flight future
+    _reconciles(s0, s1)
+
+
+def test_restart_budget_exhaustion_fails_queue_and_stops():
+    m = _serving_model()
+    inj = resilience.FaultInjector(
+        seed=0, schedule={"dispatcher_kill": 1.0})  # every cycle dies
+    eng = serve.ServingEngine(m, max_batch=8, max_wait_ms=1.0,
+                              max_restarts=1, fault_injector=inj)
+    eng.start()
+    r = eng.submit(np.ones((1, 8), np.float32))
+    with pytest.raises(serve.ServeDispatchError):
+        r.result(30)
+    # kill -> restart -> kill -> budget exhausted -> engine stops
+    deadline = time.time() + 10
+    while eng._running and time.time() < deadline:
+        try:
+            eng.submit(np.ones((1, 8), np.float32)).result(5)
+        except (serve.ServeClosedError, serve.ServeDispatchError):
+            pass
+        time.sleep(0.01)
+    assert not eng._running, "engine kept flapping past max_restarts"
+    with pytest.raises(serve.ServeClosedError):
+        eng.submit(np.ones((1, 8), np.float32))
+    assert eng.health()["state"] == "unhealthy"
+    assert ("unhealthy" in [s for s, _ in eng.health_transitions])
+
+
+def test_health_states_and_reasons():
+    m = _serving_model()
+    eng = serve.ServingEngine(m, max_batch=4, shed_watermark=2)
+    h = eng.health()
+    assert h["state"] == "unhealthy"
+    assert any("not running" in r for r in h["reasons"])
+    with eng:
+        assert eng.health()["state"] == "ready"
+        # a dispatch-failure streak below the threshold degrades
+        eng._consec_failures = 1
+        h = eng.health()
+        assert h["state"] == "degraded"
+        assert any("failure" in r for r in h["reasons"])
+        eng._consec_failures = eng.unhealthy_failures
+        assert eng.health()["state"] == "unhealthy"
+        eng._consec_failures = 0
+        # queue at the watermark degrades
+        st = serve.serve_stats()
+        saved = st.queue_depth
+        try:
+            st.queue_depth = 2
+            h = eng.health()
+            assert h["state"] == "degraded"
+            assert any("watermark" in r for r in h["reasons"])
+        finally:
+            st.queue_depth = saved
+    assert eng.health()["state"] == "unhealthy"  # stopped
+
+
+def test_health_file_and_cli_exit_codes(tmp_path):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "serve_health_for_test",
+        os.path.join(_ROOT, "tools", "serve_health.py"))
+    cli = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cli)
+
+    hpath = str(tmp_path / "health.json")
+    m = _serving_model()
+    with serve.ServingEngine(m, max_batch=8, max_wait_ms=1.0,
+                             health_file=hpath) as eng:
+        eng.infer(np.ones((1, 8), np.float32), timeout=30)
+        assert os.path.exists(hpath)
+        code, line = cli.probe(hpath)
+        assert code == 0 and line.startswith("ready")
+    # stop() refreshed the snapshot: the probe flips unhealthy
+    code, line = cli.probe(hpath)
+    assert code == 2 and "unhealthy" in line
+    # degraded maps to 1
+    (tmp_path / "h2.json").write_text(json.dumps(
+        {"state": "degraded", "reasons": ["queue depth 9 at the shed "
+                                          "watermark (8)"],
+         "time": time.time()}))
+    code, line = cli.probe(str(tmp_path / "h2.json"))
+    assert code == 1 and "degraded" in line
+    # missing / stale / garbage all fail closed
+    assert cli.probe(str(tmp_path / "nope.json"))[0] == 2
+    (tmp_path / "h3.json").write_text(json.dumps(
+        {"state": "ready", "time": time.time() - 120}))
+    assert cli.probe(str(tmp_path / "h3.json"), max_age_s=30)[0] == 2
+    (tmp_path / "h4.json").write_text("{not json")
+    assert cli.probe(str(tmp_path / "h4.json"))[0] == 2
+    assert cli.main([hpath, "--quiet"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# ServeReply.state + stop(drain_timeout_s) satellites
+# ---------------------------------------------------------------------------
+def test_reply_state_tracks_queue_and_dispatch():
+    m = _serving_model()
+    eng = serve.ServingEngine(m, max_batch=8, max_wait_ms=1.0)
+    eng._running = True  # no dispatcher: stays queued
+    r = eng.submit(np.ones((1, 8), np.float32))
+    assert r.state == "queued"
+    with pytest.raises(TimeoutError, match="queued"):
+        r.result(0.01)
+    eng._running = False
+    # mid-dispatch: an injected hang holds the request in
+    # "dispatching" long enough to observe
+    inj = resilience.FaultInjector(
+        seed=0, schedule={"dispatch_hang": 1.0}, hang_s=0.2)
+    with serve.ServingEngine(m, max_batch=8, max_wait_ms=1.0,
+                             fault_injector=inj) as eng2:
+        r2 = eng2.submit(np.ones((1, 8), np.float32))
+        deadline = time.time() + 5
+        while r2.state != "dispatching" and time.time() < deadline:
+            time.sleep(0.005)
+        assert r2.state == "dispatching"
+        with pytest.raises(TimeoutError, match="dispatching"):
+            r2.result(0.01)
+        r2.result(30)
+        assert r2.state == "done"
+
+
+def test_stop_drain_timeout_fails_hung_dispatch_futures():
+    """A hung dispatch must not block stop() forever: past
+    drain_timeout_s the in-flight futures fail with ServeClosedError
+    and stop returns."""
+    m = _serving_model()
+    inj = resilience.FaultInjector(
+        seed=0, schedule={"dispatch_hang": 1.0}, hang_s=3.0)
+    eng = serve.ServingEngine(m, max_batch=8, max_wait_ms=1.0,
+                              max_retries=0, fault_injector=inj)
+    eng.start()
+    r = eng.submit(np.ones((1, 8), np.float32))
+    deadline = time.time() + 5
+    while r.state != "dispatching" and time.time() < deadline:
+        time.sleep(0.005)
+    t0 = time.perf_counter()
+    eng.stop(drain=True, drain_timeout_s=0.2)
+    assert time.perf_counter() - t0 < 2.0, "stop blocked on the hang"
+    assert r.done()
+    with pytest.raises(serve.ServeClosedError, match="drain timeout"):
+        r.result(0)
+    assert eng.health()["state"] == "unhealthy"
+    assert any("hung" in reason
+               for _, reason in eng.health_transitions
+               ) or any("hung" in r_
+                        for r_ in eng.health()["reasons"])
+
+
+def test_stop_drain_serves_queued_requests_first():
+    m = _serving_model()
+    with serve.ServingEngine(m, max_batch=8, max_wait_ms=50.0) as eng:
+        replies = [eng.submit(np.ones((1, 8), np.float32))
+                   for _ in range(3)]
+        eng.stop(drain=True)
+        for r in replies:
+            assert r.result(5).shape == (1, 4)
+
+
+# ---------------------------------------------------------------------------
+# Concurrency: submit/stop race + mixed-signature requeue under load
+# ---------------------------------------------------------------------------
+class _Pointwise(model.Model):
+    def forward(self, x):
+        from singa_tpu import autograd
+
+        return autograd.relu(x)
+
+
+def _pointwise_model():
+    dev = device.get_default_device()
+    m = _Pointwise()
+    m.compile([tensor.from_numpy(np.zeros((2, 4), np.float32),
+                                 device=dev)],
+              is_train=False, use_graph=True)
+    m.eval()
+    return m
+
+
+def test_stress_mixed_signatures_8_threads_x_200_requests():
+    """The PR 7 coalesce/requeue paths under real concurrency: 8
+    submitter threads x 200 requests each, two per-sample signatures
+    interleaved, seeded. Every future resolves with the right shape,
+    no reply is lost, states all land terminal, and the counters
+    reconcile."""
+    m = _pointwise_model()
+    s0 = _snap()
+    results = [None] * 8
+    with serve.ServingEngine(m, max_batch=16, max_wait_ms=2.0,
+                             max_queue=4096) as eng:
+
+        def worker(tid):
+            rs = np.random.RandomState(100 + tid)
+            out = {"ok": 0, "refused": 0}
+            replies = []
+            for i in range(200):
+                feats = 4 if rs.randint(2) else 6
+                x = np.full((1, feats), float(tid * 1000 + i),
+                            np.float32)
+                try:
+                    replies.append((feats, eng.submit(x)))
+                except (serve.ServeQueueFullError,
+                        serve.ServeOverloadError):
+                    out["refused"] += 1
+            for feats, r in replies:
+                got = r.result(60)
+                assert got.shape == (1, feats)
+                assert r.state == "done"
+                out["ok"] += 1
+            results[tid] = out
+            return out
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+            assert not t.is_alive(), "stress worker hung"
+    assert all(r is not None for r in results)
+    total_ok = sum(r["ok"] for r in results)
+    total_refused = sum(r["refused"] for r in results)
+    assert total_ok + total_refused == 8 * 200
+    s1 = _snap()
+    d = _reconciles(s0, s1)
+    assert d["replies"] == total_ok
+    assert d["requests"] == 8 * 200
+
+
+def test_submit_stop_race_loses_no_future():
+    """Threads hammer submit() while the main thread stops the engine:
+    every future that submit() returned resolves (delivered or
+    ServeClosedError) — no caller is left hanging."""
+    m = _pointwise_model()
+    stop_at = threading.Event()
+    outcomes = []
+    olock = threading.Lock()
+    eng = serve.ServingEngine(m, max_batch=8, max_wait_ms=0.5)
+    eng.start()
+
+    def worker(tid):
+        rs = np.random.RandomState(tid)
+        for i in range(200):
+            x = np.ones((1, 4), np.float32) * i
+            try:
+                r = eng.submit(x)
+            except serve.ServeClosedError:
+                with olock:
+                    outcomes.append("refused")
+                continue
+            try:
+                r.result(30)
+                with olock:
+                    outcomes.append("ok")
+            except serve.ServeClosedError:
+                with olock:
+                    outcomes.append("closed")
+            if i == 50 and tid == 0:
+                stop_at.set()
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(8)]
+    for t in threads:
+        t.start()
+    stop_at.wait(30)
+    eng.stop(drain=True, drain_timeout_s=10.0)
+    for t in threads:
+        t.join(60)
+        assert not t.is_alive(), "worker hung across stop()"
+    assert len(outcomes) == 8 * 200, "a future was silently lost"
+
+
+# ---------------------------------------------------------------------------
+# Chaos soak (the harness acceptance gate)
+# ---------------------------------------------------------------------------
+def _chaos_soak(n_requests, seed=11, kill_rate=0.02):
+    """Poisson load under >=5% injected dispatch faults; returns the
+    delta counter snapshot after asserting zero silent losses and
+    bit-identical successful replies."""
+    stats.reset_cache_stats()
+    m = _serving_model(seed=seed)
+    rs = np.random.RandomState(seed)
+    reqs = _dyadic_requests(rs, n_requests, max_rows=2)
+    refs = [np.asarray(m.forward_graph(
+        tensor.from_numpy(x)).data).copy() for x in reqs]
+    inj = resilience.FaultInjector(seed=seed, schedule={
+        "dispatch_fail": 0.08,
+        "dispatch_hang": 0.05,
+        "poison_request": 0.05,
+        "device_lost_serve": 0.05,
+        "dispatcher_kill": kill_rate,
+    }, hang_s=0.004)
+    s0 = _snap()
+    eng = serve.ServingEngine(
+        m, max_batch=16, max_wait_ms=2.0, max_queue=2048,
+        max_retries=1, backoff_ms=0.2, shed_watermark=256,
+        adaptive_wait=True, max_restarts=1000, fault_injector=inj)
+    eng.start()
+    gaps = rs.exponential(1.0 / 800.0, n_requests)  # ~800 req/s
+    futures = []
+    submit_refusals = 0
+    t0 = time.perf_counter()
+    due = 0.0
+    for i, x in enumerate(reqs):
+        due += gaps[i]
+        now = time.perf_counter() - t0
+        if now < due:
+            time.sleep(due - now)
+        try:
+            futures.append((i, eng.submit(x)))
+        except (serve.ServeOverloadError, serve.ServeQueueFullError):
+            submit_refusals += 1
+    delivered = failed = 0
+    for i, r in futures:
+        try:
+            out = r.result(120)
+        except (serve.ServeDispatchError, serve.ServeDeadlineError,
+                serve.ServeClosedError):
+            failed += 1
+            assert r.state == "failed"
+            continue
+        # bit-identity survives retries, bisection, and restarts
+        assert out.tobytes() == refs[i].tobytes(), f"request {i}"
+        assert r.state == "done"
+        delivered += 1
+    eng.stop(drain=True, drain_timeout_s=30.0)
+    # zero silent losses: every submitted future resolved
+    assert all(r.done() for _, r in futures)
+    assert delivered + failed == len(futures)
+    s1 = _snap()
+    d = _reconciles(s0, s1)
+    assert d["requests"] == n_requests
+    assert d["replies"] == delivered
+    assert (d["expired"] + d["failed"] + d["shed"] + d["dropped"]
+            == failed + submit_refusals)
+    return d, s1
+
+
+def test_chaos_soak_smoke():
+    """Tier-1 smoke variant of the chaos soak (short Poisson run; the
+    full soak is the `slow`-marked test below)."""
+    d, s1 = _chaos_soak(64, seed=11)
+    # the harness actually injected: faults fired and were survived
+    assert s1["dispatch_failures"] > 0
+    assert s1["retries"] > 0
+    assert s1["poisoned"] > 0
+    assert d["replies"] > 0
+
+
+@pytest.mark.slow
+def test_chaos_soak_full():
+    """The full soak: sustained Poisson load, every fault kind firing
+    repeatedly (incl. dispatcher kills), zero silent losses,
+    bit-identical replies, exact counter reconciliation."""
+    d, s1 = _chaos_soak(500, seed=13, kill_rate=0.06)
+    assert s1["dispatch_failures"] > 5
+    assert s1["retries"] > 2
+    assert s1["poisoned"] > 2
+    assert s1["restarts"] > 0, "no dispatcher kill fired in 500 reqs"
+    assert d["replies"] > 300  # availability under ~5-8% fault rates
+
+
+# ---------------------------------------------------------------------------
+# Observability: metrics fields + counters
+# ---------------------------------------------------------------------------
+def test_metrics_jsonl_carries_resilience_fields(tmp_path):
+    from singa_tpu import trace
+
+    m = _serving_model()
+    mpath = str(tmp_path / "serve_res.jsonl")
+    mlog = trace.MetricsLogger(mpath)
+    with serve.ServingEngine(m, max_batch=8, max_wait_ms=1.0,
+                             metrics=mlog) as eng:
+        eng.infer(np.ones((1, 8), np.float32), timeout=30)
+    mlog.close()
+    recs = trace.read_metrics(mpath)
+    assert recs
+    x = recs[-1]["extra"]
+    for k in ("expired", "shed", "retries", "failed"):
+        assert k in x, f"serving metrics record missing extra.{k}"
+
+
+def test_retry_span_threads_the_tracer():
+    from singa_tpu import trace
+
+    m = _serving_model()
+    inj = resilience.FaultInjector(
+        seed=0, schedule={"dispatch_fail": {1}})
+    device.set_tracing(True)
+    trace.clear()
+    try:
+        with serve.ServingEngine(m, max_batch=8, max_wait_ms=1.0,
+                                 max_retries=1, backoff_ms=0.5,
+                                 fault_injector=inj) as eng:
+            eng.infer(np.ones((1, 8), np.float32), timeout=30)
+        names = [r["name"] for r in trace.records()]
+        assert "dispatch_retry" in names
+    finally:
+        device.set_tracing(False)
+
+
+def test_resilience_counters_in_cache_stats():
+    snap = stats.cache_stats()["serve"]
+    for k in ("expired", "late", "shed", "failed", "poisoned",
+              "retries", "dispatch_failures", "restarts",
+              "effective_wait_ms"):
+        assert k in snap, k
+    stats.reset_cache_stats()
+    s = stats.cache_stats()["serve"]
+    assert s["expired"] == 0 and s["shed"] == 0 and s["retries"] == 0
+
+
+def test_shed_watermark_zero_is_a_config_error():
+    """0 would invert the knob into 'shed everything' (depth >= 0 on
+    an empty queue) — refuse it at construction like the process knob
+    does; None is the off switch."""
+    m = _serving_model()
+    with pytest.raises(ValueError, match="shed_watermark"):
+        serve.ServingEngine(m, max_batch=2, shed_watermark=0)
+
+
+def test_exception_escaping_dispatch_wrapper_fails_inflight_loudly():
+    """An exception from _dispatch itself (outside the retry/bisect
+    guards) must leave _inflight for the supervisor — the caller gets
+    a loud ServeDispatchError, never a silent hang until their own
+    result() timeout."""
+    m = _serving_model()
+    eng = serve.ServingEngine(m, max_batch=4, max_wait_ms=1.0,
+                              max_queue=16)
+
+    def boom(group, rows):
+        raise RuntimeError("dispatch wrapper bug")
+
+    eng._dispatch = boom
+    eng.start()
+    try:
+        r = eng.submit(np.ones((1, 8), np.float32))
+        with pytest.raises(serve.ServeDispatchError,
+                           match="dispatcher died"):
+            r.result(timeout=30.0)
+    finally:
+        eng.stop()
+
+
+def test_hung_dispatch_finishing_after_stop_keeps_reconciliation():
+    """stop()'s drain timeout fails the in-flight futures (`failed`);
+    when the abandoned thread later completes its dispatch, the lost
+    deliveries (first write wins) must NOT also count as `replies` —
+    the terminal-outcome invariant holds at quiescence."""
+    s0 = _snap()
+    m = _serving_model()
+    inj = resilience.FaultInjector(
+        seed=11, schedule={"dispatch_hang": 1.0}, hang_s=0.6)
+    eng = serve.ServingEngine(m, max_batch=4, max_wait_ms=1.0,
+                              max_queue=16, drain_timeout_s=0.1,
+                              fault_injector=inj)
+    eng.start()
+    replies = [eng.submit(np.ones((1, 8), np.float32))
+               for _ in range(2)]
+    time.sleep(0.05)  # let the dispatcher pick the group up
+    eng.stop(drain=True)
+    for r in replies:
+        with pytest.raises(serve.ServeClosedError):
+            r.result(timeout=10.0)
+    # let the abandoned daemon thread finish its hung dispatch: its
+    # deliveries lose first-write-wins and must count nothing
+    time.sleep(1.2)
+    d = _reconciles(s0, _snap())
+    assert d["failed"] == 2 and d["replies"] == 0, d

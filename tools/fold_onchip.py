@@ -147,10 +147,22 @@ def main():
                   if "speedup_vs_sequential" in r else "")
             occ = (f", occ {r['occupancy_mean']}"
                    if "occupancy_mean" in r else "")
+            # --chaos arm (ISSUE 8): availability + p99 under injected
+            # faults next to the clean row; pre-chaos logs fold
+            # unchanged (no "chaos" key, no column)
+            ch = ""
+            if isinstance(r.get("chaos"), dict):
+                c = r["chaos"]
+                bad = ("" if c.get("replies_match", True)
+                       and c.get("counters_reconcile", True)
+                       else " MISMATCH")
+                ch = (f", chaos: {c.get('availability_pct')}% avail, "
+                      f"p99 {c.get('p99_ms')} ms, "
+                      f"{c.get('retries', 0)} retries{bad}")
             rows.append((stage,
                          f"{r['serve_requests_per_sec']:.1f} req/s  "
                          f"(p50 {r.get('p50_ms')} ms/p99 "
-                         f"{r.get('p99_ms')} ms{occ}{sx}"
+                         f"{r.get('p99_ms')} ms{occ}{sx}{ch}"
                          + _stage_breakdown(r) + ")" + mark))
         elif "tokens_per_sec" in r:
             diet = ("" if r.get("slot_dtype") in (None, "fp32")

@@ -1,0 +1,84 @@
+#!/usr/bin/env python3
+"""Fleet health probe for the serving tier (ISSUE 8).
+
+A `ServingEngine` configured with a `health_file` (engine kwarg or
+`device.set_serving_resilience(health_file=...)`) atomically rewrites
+a JSON health snapshot on every state transition — this CLI maps that
+file onto the exit-code contract fleet probes (k8s readiness/liveness,
+systemd watchdogs, load-balancer health checks) speak:
+
+    python tools/serve_health.py /var/run/singa_tpu/serve_health.json
+
+    exit 0  ready      serving normally
+    exit 1  degraded   serving under pressure (queue at the shed
+                       watermark, dispatch-failure streak) — keep in
+                       rotation, raise an alert
+    exit 2  unhealthy  not serving (stopped, dispatcher dead/hung,
+                       restarts exhausted) or failing every dispatch;
+                       also: snapshot missing, unparseable, or older
+                       than --max-age (a wedged process stops writing
+                       transitions, so a stale READY must not pass)
+
+The one-line summary (state + reasons + counters) prints to stdout;
+`--quiet` suppresses it for probe loops that only read the code.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+_EXIT = {"ready": 0, "degraded": 1, "unhealthy": 2}
+
+
+def probe(path: str, max_age_s: float = 0.0):
+    """(exit_code, summary_line) for the snapshot at `path`."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            snap = json.load(f)
+    except (OSError, ValueError) as e:
+        return 2, f"unhealthy: cannot read health snapshot {path}: {e}"
+    state = str(snap.get("state", "unhealthy"))
+    if state not in _EXIT:
+        return 2, f"unhealthy: unknown state {state!r} in {path}"
+    if max_age_s > 0:
+        ts = snap.get("time")
+        age = None if ts is None else time.time() - float(ts)
+        if age is None or age > max_age_s:
+            return 2, (f"unhealthy: snapshot stale "
+                       f"({'no timestamp' if age is None else f'{age:.1f}s old'}"
+                       f", max {max_age_s}s) — wedged writer?")
+    reasons = snap.get("reasons") or []
+    counters = "  ".join(
+        f"{k}={snap[k]}" for k in ("queue_depth", "consecutive_failures",
+                                   "restarts", "expired", "shed",
+                                   "retries", "failed") if k in snap)
+    line = state + ("" if not reasons else ": " + "; ".join(reasons))
+    if counters:
+        line += "  [" + counters + "]"
+    return _EXIT[state], line
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="serving-tier health probe (exit 0/1/2 = "
+                    "ready/degraded/unhealthy)")
+    ap.add_argument("path", nargs="?",
+                    default=os.path.join("metrics", "serve_health.json"),
+                    help="health snapshot written by a ServingEngine "
+                         "with health_file set (default: "
+                         "metrics/serve_health.json)")
+    ap.add_argument("--max-age", type=float, default=0.0,
+                    help="seconds beyond which the snapshot counts as "
+                         "stale => unhealthy (0 = no staleness check)")
+    ap.add_argument("--quiet", action="store_true",
+                    help="exit code only, no summary line")
+    a = ap.parse_args(argv)
+    code, line = probe(a.path, a.max_age)
+    if not a.quiet:
+        print(line)
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -62,6 +62,11 @@ for line in sys.stdin:
         "p50 " + fmt(x.get("p50_ms"), 2) + "ms",
         "p99 " + fmt(x.get("p99_ms"), 2) + "ms",
     ]
+    # resilience counters (ISSUE 8): rendered only when the record
+    # carries them, so pre-resilience JSONL logs render unchanged
+    for k in ("expired", "shed", "retries", "failed"):
+        if k in x:
+            bits.append(k + " " + fmt(x.get(k), 0))
     print("  ".join(bits))
 '
   exit $?
