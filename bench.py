@@ -256,9 +256,36 @@ def stage_smoke():
     print(json.dumps({"ok": True, "phases": phases}), flush=True)
 
 
+def _load_tuned(aliases):
+    """Best-known tuned entry for the first alias present in the
+    store (ISSUE 9: the autotuner persisted it per (model topology
+    fingerprint, chip); aliases resolve it before the model exists).
+    Entries for the TARGET chip win — SINGA_TPU_TUNED_CHIP, default
+    v5e (the project's chip; a CPU-backend autotune models it by
+    default) — else any chip's entry loads, and the log names which,
+    so a CI cpu-chip entry can never silently displace the v5e one.
+    Returns None when the store or entry is missing — a --tuned run
+    without a store degrades to the defaults, loudly."""
+    from singa_tpu import tuning
+
+    store = tuning.TunedStore(
+        os.environ.get("SINGA_TPU_TUNED_STORE") or None)
+    chip = os.environ.get("SINGA_TPU_TUNED_CHIP", "v5e")
+    for alias in aliases:
+        ent = store.get(alias=alias, chip=chip) \
+            or store.get(alias=alias)
+        if ent is not None:
+            log(f"tuned config ({alias}@{ent.get('chip')}, score "
+                f"{ent.get('score', 0):.1f}): {ent['config']}")
+            return ent
+    log(f"--tuned: no entry for {aliases} in {store.path}; "
+        "running defaults (tools/autotune.py populates the store)")
+    return None
+
+
 def stage_resnet(batch, steps, deadline_s, amp=False, remat=False,
                  slot_dtype=None, bn_stats_dtype=None, xla_profile=None,
-                 accum=1):
+                 accum=1, tuned=False, image_size=224):
     """ResNet-50 synthetic throughput at one batch size.
 
     `accum=n` measures microbatched gradient accumulation (ISSUE 4):
@@ -283,6 +310,29 @@ def stage_resnet(batch, steps, deadline_s, amp=False, remat=False,
     (`tools/tpu_watch.sh metrics` tails it live).
     """
     t_stage0 = time.time()
+    # --tuned (ISSUE 9): the persisted best-known config fills every
+    # knob the CLI left at its default (explicit flags always win —
+    # a matrix row must measure what it names). Loaded BEFORE jax
+    # setup so a tuned XLA profile reaches backend init.
+    tuned_cfg, tuned_entry = {}, None
+    if tuned:
+        tuned_entry = _load_tuned(("resnet-50", "resnet"))
+        if tuned_entry is not None:
+            from singa_tpu import tuning as _tuning
+
+            try:
+                tuned_cfg = _tuning.validate_config(
+                    tuned_entry["config"])
+            except ValueError as e:
+                # a store entry from another knob-space version must
+                # cost a re-tune, never the stage (the TunedStore
+                # corrupt-read contract)
+                log(f"--tuned: persisted config not usable ({e}); "
+                    "running defaults")
+                tuned_cfg, tuned_entry = {}, None
+            if tuned_cfg and xla_profile is None and \
+                    tuned_cfg["xla_profile"] != "default":
+                xla_profile = tuned_cfg["xla_profile"]
     _setup_jax(xla_profile)
     sys.path.insert(0, os.path.join(HERE, "examples", "cnn"))
     sys.path.insert(0, os.path.join(HERE, "examples", "cnn", "model"))
@@ -296,6 +346,35 @@ def stage_resnet(batch, steps, deadline_s, amp=False, remat=False,
     dev.SetRandSeed(0)
     log(f"device up: {dev}")
     tensor.set_matmul_precision("default")
+    tuned_applied = {}
+    if tuned_cfg:
+        if not amp and tuned_cfg["compute_dtype"] == "bfloat16":
+            amp = True
+            tuned_applied["compute_dtype"] = "bfloat16"
+        if slot_dtype is None and tuned_cfg["slot_dtype"] is not None:
+            slot_dtype = tuned_cfg["slot_dtype"]
+            tuned_applied["slot_dtype"] = slot_dtype
+        if bn_stats_dtype is None and \
+                tuned_cfg["bn_stats_dtype"] is not None:
+            bn_stats_dtype = tuned_cfg["bn_stats_dtype"]
+            tuned_applied["bn_stats_dtype"] = bn_stats_dtype
+        if accum == 1 and tuned_cfg["grad_accum"] != 1 \
+                and batch % tuned_cfg["grad_accum"] == 0:
+            accum = tuned_cfg["grad_accum"]
+            tuned_applied["grad_accum"] = accum
+        if tuned_cfg["remat_policy"] is not None:
+            device.set_remat_policy(tuned_cfg["remat_policy"])
+            tuned_applied["remat_policy"] = tuned_cfg["remat_policy"]
+        if xla_profile and "xla_profile" not in tuned_applied \
+                and tuned_cfg["xla_profile"] == xla_profile:
+            tuned_applied["xla_profile"] = xla_profile
+        from singa_tpu import tuning as _tuning
+
+        for knob, env_name in _tuning.PALLAS_ENV.items():
+            if tuned_cfg[knob] is not None:
+                os.environ[env_name] = str(tuned_cfg[knob])
+                tuned_applied[knob] = tuned_cfg[knob]
+        log(f"tuned knobs applied: {tuned_applied or '(none)'}")
     if amp:
         tensor.set_compute_dtype("bfloat16")
     if bn_stats_dtype:
@@ -335,7 +414,7 @@ def stage_resnet(batch, steps, deadline_s, amp=False, remat=False,
     # independent stream.
     kx, ky = jax.random.split(jax.random.PRNGKey(1))
     x_dev = jax.jit(lambda k: jax.random.normal(
-        k, (batch, 3, 224, 224), jnp.float32))(kx)
+        k, (batch, 3, image_size, image_size), jnp.float32))(kx)
     y_dev = jax.jit(lambda k: jax.random.randint(
         k, (batch,), 0, 1000, jnp.int32))(ky)
     jax.block_until_ready([x_dev, y_dev])
@@ -401,6 +480,7 @@ def stage_resnet(batch, steps, deadline_s, amp=False, remat=False,
                                          first_step, steady_s)
     out = {"ok": True, "batch": batch, "ips": round(ips, 2),
            "step_ms": round(1e3 * med, 2),
+           "image_size": image_size,
            "remat": bool(remat),
            "precision": "bf16" if amp else "fp32",
            # byte-diet matrix columns (tests/test_bench_mechanics.py
@@ -423,8 +503,75 @@ def stage_resnet(batch, steps, deadline_s, amp=False, remat=False,
            "loss": round(float(loss.to_numpy()), 3)}
     if accum > 1:
         out["accum_images_per_sec"] = round(ips, 2)
+    if tuned_entry is not None:
+        # the autotuned provenance rides the result (ISSUE 9):
+        # tools/fold_onchip.py renders `tuned=✓`, and the judge can
+        # trace the row back to the exact search that produced it
+        out["tuned_config"] = tuned_applied
+        out["tuned_provenance"] = {
+            "chip": tuned_entry.get("chip"),
+            "score": tuned_entry.get("score"),
+            "fingerprint": (tuned_entry.get("fingerprint") or "")[:16],
+            "source": tuned_entry.get("provenance", {}).get("source"),
+            "created": tuned_entry.get("provenance", {}).get("created"),
+            "store": os.environ.get("SINGA_TPU_TUNED_STORE", ""),
+        }
+    _emit_measured_config(out, ips, amp, slot_dtype, bn_stats_dtype,
+                          xla_profile, accum, remat, tuned_cfg)
     log(f"RESULT {out}")
     print(json.dumps(out), flush=True)
+
+
+def _emit_measured_config(out, ips, amp, slot_dtype, bn_stats_dtype,
+                          xla_profile, accum, remat, tuned_cfg):
+    """Append one MEASURED-score record to
+    metrics/measured_configs.jsonl when this run's knobs are exactly
+    representable in the autotuner's knob space — the feedback loop
+    `tools/autotune.py --metrics-jsonl` ingests (measured examples/sec
+    outrank the roofline on exact config matches). Per-op `--remat`
+    runs are skipped (that knob is outside the search space; the
+    record would mislabel the config), as is any knob value the space
+    doesn't enumerate. Geometry (batch/image_size) rides along for
+    auditability: match measured files to the geometry you tune for."""
+    if remat:
+        return
+    try:
+        import jax
+
+        from singa_tpu import tuning as _tuning
+
+        raw = {
+            "compute_dtype": "bfloat16" if amp else None,
+            "slot_dtype": slot_dtype,
+            "bn_stats_dtype": bn_stats_dtype,
+            "xla_profile": xla_profile or "default",
+            "grad_accum": accum,
+            "remat_policy": (tuned_cfg or {}).get("remat_policy"),
+        }
+        # Pallas blocks the run ACTUALLY used (the tuned path exports
+        # them to the env) — omitting them would attribute this
+        # measurement to the default-blocks config
+        for knob, env_name in _tuning.PALLAS_ENV.items():
+            if os.environ.get(env_name):
+                raw[knob] = int(os.environ[env_name])
+        cfg = _tuning.validate_config(raw)
+        d = jax.devices()[0]
+        measured_chip = _tuning.normalize_chip(
+            f"{d.platform} {getattr(d, 'device_kind', '')}")
+        path = os.path.join(HERE, "metrics",
+                            "measured_configs.jsonl")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "a") as f:
+            f.write(json.dumps({
+                "config": cfg, "source": "measured",
+                "measured_examples_per_sec": round(ips, 2),
+                "stage": "resnet", "chip": measured_chip,
+                "batch": out["batch"],
+                "image_size": out["image_size"],
+                "time": time.time()}) + "\n")
+        out["measured_config_jsonl"] = os.path.relpath(path, HERE)
+    except (ValueError, OSError) as e:
+        log(f"measured-config record skipped: {e}")
 
 
 # ===========================================================================
@@ -461,6 +608,11 @@ def _stage_env():
     # executables across attempts/processes; "" disables.
     env.setdefault("SINGA_TPU_EXPORT_CACHE",
                    os.path.join(HERE, ".export_cache"))
+    # Tuned-config store (ISSUE 9): --tuned stages and the serving
+    # tier resolve best-known configs here; tools/autotune.py
+    # populates it.
+    env.setdefault("SINGA_TPU_TUNED_STORE",
+                   os.path.join(HERE, ".tuned", "tuned_configs.json"))
     return env
 
 
@@ -1079,6 +1231,16 @@ def main():
                    help="gradient-accumulation factor for the resnet "
                    "stage: --batch is the EFFECTIVE batch, the step "
                    "scans batch/accum microbatches and applies once")
+    p.add_argument("--image-size", type=int, default=224,
+                   help="resnet stage input resolution (224 = the "
+                   "headline metric; small values make CPU mechanics "
+                   "runs affordable)")
+    p.add_argument("--tuned", action="store_true",
+                   help="resnet stage: load the autotuner's persisted "
+                   "best-known config (SINGA_TPU_TUNED_STORE; "
+                   "tools/autotune.py populates it) for every knob "
+                   "the CLI leaves at its default, and record "
+                   "tuned_config + provenance in the result JSON")
     p.add_argument("--size", choices=["base", "tiny"], default="base",
                    help="bert stage model size (tiny = CPU mechanics)")
     p.add_argument("--requests", type=int, default=400,
@@ -1108,7 +1270,8 @@ def main():
         return stage_resnet(a.batch, a.steps, a.deadline, amp=a.amp,
                             remat=a.remat, slot_dtype=a.slot_dtype,
                             bn_stats_dtype=a.bn_stats_dtype,
-                            xla_profile=a.xla_profile, accum=a.accum)
+                            xla_profile=a.xla_profile, accum=a.accum,
+                            tuned=a.tuned, image_size=a.image_size)
     if a.stage == "lm":
         return stage_lm(a.batch, a.seq, a.steps, a.deadline)
     if a.stage == "bert":
@@ -1211,6 +1374,10 @@ def main():
                 "--deadline", str(max(45, min(dl, remaining() - 60)))]
         if amp:
             args.append("--amp")
+        if a.tuned and not extra:
+            # plain rows ride the tuned config; explicit matrix rows
+            # keep measuring exactly what they name
+            args.append("--tuned")
         args += list(extra)
         r = run_stage("resnet", args,
                       min(dl + 90, max(60, remaining() - 30)))

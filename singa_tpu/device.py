@@ -65,6 +65,9 @@ __all__ = [
     "set_loss_scaling",
     # Microbatched gradient accumulation (ISSUE 4).
     "set_grad_accum",
+    # Scan-level rematerialization policy (ISSUE 9; singa_tpu.stats
+    # owns the state, model._JitStep reads it at build time).
+    "set_remat_policy",
     # Observability (ISSUE 5): span tracer + device-profiler window
     # (singa_tpu.trace owns the state).
     "set_tracing",
@@ -510,6 +513,45 @@ def set_grad_accum(n: int) -> None:
     from . import stats
 
     stats.configure(grad_accum=n)
+
+
+def set_remat_policy(policy, *names) -> None:
+    """Scan-level rematerialization policy for the compiled train step
+    (ISSUE 9; ROADMAP item 2's byte lever, searchable by the
+    autotuner). None (default) = off; a named `jax.checkpoint` policy —
+    "dots_saveable" (matmul/conv-free recompute: dot results stay
+    saved, everything else is recomputed in the backward),
+    "nothing_saveable" (maximum recompute: only region inputs
+    survive), "dots_with_no_batch_dims_saveable",
+    "everything_saveable" — or
+    `set_remat_policy("save_anything_but_these_names", "a", "b")` for
+    the name-keyed policy (pairs with `jax.ad_checkpoint.checkpoint_name`
+    inside custom models).
+
+    With a policy armed, the graph-mode step wraps each microbatch's
+    ENTIRE forward+loss region in `jax.checkpoint(policy=...)` and
+    derives its gradients from one `jax.vjp` over that region — inside
+    `_JitStep._accum_step`'s `lax.scan` when gradient accumulation is
+    on (fp32 accumulation preserved, the optimizer still applies once
+    on the mean), and as a single whole-batch region when accumulation
+    is off. Activation memory across the fwd→bwd boundary drops to the
+    policy's saveable set; the recompute FLOPs are the price
+    (μ-cuDNN's memory/recompute trade, arXiv:1804.04806). The effect
+    is CPU-verifiable via `hlo_profile.peak_bytes_estimate` on
+    `Model.step_hlo_text`. Composes with the per-op
+    `autograd.set_remat` (which checkpoints individual op fns) and
+    joins the export-cache knob fingerprint, so AOT artifacts can
+    never go stale across a policy flip. Eager mode ignores the
+    policy. Read at executable build time (the
+    `set_buffer_donation`/`set_grad_accum` contract): re-`compile()`
+    an already-compiled graph-mode model after toggling. Requires an
+    optimizer on the model and `train_one_batch` to call
+    `backward_and_update` exactly once (the grad-accum contract)."""
+    from . import stats
+
+    if names:
+        policy = (policy, list(names))
+    stats.configure(remat_policy=policy)
 
 
 def set_tracing(flag: bool = True, ring_capacity: Optional[int] = None,

@@ -358,6 +358,10 @@ def knob_fingerprint() -> Dict:
         "loss_scaling": _scalarize(cfg.get("loss_scaling")),
         "grad_accum": cfg.get("grad_accum"),
         "remat": _scalarize(remat),
+        # scan-level remat policy (ISSUE 9): a policy flip re-derives
+        # the backward (checkpointed-region vjp vs captured walk) —
+        # a different traced program, so it must orphan artifacts
+        "remat_policy": _scalarize(cfg.get("remat_policy")),
         "compute_dtype": str(tensor.get_compute_dtype()),
         "matmul_precision": tensor.get_matmul_precision(),
         "xla_profile": device.get_xla_profile(),

@@ -44,8 +44,6 @@ _INSTR_RE = re.compile(
 _TUPLE_INSTR_RE = re.compile(
     r"^\s*(?:ROOT\s+)?%?(?P<name>[\w.\-]+)\s*=\s*\("
     r".*?\)\s+(?P<opcode>[\w\-]+)\(")
-_COMP_RE = re.compile(r"^(?:ENTRY\s+)?%?(?P<name>[\w.\-]+)\s*"
-                      r"(?:\([^)]*\))?\s*->.*\{\s*$")
 _OPERANDS_RE = re.compile(r"\(([^)]*)\)")
 _OPNAME_RE = re.compile(r'op_name="([^"]*)"')
 _CALLS_RE = re.compile(r"calls=%?([\w.\-]+)")
@@ -80,10 +78,33 @@ def _parse_computations(hlo_text: str) -> Dict[str, List[_Instr]]:
     for raw in hlo_text.splitlines():
         line = raw.rstrip()
         if current is None:
-            m = _COMP_RE.match(line.strip())
-            if m and line.rstrip().endswith("{"):
-                current = m.group("name")
-                comps[current] = []
+            s = line.strip()
+            # Header forms: post-optimization text spells
+            # `[ENTRY] %name (params) -> result {` and pre-optimization
+            # HLO (`lowered.as_text(dialect="hlo")`) just
+            # `[ENTRY] name {`. Matched structurally rather than by a
+            # single regex: a while/scan BODY computation carries its
+            # carry tuple as a parameter, and tuple-typed params nest
+            # parens a regex can't bound (`%region_0.180.clone (arg:
+            # (s32[], f32[5]{0}, ...)) -> (...) {`) — those bodies are
+            # exactly what peak_bytes_estimate must see inside.
+            if s.endswith("{") and " = " not in s.split("->")[0]:
+                head = (s.split("->")[0] if "->" in s
+                        else s[:-1].strip())
+                tok = head.split()
+                name = None
+                if "->" in s and tok:
+                    name = (tok[1] if tok[0] == "ENTRY"
+                            and len(tok) > 1 else tok[0])
+                elif len(tok) == 2 and tok[0] == "ENTRY":
+                    name = tok[1]
+                elif len(tok) == 1:
+                    name = tok[0]
+                if name:
+                    name = name.lstrip("%").split("(")[0]
+                if name:
+                    current = name
+                    comps[current] = []
             continue
         if line.strip() == "}":
             current = None
@@ -295,6 +316,147 @@ def bytes_accessed(hlo_text: str) -> dict:
         by_op[key] = by_op.get(key, 0.0) + r + w
     return {"total": reads + writes, "reads": reads, "writes": writes,
             "by_op": by_op}
+
+
+# Instructions that call other computations whose internals DO
+# materialize buffers (control flow). Fusions are deliberately opaque:
+# a fusion's intermediates live in registers/VMEM, not HBM — counting
+# them would overstate every fused program's peak.
+_PEAK_RECURSE_OPS = ("while", "call", "conditional")
+_CALLEE_RE = re.compile(
+    r"(?:calls|to_apply|body|condition|branch_computations)="
+    r"(\{[^}]*\}|%?[\w.\-]+)")
+
+
+def _instr_callees(ins: _Instr) -> List[str]:
+    out = []
+    for m in _CALLEE_RE.finditer(ins.line):
+        val = m.group(1)
+        if val.startswith("{"):
+            out.extend(v.strip().lstrip("%")
+                       for v in val[1:-1].split(",") if v.strip())
+        else:
+            out.append(val.lstrip("%"))
+    return out
+
+
+def _split_top(seg: str) -> List[str]:
+    """Split on commas at bracket depth 0 — operand TYPES carry
+    commas of their own (`f32[8,8]`, tuple types `(f32[], s32[])`)."""
+    out, cur, depth = [], [], 0
+    for ch in seg:
+        if ch in "([{":
+            depth += 1
+        elif ch in ")]}":
+            depth -= 1
+        if ch == "," and depth == 0:
+            out.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    if cur:
+        out.append("".join(cur))
+    return out
+
+
+def _operand_names(ins: _Instr) -> List[str]:
+    """Operand value names of one instruction. The operand list is
+    the balanced paren group FOLLOWING the opcode — `_OPERANDS_RE`
+    (first paren group on the line) would grab the result TYPE of
+    tuple-typed instructions (`%t = (f32[], s32[]) tuple(%a, %b)`),
+    mis-freeing every value whose last use is a tuple/while/ROOT
+    tuple and under-counting the peak."""
+    idx = ins.line.find(ins.opcode + "(")
+    if idx < 0:
+        return []
+    start = idx + len(ins.opcode)
+    depth, end = 0, None
+    for j in range(start, len(ins.line)):
+        ch = ins.line[j]
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+            if depth == 0:
+                end = j
+                break
+    if end is None:
+        return []
+    out = []
+    for tok in _split_top(ins.line[start + 1:end]):
+        tok = tok.strip()
+        if tok:
+            out.append(tok.split(" ")[-1].lstrip("%"))
+    return out
+
+
+def _comp_peak(name: str, comps: Dict[str, List[_Instr]],
+               memo: Dict[str, float]) -> float:
+    """Max live bytes over one computation's instruction sequence:
+    parameters are live throughout (the caller holds them), each
+    result is live from its definition to its last textual use (the
+    ROOT result to the end), and control-flow instructions add their
+    callee computation's own peak as a transient at the call point
+    (while takes max(body, condition) — they never run
+    simultaneously). An analytic estimate, not a buffer-assignment
+    readout — but it moves with the program's real liveness, which is
+    what makes a remat knob's effect visible on CPU."""
+    if name in memo:
+        return memo[name]
+    memo[name] = 0.0  # cycle guard (HLO call graphs are acyclic)
+    instrs = comps.get(name, [])
+    opnames = [_operand_names(ins) for ins in instrs]
+    last_use: Dict[str, int] = {}
+    for i, names in enumerate(opnames):
+        for nm in names:
+            last_use[nm] = i
+    base = sum(_instr_bytes(i) for i in instrs
+               if i.opcode == "parameter")
+    cur = peak = base
+    live: Dict[str, float] = {}
+    for i, ins in enumerate(instrs):
+        if ins.opcode == "parameter":
+            continue
+        b = _instr_bytes(ins)
+        live[ins.name] = b
+        cur += b
+        transient = 0.0
+        if ins.opcode in _PEAK_RECURSE_OPS:
+            subs = [_comp_peak(c, comps, memo)
+                    for c in _instr_callees(ins) if c in comps]
+            if ins.opcode == "while" and subs:
+                transient = max(subs)
+            else:
+                transient = sum(subs) if ins.opcode == "call" \
+                    else (max(subs) if subs else 0.0)
+        if cur + transient > peak:
+            peak = cur + transient
+        for nm in opnames[i]:
+            if last_use.get(nm) == i:
+                cur -= live.pop(nm, 0.0)
+        if (not ins.line.lstrip().startswith("ROOT")
+                and last_use.get(ins.name, i) <= i):
+            cur -= live.pop(ins.name, 0.0)
+    memo[name] = peak
+    return peak
+
+
+def peak_bytes_estimate(hlo_text: str) -> float:
+    """Estimated peak live bytes of the program's ENTRY computation:
+    the max, over the instruction sequence, of (parameters + results
+    still awaiting a later use + the internal peak of any control-flow
+    callee active at that point). The memory-side companion of
+    `bytes_accessed`: a byte-DIET knob (bf16 slots/stats) moves the
+    traffic meter; a REMAT knob (`device.set_remat_policy`) moves this
+    one — fewer activations survive the fwd→bwd boundary, so the max
+    live set shrinks even though recompute adds traffic. CPU-
+    verifiable via `Model.step_hlo_text`, no chip needed
+    (tests/test_remat_policy.py pins that `dots_saveable` strictly
+    lowers it for a conv model under grad accumulation)."""
+    comps = _parse_computations(hlo_text)
+    if not comps:
+        return 0.0
+    return _comp_peak(_entry_name(comps), comps, {})
 
 
 def aggregate(rows: List[dict], top: int = 0) -> List[dict]:

@@ -471,13 +471,17 @@ class Model(Layer):
         identical DAG structure share executables)."""
         return stats_mod.cache_stats()
 
-    def step_hlo_text(self, *batch) -> str:
-        """Optimized HLO of the whole-step jit program for `batch`
-        (compiled, never executed — model/optimizer arrays are
-        untouched apart from `_ensure_opt_slots` pre-creating missing
-        slot zeros). The input to `hlo_profile.bytes_accessed`/
-        `profile_hlo`: how tests and tools measure a byte-diet knob's
-        effect without a chip. Reuses (or primes) the model's own
+    def step_hlo_text(self, *batch, optimized: bool = True) -> str:
+        """HLO of the whole-step jit program for `batch` (never
+        executed — model/optimizer arrays are untouched apart from
+        `_ensure_opt_slots` pre-creating missing slot zeros). The
+        input to `hlo_profile.bytes_accessed`/`profile_hlo`: how tests
+        and tools measure a byte-diet knob's effect without a chip.
+        `optimized=False` returns the pre-optimization HLO instead
+        (no XLA compile paid) — the view where the remat policy's
+        checkpoint barriers survive, which is what
+        `hlo_profile.peak_bytes_estimate` meters (see
+        `_JitStep.lowered_text`). Reuses (or primes) the model's own
         `_jit_step` executable, so inspecting a training model — or
         inspecting then training — pays the whole-step XLA compile
         once, not twice."""
@@ -490,7 +494,7 @@ class Model(Layer):
                     batch_specs=self._batch_specs)
             else:
                 self._jit_step = _JitStep(self)
-        return self._jit_step.lowered_text(*batch)
+        return self._jit_step.lowered_text(*batch, optimized=optimized)
 
     def _ensure_forward_exec(self) -> "_JitForward":
         """The model's forward-executable wrapper, created lazily —
@@ -741,6 +745,22 @@ def _merge_accum_out(stacked, mb: int):
         return a[-1]
 
     return jax.tree_util.tree_map(leaf, stacked)
+
+
+def _checkpoint_policy(policy):
+    """Resolve a validated remat-policy config value
+    (`stats.remat_policy()`) to the jax.checkpoint policy callable.
+    None stays None (checkpoint's own default = nothing saveable —
+    but a None CONFIG means remat is OFF and no checkpoint wraps at
+    all; callers branch on the config before resolving)."""
+    from jax import checkpoint_policies as _cp
+
+    if policy is None:
+        return None
+    if isinstance(policy, str):
+        return getattr(_cp, policy)
+    name, keep = policy  # ("save_anything_but_these_names", names)
+    return _cp.save_anything_except_these_names(*keep)
 
 
 class _JitForward:
@@ -1081,6 +1101,21 @@ class _JitStep:
                                         step_counter, batch)
 
             step_fn = accum_fn
+        elif (stats_mod.remat_policy() is not None
+              and self.opt is not None):
+            # Scan-level remat with accumulation OFF (ISSUE 9): the
+            # whole batch runs as ONE checkpointed microbatch through
+            # the accumulation body (length-1 scan elided inside
+            # _accum_scan), so the policy has exactly one definition
+            # whether or not grad accumulation is on. Requires the
+            # accumulation contract (one backward_and_update per
+            # step), which _accum_step validates.
+            def remat_fn(pvals, svals, ovals, key, step_counter,
+                         batch):
+                return self._accum_step(1, pvals, svals, ovals, key,
+                                        step_counter, batch)
+
+            step_fn = remat_fn
         # Donation honors the eager-config knob at build time
         # (device.set_buffer_donation); re-compile() to re-arm. The
         # export-cache path forces donation OFF (`donate=False`): a
@@ -1121,19 +1156,25 @@ class _JitStep:
         device."""
         return micro
 
-    def _run_accum_microbatch(self, dev, svals_c, key_c, mb):
+    def _run_accum_microbatch(self, dev, svals_c, key_c, mb,
+                              skip_backward: bool = False):
         """One microbatch forward+backward with the optimizer in
         capture mode: binds states/key, runs the user's
         train_one_batch, and returns (out_arrays, loss_array, pairs,
         new_state_arrays, new_key). The shared body of the discovery
-        pass, the scan body, and the sharded local step."""
+        pass, the scan body, and the sharded local step.
+
+        `skip_backward=True` (the scan-level remat path) runs the
+        forward+loss only — `pairs` comes back None and the caller
+        derives gradients from `jax.vjp` over the checkpointed
+        region (`_remat_microbatch_grads`)."""
         import jax.numpy as jnp
 
         model, opt = self.model, self.opt
         for s, v in zip(self.states, svals_c):
             s.data = v
         dev._rng_key = key_c
-        opt._accum_begin()
+        opt._accum_begin(skip_backward=skip_backward)
         try:
             out = model.train_one_batch(
                 *[tensor_mod.from_raw(b, dev) for b in mb])
@@ -1182,42 +1223,108 @@ class _JitStep:
                 "(param, grad) pairs — nothing to accumulate")
         return order, outs_sds
 
+    def _remat_microbatch_grads(self, dev, order, svals_c, key_c, mb,
+                                policy):
+        """One microbatch under the scan-level remat policy (ISSUE 9):
+        the ENTIRE forward+loss region — the user's train_one_batch
+        with the framework backward suppressed — is wrapped in
+        `jax.checkpoint(policy=...)` and gradients come from ONE
+        `jax.vjp` over it, so what survives the fwd→bwd boundary is
+        exactly the policy's saveable set (region inputs + e.g. dot
+        results under `dots_saveable`) instead of every op's
+        residuals; XLA recomputes the rest inside the backward. The
+        vjp seed matches `backward_and_update`'s (the live loss scale
+        under dynamic scaling, implicit ones otherwise), so the grads
+        feed `apply_accumulated` identically to the captured-pairs
+        path. Returns (out_arrays, loss_array, grads_in_order,
+        new_state_arrays, new_key)."""
+        import jax.numpy as jnp
+
+        from . import resilience
+
+        params = order
+
+        def region(plist, sv, kv, mb_arrays):
+            saved = [p.data for p in params]
+            try:
+                for p, v in zip(params, plist):
+                    p.data = v
+                outs, loss_arr, _, new_s, new_key = \
+                    self._run_accum_microbatch(dev, sv, kv, mb_arrays,
+                                               skip_backward=True)
+            finally:
+                for p, v in zip(params, saved):
+                    p.data = v
+            return loss_arr, (outs, tuple(new_s), new_key)
+
+        ck = jax.checkpoint(region, policy=_checkpoint_policy(policy))
+        plist = [p.data for p in params]
+        loss_arr, vjp_fn, aux = jax.vjp(ck, plist, list(svals_c),
+                                        key_c, list(mb), has_aux=True)
+        outs, new_s, new_key = aux
+        if resilience.guard_active() and resilience.scaler_active():
+            seed = resilience.scaled_seed(loss_arr)
+        else:
+            seed = jnp.ones_like(loss_arr)
+        grads = vjp_fn(seed)[0]
+        return outs, loss_arr, list(grads), list(new_s), new_key
+
     def _accum_scan(self, dev, order, svals_init, key_init, micro):
         """`lax.scan` the user's train_one_batch over a [n, mb, ...]
         microbatch stack, accumulating gradients in fp32. The ONE
         definition of the accumulation loop body — the single-device
         step and the sharded shard_map local step both run exactly
-        this, so the modes cannot drift apart numerically. Returns
-        ((final_states, final_key, grad_sums, loss_sum),
+        this, so the modes cannot drift apart numerically. Under
+        `device.set_remat_policy` the body's gradients come from the
+        checkpointed-region vjp (`_remat_microbatch_grads`) instead of
+        the captured per-op walk — same accumulation math either way.
+        Returns ((final_states, final_key, grad_sums, loss_sum),
         stacked_outs)."""
         import jax.numpy as jnp
 
         acc0 = [jnp.zeros(p.data.shape, jnp.float32) for p in order]
         ids = [id(p) for p in order]
+        remat_pol = stats_mod.remat_policy()
 
         def body(carry, mb_arrays):
             svals_c, key_c, acc, loss_acc = carry
-            outs, loss_arr, pairs, new_s, new_key = \
-                self._run_accum_microbatch(dev, svals_c, key_c,
-                                           mb_arrays)
-            gd = {id(p): (g.data if isinstance(g, Tensor) else g)
-                  for p, g in pairs}
-            if sorted(gd) != sorted(ids):
-                raise RuntimeError(
-                    "gradient accumulation: the (param, grad) set "
-                    "changed between the discovery pass and the scan "
-                    "body")
+            if remat_pol is not None:
+                outs, loss_arr, gl, new_s, new_key = \
+                    self._remat_microbatch_grads(dev, order, svals_c,
+                                                 key_c, mb_arrays,
+                                                 remat_pol)
+            else:
+                outs, loss_arr, pairs, new_s, new_key = \
+                    self._run_accum_microbatch(dev, svals_c, key_c,
+                                               mb_arrays)
+                gd = {id(p): (g.data if isinstance(g, Tensor) else g)
+                      for p, g in pairs}
+                if sorted(gd) != sorted(ids):
+                    raise RuntimeError(
+                        "gradient accumulation: the (param, grad) set "
+                        "changed between the discovery pass and the "
+                        "scan body")
+                gl = [gd[i] for i in ids]
             # same sequential fp32 sum as the eager adder
             # (_accum_add_fn) — the two modes accumulate
             # bit-identically
-            acc = [a + gd[i].astype(jnp.float32)
-                   for a, i in zip(acc, ids)]
+            acc = [a + g.astype(jnp.float32)
+                   for a, g in zip(acc, gl)]
             loss_acc = loss_acc + jnp.mean(loss_arr).astype(
                 jnp.float32)
             return (tuple(new_s), new_key, acc, loss_acc), outs
 
         carry0 = (tuple(svals_init), key_init, acc0,
                   jnp.zeros((), jnp.float32))
+        if micro and int(micro[0].shape[0]) == 1:
+            # Length-1 "scan" (the remat-policy reroute of a
+            # non-accumulated step): run the body once inline — no
+            # while loop in the HLO, so the entry-level byte/peak
+            # meters stay sighted on the step's real internals.
+            carry, outs = body(carry0, [m[0] for m in micro])
+            outs = jax.tree_util.tree_map(
+                lambda a: jnp.asarray(a)[None], outs)
+            return carry, outs
         return jax.lax.scan(body, carry0, micro)
 
     def _accum_step(self, n, pvals, svals, ovals, key, step_counter,
@@ -1312,11 +1419,20 @@ class _JitStep:
                 st.setdefault("m", zeros("m", p))
                 st.setdefault("v", zeros("v", p))
 
-    def lowered_text(self, *batch) -> str:
-        """Optimized HLO text of the compiled train step for these
-        batch shapes (no execution, no donation hazard — .lower() only
-        reads shapes). Feeds `hlo_profile.bytes_accessed`, the
-        CPU-verifiable byte-diet meter."""
+    def lowered_text(self, *batch, optimized: bool = True) -> str:
+        """HLO text of the compiled train step for these batch shapes
+        (no execution, no donation hazard — .lower() only reads
+        shapes). `optimized=True` (default) returns the
+        post-optimization text — the input to
+        `hlo_profile.bytes_accessed`, the CPU-verifiable byte-diet
+        meter. `optimized=False` returns the PRE-optimization HLO
+        (`dialect="hlo"`, no XLA compile paid): the text where
+        `jax.checkpoint`'s optimization barriers still stand — the
+        CPU backend's cleanup passes CSE the remat recompute away
+        post-optimization (CPU has no HBM to save), so the remat
+        knob's liveness effect (`hlo_profile.peak_bytes_estimate`) is
+        only honest pre-optimization, which is also the program the
+        TPU compiler (which honors the barriers) actually sees."""
         batch_arrays = tuple(
             b.data if isinstance(b, Tensor) else b for b in batch
         )
@@ -1330,9 +1446,12 @@ class _JitStep:
         pvals, svals, ovals, key, batch_arrays = self._prepare_inputs(
             pvals, svals, ovals, dev._rng_key, batch_arrays
         )
-        return self._compiled.lower(
+        lowered = self._compiled.lower(
             pvals, svals, ovals, key, step, batch_arrays
-        ).compile().as_text()
+        )
+        if not optimized:
+            return lowered.as_text(dialect="hlo")
+        return lowered.compile().as_text()
 
     # ---- AOT export cache (ISSUE 6) --------------------------------------
     def _export_kind(self) -> str:

@@ -183,6 +183,7 @@ class Optimizer:
         # or the scan-fused graph step) sums the grads in fp32 and
         # applies once via apply_accumulated.
         self._accum_capture = None
+        self._accum_skip_backward = False
 
     def set_clip_norm(self, value: Optional[float]):
         """Clip gradients to `value` by global L2 norm (None = off)."""
@@ -606,17 +607,25 @@ class Optimizer:
         return self.backward_and_update(loss)
 
     # -- gradient-accumulation capture (ISSUE 4) ---------------------------
-    def _accum_begin(self) -> None:
+    def _accum_begin(self, skip_backward: bool = False) -> None:
         """Arm capture mode: subsequent `backward_and_update` calls
         stash their (loss, pairs) instead of applying. Used by the
         accumulation drivers (Model's eager microbatch loop and the
-        scan-fused graph step); always paired with `_accum_end`."""
+        scan-fused graph step); always paired with `_accum_end`.
+
+        `skip_backward=True` (the scan-level remat path, ISSUE 9)
+        stashes `(loss, None)` WITHOUT running the framework backward
+        at all: the caller derives gradients itself via `jax.vjp` over
+        the checkpointed forward region, so tracing the per-op walk
+        here would be dead weight the compiler has to DCE."""
         self._accum_capture = []
+        self._accum_skip_backward = bool(skip_backward)
 
     def _accum_end(self):
         """Disarm capture mode and return the captured list of
         (loss, pairs) tuples (one per backward that ran)."""
         cap, self._accum_capture = self._accum_capture, None
+        self._accum_skip_backward = False
         return cap
 
     def apply_accumulated(self, loss_sum, acc_pairs, n_total: int):
@@ -686,6 +695,13 @@ class Optimizer:
         deferred: (loss, pairs) is stashed for `apply_accumulated`
         and neither the optimizer step counter nor the guard state
         advances here."""
+        if (self._accum_capture is not None
+                and getattr(self, "_accum_skip_backward", False)):
+            # scan-level remat capture: the caller owns the backward
+            # (jax.vjp over the checkpointed region) — record only
+            # that ONE backward_and_update fired and hand the loss back
+            self._accum_capture.append((loss, None))
+            return loss
         guard = resilience.guard_active()
         dy = None
         if guard and resilience.scaler_active():

@@ -549,6 +549,15 @@ class ServingEngine:
         cfg = get_config()
         res = get_resilience_config()
         self.model = model
+        # Tuned-config default load (ISSUE 9): when the autotuner's
+        # store (SINGA_TPU_TUNED_STORE / .tuned/) holds a best-known
+        # config for this model's topology fingerprint, arm its
+        # FORWARD-SAFE subset (BN-stats floor, pallas block envs —
+        # never training geometry) before any request traces. A
+        # missing store is a silent no-op; a hit logs one stderr line.
+        from . import tuning
+
+        self.tuned = tuning.apply_best_for_serving(model)
         self.max_batch = int(max_batch if max_batch is not None
                              else cfg["max_batch"])
         self.max_wait_s = float(max_wait_ms if max_wait_ms is not None

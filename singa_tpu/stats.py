@@ -46,6 +46,8 @@ __all__ = [
     "dag_auto_flops_per_op",
     "count_train_step",
     "grad_accum_n",
+    "remat_policy",
+    "REMAT_POLICIES",
     "note_accum_build",
     "count_accum_step",
 ]
@@ -90,6 +92,24 @@ _CONFIG: Dict = {
     # min_scale} (normalized by configure). Setter:
     # device.set_loss_scaling.
     "loss_scaling": None,
+    # Scan-level rematerialization policy (ISSUE 9): None = off, else
+    # a named jax.checkpoint policy ("dots_saveable",
+    # "nothing_saveable", "everything_saveable",
+    # "dots_with_no_batch_dims_saveable") or a
+    # ("save_anything_but_these_names", [names...]) pair. When armed,
+    # the graph-mode step derives each microbatch's gradients from
+    # `jax.vjp` over the WHOLE forward+loss region wrapped in
+    # `jax.checkpoint(policy=...)` — inside `_JitStep._accum_step`'s
+    # lax.scan body (and, with grad_accum off, the step body runs as
+    # one microbatch) — so XLA recomputes non-saveable activations in
+    # the backward instead of keeping them live across the fwd→bwd
+    # boundary. Composes with the per-op `autograd.set_remat` (which
+    # checkpoints individual op fns) and with grad accumulation (fp32
+    # accumulation preserved). Eager mode ignores it (there is no
+    # compiled program whose liveness it could shape). Read at
+    # executable build time: re-`compile()` after toggling. Setter:
+    # device.set_remat_policy.
+    "remat_policy": None,
     # Microbatched gradient accumulation (ISSUE 4): the compiled train
     # step reshapes its batch to [n, mb, ...] and lax.scans the
     # forward/backward over microbatches, accumulating gradients in
@@ -144,6 +164,8 @@ def configure(**kw) -> Dict:
             v = int(v)
             if v < 1:
                 raise ValueError("grad_accum must be >= 1")
+        elif k == "remat_policy":
+            v = _normalize_remat_policy(v)
         elif k == "loss_scaling":
             if v is not None:
                 if not isinstance(v, dict):
@@ -184,6 +206,48 @@ def configure(**kw) -> Dict:
 
 def get_config() -> Dict:
     return dict(_CONFIG)
+
+
+# Named jax.checkpoint policies the remat knob accepts. Kept here (no
+# jax import) so config validation, the export-cache key, and the
+# autotuner knob space all agree on one list; model._checkpoint_policy
+# resolves names to the jax callables at build time.
+REMAT_POLICIES = (
+    "nothing_saveable",
+    "dots_saveable",
+    "dots_with_no_batch_dims_saveable",
+    "everything_saveable",
+)
+
+
+def _normalize_remat_policy(v):
+    """None | named policy | ("save_anything_but_these_names",
+    [names...]). Off-spellings (False, "off") normalize to None; a
+    typo'd policy raises here, at configure time, instead of silently
+    never engaging."""
+    if v is None or v is False or v == "off":
+        return None
+    if isinstance(v, str):
+        if v not in REMAT_POLICIES:
+            raise ValueError(
+                f"unknown remat policy {v!r}; known: "
+                f"{sorted(REMAT_POLICIES)} or "
+                "('save_anything_but_these_names', [names...])")
+        return v
+    if (isinstance(v, (tuple, list)) and len(v) == 2
+            and v[0] == "save_anything_but_these_names"
+            and isinstance(v[1], (tuple, list))
+            and all(isinstance(n, str) for n in v[1])):
+        return (v[0], tuple(v[1]))
+    raise ValueError(
+        f"remat policy must be None, one of {sorted(REMAT_POLICIES)}, "
+        "or ('save_anything_but_these_names', [names...]); got "
+        f"{v!r}")
+
+
+def remat_policy():
+    """Scan-level remat policy (None = off; see configure)."""
+    return _CONFIG["remat_policy"]
 
 
 def donation_enabled() -> bool:

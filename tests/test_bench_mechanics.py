@@ -14,8 +14,8 @@ import sys
 _ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 
 
-def _run_stage(args, timeout=240):
-    env = dict(os.environ, BENCH_PLATFORM="cpu")
+def _run_stage(args, timeout=240, extra_env=None):
+    env = dict(os.environ, BENCH_PLATFORM="cpu", **(extra_env or {}))
     proc = subprocess.run(
         [sys.executable, os.path.join(_ROOT, "bench.py")] + args,
         capture_output=True, text=True, timeout=timeout, env=env,
@@ -460,3 +460,65 @@ def test_eager_overhead_emits_stats_line_and_final_json():
     assert ws["serve_cold_first_reply_s"] > 0
     assert ws["serve_warm_first_reply_s"] > 0
     assert "serve_warm_speedup" in ws
+
+
+def test_resnet_tuned_stage_loads_persisted_config(tmp_path):
+    """ISSUE 9: `bench.py --stage resnet --tuned` loads the
+    autotuner's persisted best-known config end-to-end on CPU — the
+    tuned knobs actually arm (accum geometry in the result), and the
+    result JSON carries `tuned_config` + its provenance."""
+    from singa_tpu import tuning
+
+    store = str(tmp_path / "tuned.json")
+    tuning.TunedStore(store).put(
+        "fp-test", "v5e",
+        {"slot_dtype": "bfloat16", "grad_accum": 2},
+        999.0, provenance={"source": "cost-model"}, alias="resnet")
+    proc, result = _run_stage(
+        ["--stage", "resnet", "--batch", "4", "--steps", "1",
+         "--image-size", "24", "--tuned", "--deadline", "150"],
+        timeout=300, extra_env={"SINGA_TPU_TUNED_STORE": store})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert result is not None and result["ok"] is True
+    assert result["tuned_config"] == {"slot_dtype": "bfloat16",
+                                      "grad_accum": 2}
+    assert result["accum"] == 2 and result["slot_dtype"] == "bfloat16"
+    prov = result["tuned_provenance"]
+    assert prov["score"] == 999.0 and prov["source"] == "cost-model"
+    # explicit CLI flags outrank the store: an empty store degrades
+    # loudly to defaults (no tuned_config key), never crashes
+    proc2, result2 = _run_stage(
+        ["--stage", "resnet", "--batch", "4", "--steps", "1",
+         "--image-size", "24", "--tuned", "--deadline", "150"],
+        timeout=300,
+        extra_env={"SINGA_TPU_TUNED_STORE": str(tmp_path / "no.json")})
+    assert proc2.returncode == 0, proc2.stderr[-2000:]
+    assert result2["ok"] is True and "tuned_config" not in result2
+    # both runs emit a MEASURED-score record for their effective
+    # config — the --metrics-jsonl feedback loop's source
+    assert result["measured_config_jsonl"]
+    assert result2["measured_config_jsonl"]
+
+
+def test_fold_onchip_renders_tuned_marker(tmp_path, capsys,
+                                          monkeypatch):
+    """ISSUE 9: tools/fold_onchip.py marks autotuned rows `tuned=✓`;
+    old logs (no `tuned_config` key) render unchanged."""
+    fold = _load_module("fold_onchip_for_test", "tools/fold_onchip.py")
+    logs = tmp_path / "onchip_logs"
+    logs.mkdir()
+    (logs / "resnet_tuned.out").write_text(json.dumps(
+        {"ok": True, "ips": 2100.0, "step_ms": 60.9, "batch": 128,
+         "precision": "bf16",
+         "tuned_config": {"slot_dtype": "bfloat16"},
+         "tuned_provenance": {"score": 2500.0}}) + "\n")
+    (logs / "resnet_old.out").write_text(json.dumps(
+        {"ok": True, "ips": 900.0, "step_ms": 142.2, "batch": 128,
+         "precision": "fp32"}) + "\n")
+    monkeypatch.setattr(fold, "LOGS", str(logs))
+    assert fold.main() == 0
+    out = capsys.readouterr().out
+    tuned_line = [ln for ln in out.splitlines() if "2100.0" in ln][0]
+    assert "tuned=✓" in tuned_line
+    old_line = [ln for ln in out.splitlines() if "900.0" in ln][0]
+    assert "tuned" not in old_line

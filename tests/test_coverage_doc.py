@@ -120,6 +120,28 @@ def test_serving_resilience_row_and_readme_section_present():
         assert state in readme, state
 
 
+def test_autotune_row_and_readme_sections_present():
+    """ISSUE 9 doc contract: the P19 autotuner row and the README
+    "Autotuning" + "Remat policies" sections exist (path rot in
+    either is caught by test_all_cited_paths_exist)."""
+    cov = open(os.path.join(_ROOT, "COVERAGE.md")).read()
+    assert "| P19 |" in cov
+    assert "singa_tpu/tuning.py" in cov
+    assert "tools/autotune.py" in cov
+    assert "tests/test_autotune.py" in cov
+    assert "tests/test_remat_policy.py" in cov
+    readme = open(os.path.join(_ROOT, "README.md")).read()
+    assert "## Autotuning" in readme
+    assert "## Remat policies" in readme
+    assert "set_remat_policy" in readme
+    assert "peak_bytes_estimate" in readme
+    assert "--tuned" in readme
+    assert "SINGA_TPU_TUNED_STORE" in readme
+    for policy in ("dots_saveable", "nothing_saveable",
+                   "save_anything_but_these_names"):
+        assert policy in readme, policy
+
+
 def test_all_cited_paths_exist():
     text = open(os.path.join(_ROOT, "COVERAGE.md")).read()
     missing = []

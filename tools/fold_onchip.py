@@ -134,6 +134,11 @@ def main():
             # EFFECTIVE batch; show the scan geometry alongside
             if r.get("accum", 1) != 1:
                 diet += f", accum=x{r['accum']}(mb{r['microbatch']})"
+            # autotuned row (ISSUE 9): the config came from the tuned
+            # store, not hand-queued flags; old logs (no key) render
+            # unchanged
+            if r.get("tuned_config") is not None:
+                diet += ", tuned=✓"
             diet += _stage_breakdown(r)
             rows.append((stage,
                          f"{r['ips']:.1f} img/s  ({r['step_ms']:.1f} "

@@ -22,6 +22,66 @@
 #                                      ServingEngine's MetricsLogger
 #                                      stream carries.
 
+#   tools/tpu_watch.sh tune [DIR]      tail the NEWEST autotune search
+#                                      JSONL under DIR (default:
+#                                      ./metrics, where tools/autotune.py
+#                                      streams candidates) and print one
+#                                      pretty line per scored config —
+#                                      live search telemetry.
+
+if [ "$1" = "tune" ]; then
+  dir=${2:-metrics}
+  f=$(ls -t "$dir"/*autotune*.jsonl 2>/dev/null | head -1)
+  if [ -z "$f" ]; then
+    echo "tpu_watch: no autotune JSONL under $dir/ yet" >&2
+    exit 1
+  fi
+  echo "tpu_watch: tailing $f" >&2
+  tail -n +1 -F "$f" | python3 -u -c '
+import json, sys
+
+def fmt(v, nd=1):
+    if v is None:
+        return "-"
+    return str(round(v, nd))
+
+def human(b):
+    if b is None:
+        return "-"
+    for unit in ("B", "KB", "MB", "GB"):
+        if b < 1024:
+            return f"{b:.0f}{unit}"
+        b /= 1024.0
+    return f"{b:.1f}TB"
+
+for line in sys.stdin:
+    line = line.strip()
+    if not line:
+        continue
+    try:
+        r = json.loads(line)
+    except ValueError:
+        continue  # partial trailing line from a killed writer
+    if not isinstance(r, dict) or "config" not in r:
+        continue
+    cfg = r.get("config") or {}
+    nd = " ".join(f"{k}={v}" for k, v in sorted(cfg.items())
+                  if v not in (None, "default", 1))
+    bits = [
+        "cand " + str(r.get("i", "?")).rjust(3),
+        "score " + fmt(r.get("score")).rjust(10),
+        "bytes " + human(r.get("bytes")),
+        "peak " + human(r.get("peak_bytes")),
+        ("cached" if r.get("cached") else r.get("source", "?")),
+    ]
+    if not r.get("feasible", True):
+        bits.append("INFEASIBLE")
+    bits.append(nd or "default")
+    print("  ".join(bits))
+'
+  exit $?
+fi
+
 if [ "$1" = "serve" ]; then
   dir=${2:-metrics}
   # serving streams are tagged *serve*; fall back to the newest JSONL
