@@ -1965,6 +1965,143 @@ def attention(q, k, v, causal=True, scale=None, mesh=None, axis_name="seq"):
     return Attention(causal, scale, mesh, axis_name)(q, k, v)
 
 
+class MoEFFN(Operator):
+    """Top-1 mixture-of-experts FFN (ISSUE 10) — the GShard recipe of
+    `parallel/moe.py` as a registry op: (x, gate, w1, b1, w2, b2) ->
+    (y, aux_loss, dropped_frac). Backward comes from `jax.vjp` through
+    the dense dispatch/combine einsums; `dropped_frac` is
+    `stop_gradient`ed (a pure stat) and its cotangent is always zero.
+    With a mesh carrying an "expert" axis (>1), the expert dim of the
+    dispatched tensors is sharding-constrained so GSPMD partitions
+    expert compute across chips (all-to-all on dispatch/combine) —
+    engaged only under tracing, the `Attention` mesh contract. The
+    process knob `stats.moe_capacity_factor` (the autotuner's axis)
+    overrides `capacity_factor` at trace time."""
+
+    def __init__(self, capacity_factor: float = 1.25, mesh=None,
+                 axis_name: str = "expert"):
+        super().__init__()
+        self.capacity_factor = capacity_factor
+        self.mesh = mesh
+        self.axis_name = axis_name
+
+    def forward(self, *xs):
+        self._use_mesh = (
+            self.mesh is not None
+            and self.mesh.shape.get(self.axis_name, 1) > 1
+            and any(isinstance(x, jax.core.Tracer) for x in xs))
+        return super().forward(*xs)
+
+    def fn(self, x, gate_w, w1, b1, w2, b2):
+        from .parallel import moe as moe_mod
+
+        cf = stats_mod.moe_capacity_factor() or self.capacity_factor
+        params = moe_mod.MoEParams(gate_w, w1, b1, w2, b2)
+        mesh = self.mesh if self._use_mesh else None
+        if mesh is not None:
+            stats_mod.note_collective(self.axis_name,
+                                      "sharding_constraint", 2)
+        t = 1
+        for d in x.shape[:-1]:
+            t *= int(d)
+        e = int(gate_w.shape[-1])
+        stats_mod.note_moe_build(
+            e, max(1, math.ceil(t / e * cf)), cf)
+        return moe_mod.moe_ffn(params, x, capacity_factor=cf,
+                               mesh=mesh, axis_name=self.axis_name,
+                               with_stats=True)
+
+
+def moe_ffn(x, gate_w, w1, b1, w2, b2, capacity_factor=1.25, mesh=None,
+            axis_name="expert"):
+    """(y, aux_loss, dropped_frac) — see `MoEFFN`."""
+    return MoEFFN(capacity_factor, mesh, axis_name)(
+        x, gate_w, w1, b1, w2, b2)
+
+
+class PipelineApply(Operator):
+    """Stage-stacked pipeline composition (ISSUE 10): (x, *stacked
+    param leaves) -> y where y = stage_{P-1}(...stage_0(x)), run as a
+    1F1B (default) or GPipe schedule over the mesh's "pipe" axis when
+    one is in play (engaged only under tracing, the `Attention` mesh
+    contract), else as the bit-identical sequential composition —
+    eager steps, single-device graphs, and the compile-time lazy-init
+    forward all take that path. Backward comes from `jax.vjp`: through
+    the schedule's custom vjp (1F1B) / the shard_map scan (GPipe), or
+    plainly through the sequential loop."""
+
+    def __init__(self, stage_fn, leaf_names, num_stages: int,
+                 mesh=None, axis_name: str = "pipe",
+                 microbatches=None, schedule: str = "1f1b",
+                 batch_axis=None):
+        super().__init__()
+        self.stage_fn = stage_fn
+        self.leaf_names = tuple(leaf_names)
+        self.num_stages = int(num_stages)
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.microbatches = microbatches
+        self.schedule = schedule
+        self.batch_axis = batch_axis
+
+    def forward(self, *xs):
+        self._use_pipe = (
+            self.mesh is not None
+            and self.mesh.shape.get(self.axis_name, 1) > 1
+            and any(isinstance(x, jax.core.Tracer) for x in xs))
+        return super().forward(*xs)
+
+    def fn(self, x, *leaves):
+        params = dict(zip(self.leaf_names, leaves))
+        if self._use_pipe:
+            from .parallel.pipeline import pipeline_apply
+
+            batch_axis = self.batch_axis
+            if batch_axis is None and "data" in self.mesh.shape:
+                batch_axis = "data"
+            pipe = self.mesh.shape[self.axis_name]
+            dp = (self.mesh.shape[batch_axis]
+                  if batch_axis in self.mesh.shape else 1)
+            m = (stats_mod.pipeline_microbatches()
+                 or self.microbatches or pipe)
+            if (int(x.shape[0]) % (int(m) * dp) == 0
+                    and self.num_stages % pipe == 0):
+                # Stage folding: with S stages over P < S pipe chips,
+                # chip i holds the k = S/P consecutive stages
+                # [i*k, (i+1)*k) and applies them back-to-back per
+                # tick — leaves reshape [S, ...] -> [P, k, ...] and
+                # the per-chip stage_fn loops its k sub-stages. k == 1
+                # is the plain one-stage-per-chip layout.
+                k = self.num_stages // pipe
+                stage_fn = self.stage_fn
+                if k > 1:
+                    params = {nm: v.reshape((pipe, k) + v.shape[1:])
+                              for nm, v in params.items()}
+                    user_fn = self.stage_fn
+
+                    def stage_fn(p, h):
+                        for j in range(k):
+                            h = user_fn(
+                                {nm: v[j] for nm, v in p.items()}, h)
+                        return h
+                return pipeline_apply(
+                    stage_fn, params, x, self.mesh,
+                    axis_name=self.axis_name,
+                    microbatches=self.microbatches,
+                    schedule=self.schedule, batch_axis=batch_axis)
+            # batch cannot split (e.g. the batch-1 lazy-init forward)
+            # or stages don't fold onto the pipe axis: fall through to
+            # the sequential composition — same math, no schedule
+        # sequential reference composition — same math, same dtype
+        # path, so the pipelined and plain steps are bit-comparable on
+        # exact-arithmetic data
+        h = x
+        for s in range(self.num_stages):
+            h = self.stage_fn(
+                {k: v[s] for k, v in params.items()}, h)
+        return h
+
+
 def gather(x, indices, axis=0):
     return Gather(axis, indices)(x)
 

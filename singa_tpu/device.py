@@ -65,6 +65,9 @@ __all__ = [
     "set_loss_scaling",
     # Microbatched gradient accumulation (ISSUE 4).
     "set_grad_accum",
+    # Multi-axis parallel trainer (ISSUE 10; parallel.plan owns the
+    # state).
+    "set_parallel_plan",
     # Scan-level rematerialization policy (ISSUE 9; singa_tpu.stats
     # owns the state, model._JitStep reads it at build time).
     "set_remat_policy",
@@ -513,6 +516,32 @@ def set_grad_accum(n: int) -> None:
     from . import stats
 
     stats.configure(grad_accum=n)
+
+
+def set_parallel_plan(plan=None, **axes) -> None:
+    """Process-default `parallel.ParallelPlan` (ISSUE 10): the
+    multi-axis geometry `Model.compile` adopts when called without
+    `mesh`/`plan`. Pass a plan object, axis sizes
+    (`set_parallel_plan(data=4, pipe=2)` builds one — extra keywords
+    `pipeline_microbatches`/`pipeline_schedule`/`moe_capacity_factor`
+    carry the policy), or nothing to clear. With a plan armed, a bare
+    `compile(..., use_graph=True)` trains as one SPMD program over
+    the plan's mesh: tensor-parallel layers under the GSPMD rules,
+    `PipelineStack` stages on the "pipe" axis (1F1B schedule),
+    `MoE` experts on the "expert" axis — composed with grad-accum,
+    the step guard, and the loss scaler exactly like the DP path.
+    Read at compile time: re-`compile()` after toggling (the
+    `set_grad_accum` contract). Counters:
+    `cache_stats()["parallel"]`."""
+    from .parallel import plan as plan_mod
+
+    if plan is not None and axes:
+        raise ValueError(
+            "set_parallel_plan: pass a ParallelPlan OR axis sizes, "
+            "not both")
+    if plan is None and axes:
+        plan = plan_mod.ParallelPlan(**axes)
+    plan_mod.set_process_plan(plan)
 
 
 def set_remat_policy(policy, *names) -> None:

@@ -365,7 +365,23 @@ def knob_fingerprint() -> Dict:
         "compute_dtype": str(tensor.get_compute_dtype()),
         "matmul_precision": tensor.get_matmul_precision(),
         "xla_profile": device.get_xla_profile(),
+        # Multi-axis trainer knobs (ISSUE 10): the process-default
+        # ParallelPlan selects mesh/schedule at compile time, and the
+        # pipeline-microbatch / MoE-capacity overrides change the
+        # traced schedule geometry — all three must orphan artifacts
+        # on flip (a per-model compile(plan=...) rides the sharded
+        # step's extras instead).
+        "parallel_plan": _scalarize(_process_plan_fp()),
+        "pipeline_microbatches": cfg.get("pipeline_microbatches"),
+        "moe_capacity_factor": cfg.get("moe_capacity_factor"),
     }
+
+
+def _process_plan_fp():
+    from .parallel import plan as plan_mod
+
+    p = plan_mod.process_plan()
+    return None if p is None else p.fingerprint()
 
 
 def _args_signature(args) -> Dict:

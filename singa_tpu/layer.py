@@ -503,3 +503,233 @@ class Sequential(Layer):
         for l in self._seq:
             x = l(x)
         return x
+
+
+class MoE(Layer):
+    """Trainable top-1 mixture-of-experts FFN (ISSUE 10; no reference
+    equivalent — the GShard recipe of `parallel/moe.py` as a first-
+    class layer). Params: replicated router `gate` (D, E) plus
+    expert-stacked `w1`/`b1`/`w2`/`b2` whose leading expert dim the
+    default sharding rules place on the mesh's "expert" axis, so a
+    `ParallelPlan(expert=n)` shards expert compute across chips with
+    GSPMD inserting the dispatch/combine all-to-alls.
+
+    The auxiliary load-balancing loss of the LAST forward is exposed
+    as `self.aux_loss` (a Tensor; add `aux_weight * layer.aux_loss`
+    into the training loss — gradients flow through the router).
+    BN-style state: `dropped_frac` holds an exponential moving average
+    of the fraction of tokens dropped by expert-capacity overflow,
+    updated only in training mode and captured as a program output in
+    graph mode exactly like BatchNorm running stats.
+
+    `capacity_factor=None` defers to the compile-time plan
+    (`ParallelPlan.moe_capacity_factor`, default 1.25); the process
+    knob `stats.moe_capacity_factor` — the autotuner's axis —
+    overrides both at trace time."""
+
+    def __init__(self, num_experts: int, d_ff: int,
+                 capacity_factor: Optional[float] = None,
+                 momentum: float = 0.9, mesh=None,
+                 axis_name: str = "expert", name=None):
+        super().__init__(name)
+        self.num_experts = int(num_experts)
+        self.d_ff = int(d_ff)
+        self.capacity_factor = capacity_factor
+        self.momentum = float(momentum)
+        self.mesh = mesh
+        self.axis_name = axis_name
+        # which attrs the USER pinned at construction: plan wiring
+        # only fills the others, and a RE-compile with a different
+        # plan re-fills them (the set_grad_accum re-compile contract
+        # — first-plan values must not stick)
+        self._own_mesh = mesh is not None
+        self._own_cf = capacity_factor is not None
+
+    def _apply_plan(self, plan, mesh):
+        if not self._own_mesh:
+            self.mesh = mesh
+        if not self._own_cf:
+            self.capacity_factor = plan.moe_capacity_factor
+
+    def initialize(self, x: Tensor):
+        d = x.shape[-1]
+        e, f = self.num_experts, self.d_ff
+        s1, s2 = 1.0 / np.sqrt(d), 1.0 / np.sqrt(f)
+        gate = Tensor((d, e), device=x.device)
+        initializer.gaussian(gate, 0.0, s1)
+        self.register_param("gate", gate)
+        w1 = Tensor((e, d, f), device=x.device)
+        initializer.gaussian(w1, 0.0, s1)
+        self.register_param("w1", w1)
+        b1 = Tensor((e, f), device=x.device)
+        b1.set_value(0.0)
+        self.register_param("b1", b1)
+        w2 = Tensor((e, f, d), device=x.device)
+        initializer.gaussian(w2, 0.0, s2)
+        self.register_param("w2", w2)
+        b2 = Tensor((e, d), device=x.device)
+        b2.set_value(0.0)
+        self.register_param("b2", b2)
+        df = Tensor((), device=x.device)
+        df.set_value(0.0)
+        self.register_state("dropped_frac", df)
+
+    def forward(self, x: Tensor):
+        import jax
+
+        cf = self.capacity_factor if self.capacity_factor else 1.25
+        y, aux, dropped = autograd.moe_ffn(
+            x, self.gate, self.w1, self.b1, self.w2, self.b2,
+            capacity_factor=cf, mesh=self.mesh,
+            axis_name=self.axis_name)
+        self.aux_loss = aux
+        if autograd.training:
+            # BN-style EMA rebind (raw arrays — state is non-grad; in
+            # graph mode the new value is captured as a program
+            # output, the BatchNorm contract)
+            import jax.numpy as jnp
+
+            m = self.momentum
+            old = jnp.asarray(self.dropped_frac.data)
+            new = ((1.0 - m) * old
+                   + m * jnp.asarray(dropped.data).astype(old.dtype))
+            self.dropped_frac.data = new
+            from . import stats as stats_mod
+
+            if not isinstance(dropped.data, jax.core.Tracer):
+                stats_mod.note_moe_dropped(float(dropped.data))
+        return y
+
+
+class PipelineStack(Layer):
+    """Homogeneous stack of pipeline stages (ISSUE 10; no reference
+    equivalent). Holds P stages' parameters STACKED on a leading
+    stage dim (registered as `stage_<leaf>` params, which the default
+    sharding rules place on the mesh's "pipe" axis — chip i holds
+    stage i), and runs `y = stage_{P-1}(...stage_0(x))`:
+
+      * under a mesh whose "pipe" axis is >1 (a `ParallelPlan` with
+        `pipe=n`): as a 1F1B (default) or GPipe schedule inside the
+        compiled step (`parallel/pipeline.py`), microbatches threaded
+        from the plan / the process knob;
+      * otherwise (eager steps, single-device graphs, the lazy-init
+        forward): as the bit-identical sequential composition.
+
+    `stage_fn(params_dict, h) -> h` must be pure jax with output
+    shape == input shape (homogeneous pipeline);
+    `init_stage(key, x_shape) -> {leaf: array}` draws one stage's
+    parameters from a PRNG key. `PipelineStack.mlp(...)` builds the
+    canonical residual-GELU-MLP block stack."""
+
+    def __init__(self, num_stages: int, stage_fn, init_stage, *,
+                 mesh=None, axis_name: str = "pipe",
+                 microbatches: Optional[int] = None,
+                 schedule: Optional[str] = None, batch_axis=None,
+                 name=None):
+        super().__init__(name)
+        self.num_stages = int(num_stages)
+        if self.num_stages < 1:
+            raise ValueError("PipelineStack needs num_stages >= 1")
+        self._stage_fn = stage_fn
+        self._init_stage = init_stage
+        # stage_fn identity as a SCALAR config attr: the topology
+        # fingerprint only hashes scalar layer config, and two stacks
+        # with different stage math but identical param shapes must
+        # never share an AOT artifact. Bytecode alone is NOT enough —
+        # constants live in co_consts and factory-captured values in
+        # closure cells (two `lambda p, h: h + c * (h @ p['W'])` with
+        # different c share co_code) — so fold both in via the
+        # export-cache scalarizer.
+        import hashlib
+        import json as _json
+
+        from . import export_cache as _ec
+
+        cells = []
+        for c in getattr(stage_fn, "__closure__", None) or ():
+            try:
+                cells.append(_ec._scalarize(c.cell_contents, 1))
+            except Exception:
+                cells.append(type(c.cell_contents).__name__)
+        self._stage_fn_id = hashlib.sha256(_json.dumps(
+            [_ec._scalarize(stage_fn), cells], sort_keys=True,
+            default=str).encode()).hexdigest()[:16]
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.microbatches = microbatches
+        self.schedule = schedule
+        self.batch_axis = batch_axis
+        # user-pinned ctor attrs (see MoE._apply_plan): plan wiring
+        # fills the rest and RE-fills them on re-compile with a
+        # different plan
+        self._own_mesh = mesh is not None
+        self._own_mb = microbatches is not None
+        self._own_schedule = schedule is not None
+
+    def _apply_plan(self, plan, mesh):
+        if not self._own_mesh:
+            self.mesh = mesh
+        if not self._own_mb:
+            self.microbatches = plan.pipeline_microbatches
+        if not self._own_schedule:
+            self.schedule = plan.pipeline_schedule
+
+    @classmethod
+    def mlp(cls, num_stages: int, d_ff: Optional[int] = None, **kw):
+        """Residual pre-activation GELU MLP blocks:
+        h + gelu(h W1 + b1) W2 + b2, with d_ff defaulting to 2*d."""
+        import jax
+        import jax.numpy as jnp
+
+        def stage_fn(p, h):
+            return h + jax.nn.gelu(h @ p["W1"] + p["b1"]) @ p["W2"] \
+                + p["b2"]
+
+        def init_stage(key, x_shape):
+            d = int(x_shape[-1])
+            f = d_ff or 2 * d
+            k1, k2 = jax.random.split(key)
+            s1, s2 = 1.0 / np.sqrt(d), 1.0 / np.sqrt(f)
+            return {
+                "W1": (jax.random.normal(k1, (d, f)) * s1
+                       ).astype(jnp.float32),
+                "b1": jnp.zeros((f,), jnp.float32),
+                "W2": (jax.random.normal(k2, (f, d)) * s2
+                       ).astype(jnp.float32),
+                "b2": jnp.zeros((d,), jnp.float32),
+            }
+
+        return cls(num_stages, stage_fn, init_stage, **kw)
+
+    def initialize(self, x: Tensor):
+        import jax
+        import jax.numpy as jnp
+
+        dev = x.device
+        # compile-time eval: init draws from CONCRETE keys even under
+        # the eval_shape init forward (device.next_key's contract), so
+        # no tracer can leak into the registered params
+        with jax.ensure_compile_time_eval():
+            per_stage = []
+            for _ in range(self.num_stages):
+                per_stage.append(
+                    self._init_stage(dev.next_key(), tuple(x.shape)))
+            names = sorted(per_stage[0])
+            stacks = {nm: jnp.stack([jnp.asarray(st[nm])
+                                     for st in per_stage])
+                      for nm in names}
+        for nm in names:
+            t = tensor_mod.from_raw(stacks[nm], dev)
+            self.register_param(f"stage_{nm}", t)
+        self._leaf_names = tuple(names)
+
+    def forward(self, x: Tensor):
+        leaves = [getattr(self, f"stage_{nm}")
+                  for nm in self._leaf_names]
+        op = autograd.PipelineApply(
+            self._stage_fn, self._leaf_names, self.num_stages,
+            mesh=self.mesh, axis_name=self.axis_name,
+            microbatches=self.microbatches,
+            schedule=self.schedule or "1f1b",
+            batch_axis=self.batch_axis)
+        return op(x, *leaves)

@@ -49,6 +49,7 @@ class Model(Layer):
         self._jit_fwd = None
         self._use_graph = False
         self._mesh = self._rules = self._batch_specs = None
+        self._plan = None
         # Per-model gradient-accumulation override (None = defer to
         # the process knob, device.set_grad_accum / stats config).
         self._grad_accum = None
@@ -65,7 +66,7 @@ class Model(Layer):
     def compile(self, inputs: List[Tensor], is_train: bool = True,
                 use_graph: bool = False, sequential: bool = False,
                 mesh=None, rules=None, batch_specs=None,
-                grad_accum=None):
+                grad_accum=None, plan=None):
         """Reference: `Model.compile` — one tracing pass to initialize
         params (lazy shape inference), then optionally arm graph mode.
 
@@ -88,7 +89,47 @@ class Model(Layer):
         and applies the optimizer once on the mean. Batch sizes must
         divide by n. `grad_accum=1` pins accumulation OFF regardless
         of the process knob; None defers to it.
+
+        `plan` (a `parallel.ParallelPlan`, ISSUE 10) is the multi-axis
+        spelling of mesh mode: it names the mesh geometry
+        (dp x model x pipe x expert x seq), the sharding rules, and
+        the pipeline/MoE policy in one object. compile builds the
+        mesh from it, wires it into every mesh-aware layer
+        (`PipelineStack`, `MoE`, `MultiHeadAttention` — anything with
+        a `mesh` attribute left at None), and keys the AOT export
+        cache on `plan.fingerprint()`. When neither `plan` nor `mesh`
+        is given, the process default (`device.set_parallel_plan`)
+        applies.
         """
+        if plan is None and mesh is None:
+            from .parallel import plan as plan_mod
+
+            plan = plan_mod.process_plan()
+        if plan is not None:
+            if mesh is not None:
+                raise ValueError(
+                    "compile: pass either plan= or mesh=, not both "
+                    "(the plan builds its own mesh)")
+            mesh = plan.build_mesh()
+            if rules is None:
+                rules = plan.build_rules()
+            # wire the mesh + plan policy into every mesh-aware layer;
+            # a RE-compile with a different plan re-wires everything
+            # the previous plan set (layers track which attrs the
+            # user pinned vs the plan filled)
+            stack = [self]
+            while stack:
+                l = stack.pop()
+                if l is not self:
+                    if hasattr(l, "_apply_plan"):
+                        l._apply_plan(plan, mesh)
+                    elif hasattr(l, "mesh") and (
+                            l.mesh is None
+                            or getattr(l, "_mesh_from_plan", False)):
+                        l.mesh = mesh
+                        l._mesh_from_plan = True
+                stack.extend(l.sublayers.values())
+        self._plan = plan
         if grad_accum is not None:
             grad_accum = int(grad_accum)
             if grad_accum < 1:
@@ -309,7 +350,8 @@ class Model(Layer):
 
                 self._jit_step = ShardedJitStep(
                     self, self._mesh, rules=self._rules,
-                    batch_specs=self._batch_specs)
+                    batch_specs=self._batch_specs,
+                    plan=getattr(self, "_plan", None))
             else:
                 self._jit_step = _JitStep(self)
         return self._jit_step(*batch)
@@ -418,7 +460,7 @@ class Model(Layer):
     _FP_SKIP_ATTRS = frozenset({
         "_params", "_sublayers", "_state_attrs", "_initialized",
         "training", "_use_graph", "_jit_step", "_jit_fwd",
-        "_optimizer", "_mesh", "_rules", "_batch_specs",
+        "_optimizer", "_mesh", "_rules", "_batch_specs", "_plan",
     })
 
     def topology_fingerprint(self) -> str:
@@ -491,7 +533,8 @@ class Model(Layer):
 
                 self._jit_step = ShardedJitStep(
                     self, self._mesh, rules=self._rules,
-                    batch_specs=self._batch_specs)
+                    batch_specs=self._batch_specs,
+                    plan=getattr(self, "_plan", None))
             else:
                 self._jit_step = _JitStep(self)
         return self._jit_step.lowered_text(*batch, optimized=optimized)

@@ -9,9 +9,17 @@ long-context attention over the "seq" axis.
 """
 from .mesh import AXES, auto_mesh, create_mesh, default_balanced_mesh  # noqa: F401
 from .pipeline import (  # noqa: F401
+    SCHEDULES,
     pipeline_apply,
     place_stacked,
     stack_stage_params,
+)
+from .plan import (  # noqa: F401
+    ParallelPlan,
+    parse_geometry,
+    plan_from_geometry,
+    process_plan,
+    set_process_plan,
 )
 from .ring_attention import plain_attention, ring_attention  # noqa: F401
 from .sharding import (  # noqa: F401

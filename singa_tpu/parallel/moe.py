@@ -68,8 +68,13 @@ def place_moe_params(params: MoEParams, mesh: Mesh,
 
 
 def moe_ffn(params: MoEParams, x, *, capacity_factor: float = 1.25,
-            mesh: Optional[Mesh] = None, axis_name: str = "expert"):
-    """Top-1 MoE FFN. x: (..., D) -> (y, aux_loss).
+            mesh: Optional[Mesh] = None, axis_name: str = "expert",
+            with_stats: bool = False):
+    """Top-1 MoE FFN. x: (..., D) -> (y, aux_loss), or
+    (y, aux_loss, dropped_frac) with `with_stats=True` — dropped_frac
+    is the fraction of tokens that overflowed their expert's capacity
+    buffer (output 0; the load-imbalance signal the serving/bench
+    tiers report, `stop_gradient`ed so it never perturbs training).
 
     With `mesh`, the expert dim of the dispatched tensors is
     sharding-constrained to `axis_name` so GSPMD partitions expert
@@ -129,4 +134,8 @@ def moe_ffn(params: MoEParams, x, *, capacity_factor: float = 1.25,
 
     combine = (dispatch * gate_top[:, None, None]).astype(dt)
     y = jnp.einsum("tec,ecd->td", combine, ex_out)
+    if with_stats:
+        dropped = lax.stop_gradient(
+            1.0 - jnp.sum(keep.astype(jnp.float32)) / t)
+        return y.reshape(orig_shape), aux_loss, dropped
     return y.reshape(orig_shape), aux_loss

@@ -1,0 +1,166 @@
+"""ParallelPlan: the one object that names a training step's parallel
+geometry (ISSUE 10).
+
+The reference's only distribution story is pure data parallelism
+(`opt.DistOpt`, SURVEY.md §2.4); the mesh trainer grew TP/SP under
+GSPMD rules, and this object is how all the axes compose into ONE
+`Model.compile` argument:
+
+    plan = ParallelPlan(data=2, model=2, pipe=2)
+    model.compile([x], is_train=True, use_graph=True, plan=plan)
+
+A plan is geometry + policy:
+
+  * axis sizes over `mesh.AXES` (`data`/`model`/`seq`/`pipe`/
+    `expert`; 0 = unset, "data" absorbs the remainder — the
+    `auto_mesh` contract);
+  * `rules` — the `ShardingRules` table (None = `DEFAULT_RULES`,
+    which already routes Megatron TP, stage-stacked pipeline params,
+    and MoE expert params);
+  * `pipeline_microbatches` / `pipeline_schedule` — every
+    `PipelineStack` in the model defaults to these;
+  * `moe_capacity_factor` — every `MoE` layer defaults to this.
+
+`Model.compile(plan=...)` builds the mesh, wires it into every
+mesh-aware layer (anything with a `mesh` attribute left at None), and
+hands the plan to `ShardedJitStep`, whose export-cache key carries
+`plan.fingerprint()` — a plan flip can never load a stale AOT
+artifact, and flipping back re-hits.
+
+The process knob `device.set_parallel_plan(...)` stores a default plan
+here; `Model.compile` consults it when called without `mesh`/`plan`
+(the same defer-to-process contract as `device.set_grad_accum`).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from .mesh import AXES, auto_mesh
+
+_SCHEDULES = ("1f1b", "gpipe")
+
+
+class ParallelPlan:
+    """Mesh geometry (dp x model x pipe x expert x seq) + sharding
+    rules + pipeline/MoE policy, as one compile-time object."""
+
+    def __init__(self, data: int = 0, model: int = 0, seq: int = 0,
+                 pipe: int = 0, expert: int = 0, rules=None,
+                 pipeline_microbatches: Optional[int] = None,
+                 pipeline_schedule: str = "1f1b",
+                 moe_capacity_factor: float = 1.25):
+        axes = {"data": data, "model": model, "seq": seq,
+                "pipe": pipe, "expert": expert}
+        for k, v in axes.items():
+            v = int(v)
+            if v < 0:
+                raise ValueError(f"plan axis {k}={v} must be >= 0")
+            axes[k] = v
+        if pipeline_schedule not in _SCHEDULES:
+            raise ValueError(
+                f"unknown pipeline_schedule {pipeline_schedule!r}; "
+                f"known: {list(_SCHEDULES)}")
+        if pipeline_microbatches is not None:
+            pipeline_microbatches = int(pipeline_microbatches)
+            if pipeline_microbatches < 1:
+                raise ValueError("pipeline_microbatches must be >= 1")
+        moe_capacity_factor = float(moe_capacity_factor)
+        if moe_capacity_factor <= 0:
+            raise ValueError("moe_capacity_factor must be > 0")
+        self.axes = axes
+        self.rules = rules
+        self.pipeline_microbatches = pipeline_microbatches
+        self.pipeline_schedule = pipeline_schedule
+        self.moe_capacity_factor = moe_capacity_factor
+
+    # -- geometry ----------------------------------------------------------
+    def build_mesh(self, n_devices: Optional[int] = None):
+        """Named Mesh for this plan's axes (the `auto_mesh` contract:
+        explicit axes honored, "data" absorbs the remainder)."""
+        return auto_mesh(n_devices, **{k: v for k, v in
+                                       self.axes.items()})
+
+    def build_rules(self):
+        from .sharding import ShardingRules
+
+        return self.rules if self.rules is not None else ShardingRules()
+
+    def size(self) -> int:
+        """Product of the explicitly-set axes (devices the plan pins;
+        the mesh may be larger when "data" absorbs a remainder)."""
+        out = 1
+        for v in self.axes.values():
+            out *= max(1, v)
+        return out
+
+    # -- identity ----------------------------------------------------------
+    def fingerprint(self) -> Dict:
+        """JSON-able identity for the export-cache key: a plan flip
+        must orphan AOT artifacts; flipping back re-hits."""
+        from .. import export_cache
+
+        return {
+            "axes": {k: int(v) for k, v in sorted(self.axes.items())
+                     if v},
+            "rules": export_cache._scalarize(self.rules),
+            "pipeline_microbatches": self.pipeline_microbatches,
+            "pipeline_schedule": self.pipeline_schedule,
+            "moe_capacity_factor": self.moe_capacity_factor,
+        }
+
+    def describe(self) -> str:
+        axes = ",".join(f"{k}={v}" for k in AXES
+                        for v in [self.axes.get(k, 0)] if v)
+        return (f"ParallelPlan({axes or 'data=all'}, "
+                f"schedule={self.pipeline_schedule}, "
+                f"mb={self.pipeline_microbatches or 'pipe'}, "
+                f"cf={self.moe_capacity_factor})")
+
+    __repr__ = describe
+
+
+def parse_geometry(spec: str) -> Dict[str, int]:
+    """"data=4,pipe=2" -> {"data": 4, "pipe": 2} (the autotuner's
+    mesh-geometry knob format; ":" also accepted as a separator)."""
+    out: Dict[str, int] = {}
+    for part in str(spec).replace(":", ",").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(
+                f"bad mesh geometry {spec!r}: expected axis=size "
+                f"pairs, got {part!r}")
+        k, v = part.split("=", 1)
+        k = k.strip()
+        if k not in AXES:
+            raise ValueError(
+                f"bad mesh geometry {spec!r}: unknown axis {k!r} "
+                f"(known: {list(AXES)})")
+        out[k] = int(v)
+    if not out:
+        raise ValueError(f"bad mesh geometry {spec!r}: empty")
+    return out
+
+
+def plan_from_geometry(spec: str, **policy) -> ParallelPlan:
+    return ParallelPlan(**parse_geometry(spec), **policy)
+
+
+# ---------------------------------------------------------------------------
+# Process default (device.set_parallel_plan)
+# ---------------------------------------------------------------------------
+_PROCESS_PLAN: Optional[ParallelPlan] = None
+
+
+def set_process_plan(plan: Optional[ParallelPlan]) -> None:
+    global _PROCESS_PLAN
+    if plan is not None and not isinstance(plan, ParallelPlan):
+        raise ValueError(
+            f"set_parallel_plan expects a ParallelPlan or None, got "
+            f"{type(plan).__name__}")
+    _PROCESS_PLAN = plan
+
+
+def process_plan() -> Optional[ParallelPlan]:
+    return _PROCESS_PLAN

@@ -25,7 +25,16 @@ Rule = Tuple[str, Sequence[Optional[str]]]
 #  - conv kernels (out_c, in_c, kh, kw): shard output channels;
 #  - embeddings (vocab, dim): shard the vocab (lookup all-reduces).
 # Biases/gains stay replicated — tiny, and it keeps BN/LN trivial.
+# Multi-axis additions (ISSUE 10):
+#  - `stage_*` params (layer.PipelineStack's stacked stages): leading
+#    stage dim over "pipe" — chip i holds stage i;
+#  - MoE expert-stacked params (layer.MoE's w1/b1/w2/b2): leading
+#    expert dim over "expert" (the router `gate` stays replicated —
+#    every chip routes every token, the GShard convention).
+# Rules degrade safely when the axis is absent from the mesh.
 DEFAULT_RULES: List[Rule] = [
+    (r"(^|\.)stage_\w+$", ("pipe",)),
+    (r"(^|\.)(w1|b1|w2|b2)$", ("expert",)),
     (r"(^|\.)conv\w*\.W$", ("model", None, None, None)),
     (r"(^|\.)embed\w*\.W$", ("model", None)),
     (r"(^|\.)(W|weight)$", (None, "model")),

@@ -38,9 +38,11 @@ class ShardedJitStep(_JitStep):
     def __init__(self, model, mesh, rules: Optional[ShardingRules] = None,
                  batch_axis: str = "data",
                  batch_specs: Optional[Sequence] = None,
-                 seq_axis: Optional[str] = None, seq_dim: int = 1):
+                 seq_axis: Optional[str] = None, seq_dim: int = 1,
+                 plan=None):
         super().__init__(model)
         self.mesh = mesh
+        self.plan = plan  # ParallelPlan (ISSUE 10); keys the AOT store
         self.rules = rules or ShardingRules()
         self.batch_axis = batch_axis
         self.batch_specs = batch_specs
@@ -408,9 +410,31 @@ class ShardedJitStep(_JitStep):
                             else [repr(s) for s in self.batch_specs]),
             "rules": export_cache._scalarize(self.rules),
             "multiproc": bool(self._multiproc),
+            # ParallelPlan identity (ISSUE 10): schedule/microbatch/
+            # capacity policy bakes a different traced program even on
+            # an identical mesh — a plan flip must orphan artifacts
+            # (and flipping back re-hits).
+            "plan": (None if self.plan is None
+                     else self.plan.fingerprint()),
         }
 
     # -- jit wiring --------------------------------------------------------
+    def _build(self, *batch_arrays, donate=None):
+        """Pipeline/expert meshes build with donation OFF (ISSUE 10):
+        this jax version's SPMD partitioner can propagate a spurious
+        batch-axis sharding out of the 1F1B schedule's check-rep-off
+        manual region (and, shape-dependent, out of the MoE dispatch's
+        expert sharding constraints) into an unrelated donated param's
+        OUTPUT, and the donation alias check then explodes at dispatch
+        ("aliased input/output to have the same size"). The pure
+        DP/TP/SP axes keep the aliasing contract; pipe/expert trade it
+        for correctness — the same conservative discipline as
+        export-cached steps."""
+        if donate is None and (self.mesh.shape.get("pipe", 1) > 1
+                               or self.mesh.shape.get("expert", 1) > 1):
+            donate = False
+        return super()._build(*batch_arrays, donate=donate)
+
     def _jit_kwargs(self, batch_arrays):
         rep = replicated(self.mesh)
         p_sh = self._param_shardings()

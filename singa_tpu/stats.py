@@ -50,6 +50,12 @@ __all__ = [
     "REMAT_POLICIES",
     "note_accum_build",
     "count_accum_step",
+    "moe_capacity_factor",
+    "pipeline_microbatches",
+    "note_pipeline_build",
+    "note_moe_build",
+    "note_moe_dropped",
+    "note_collective",
 ]
 
 
@@ -110,6 +116,21 @@ _CONFIG: Dict = {
     # executable build time: re-`compile()` after toggling. Setter:
     # device.set_remat_policy.
     "remat_policy": None,
+    # Multi-axis parallel trainer overrides (ISSUE 10). Both default
+    # to None = "use the layer/plan's own setting"; when set they
+    # override every PipelineStack / MoE layer at trace time, which is
+    # what lets the autotuner sweep them without rebuilding models.
+    # Read at executable build/trace time (the grad_accum contract):
+    # re-compile() after toggling. Setters ride
+    # device.set_parallel_plan's module (parallel.plan) and the
+    # autotuner's apply_config.
+    #   pipeline_microbatches: microbatch count of every pipeline
+    #   schedule (None = the stack's own setting, which defaults to
+    #   the pipe size).
+    "pipeline_microbatches": None,
+    #   moe_capacity_factor: expert capacity factor of every MoE layer
+    #   (None = the layer's constructor value).
+    "moe_capacity_factor": None,
     # Microbatched gradient accumulation (ISSUE 4): the compiled train
     # step reshapes its batch to [n, mb, ...] and lax.scans the
     # forward/backward over microbatches, accumulating gradients in
@@ -164,6 +185,18 @@ def configure(**kw) -> Dict:
             v = int(v)
             if v < 1:
                 raise ValueError("grad_accum must be >= 1")
+        elif k == "pipeline_microbatches":
+            if v is not None:
+                v = int(v)
+                if v < 1:
+                    raise ValueError(
+                        "pipeline_microbatches must be None or >= 1")
+        elif k == "moe_capacity_factor":
+            if v is not None:
+                v = float(v)
+                if v <= 0:
+                    raise ValueError(
+                        "moe_capacity_factor must be None or > 0")
         elif k == "remat_policy":
             v = _normalize_remat_policy(v)
         elif k == "loss_scaling":
@@ -456,6 +489,100 @@ def count_train_step(n: int = 1) -> None:
 def grad_accum_n() -> int:
     """Configured gradient-accumulation factor (1 = off)."""
     return _CONFIG["grad_accum"]
+
+
+def pipeline_microbatches():
+    """Process override for every pipeline schedule's microbatch count
+    (None = the stack's own setting)."""
+    return _CONFIG["pipeline_microbatches"]
+
+
+def moe_capacity_factor():
+    """Process override for every MoE layer's capacity factor (None =
+    the layer's constructor value)."""
+    return _CONFIG["moe_capacity_factor"]
+
+
+class _ParallelStats:
+    """cache_stats()["parallel"]: the multi-axis trainer view (ISSUE
+    10) — the last built pipeline's schedule geometry (stages,
+    microbatches, bubble ticks and the analytic bubble fraction
+    (P-1)/(M+P-1); 1F1B's combined fwd+bwd pass reports its 2(M+P-1)
+    tick count), the last MoE layer's expert/capacity geometry and the
+    most recent CONCRETE dropped-token fraction (graph-mode steps trace
+    it into the program, so eager steps and the bench's state readback
+    are the host-visible sources), and per-axis collective counts the
+    parallel modules themselves emit per traced step (ppermute /
+    psum / all_to_all-equivalent sharding constraints, keyed by mesh
+    axis). Build notes describe live executables and survive
+    reset_cache_stats(); the counters reset."""
+
+    def __init__(self):
+        self.reset()
+        self.pipeline = None  # build note: {stages, microbatches, ...}
+        self.moe = None       # build note: {experts, capacity, ...}
+
+    def reset(self) -> None:
+        self.pipeline_builds = 0
+        self.moe_builds = 0
+        self.collectives: Dict[str, Dict[str, int]] = {}
+        self.dropped_frac_last = None
+
+    def snapshot(self) -> Dict:
+        return {
+            "pipeline": self.pipeline,
+            "moe": self.moe,
+            "pipeline_builds": self.pipeline_builds,
+            "moe_builds": self.moe_builds,
+            "collectives": {ax: dict(kinds)
+                            for ax, kinds in
+                            sorted(self.collectives.items())},
+            "dropped_frac_last": self.dropped_frac_last,
+        }
+
+
+_PARALLEL = _ParallelStats()
+register_cache("parallel", _PARALLEL)
+
+
+def note_pipeline_build(stages: int, microbatches: int,
+                        schedule: str) -> None:
+    """Record one pipeline schedule build/trace: geometry + the
+    analytic bubble fraction (P-1)/(M+P-1)."""
+    p, m = int(stages), int(microbatches)
+    ticks = m + p - 1
+    _PARALLEL.pipeline_builds += 1
+    _PARALLEL.pipeline = {
+        "stages": p,
+        "microbatches": m,
+        "schedule": schedule,
+        "bubble_ticks": p - 1,
+        "ticks": ticks if schedule == "gpipe" else 2 * ticks,
+        "bubble_fraction": round((p - 1) / ticks, 6),
+    }
+
+
+def note_moe_build(experts: int, capacity: int,
+                   capacity_factor: float) -> None:
+    _PARALLEL.moe_builds += 1
+    _PARALLEL.moe = {
+        "experts": int(experts),
+        "capacity": int(capacity),
+        "capacity_factor": float(capacity_factor),
+    }
+
+
+def note_moe_dropped(frac) -> None:
+    """Record a CONCRETE dropped-token fraction (eager steps / bench
+    state readback; traced values never reach here)."""
+    _PARALLEL.dropped_frac_last = float(frac)
+
+
+def note_collective(axis: str, kind: str, n: int = 1) -> None:
+    """Count collectives the parallel modules emit per traced step,
+    keyed (mesh axis, kind) — e.g. ("pipe", "ppermute")."""
+    d = _PARALLEL.collectives.setdefault(str(axis), {})
+    d[kind] = d.get(kind, 0) + int(n)
 
 
 class _AccumStats:

@@ -109,6 +109,22 @@ KNOBS: Dict[str, tuple] = {
     # scan-level rematerialization policy (device.set_remat_policy) —
     # the headline new knob: searchable memory/recompute trade
     "remat_policy": (None, "dots_saveable", "nothing_saveable"),
+    # Multi-axis parallel trainer knobs (ISSUE 10; ROADMAP item 3).
+    # mesh_geometry: a ParallelPlan axis spec ("data=4,pipe=2") the
+    # scorer compiles the step over (None = single device). Values
+    # whose axis product does not divide the process's device count
+    # score infeasible (loud row reason) rather than erroring — the
+    # same knob space serves 1-device CI and the 8-device mesh.
+    "mesh_geometry": (None, "data=4,pipe=2", "data=4,model=2",
+                      "data=2,model=2,pipe=2", "data=4,expert=2"),
+    # pipeline_microbatches: every PipelineStack's microbatch count
+    # (None = pipe size); more microbatches shrink the bubble
+    # (P-1)/(M+P-1) but shrink per-tick MXU shapes.
+    "pipeline_microbatches": (None, 2, 4, 8),
+    # moe_capacity_factor: every MoE layer's expert capacity factor
+    # (None = the layer/plan setting); higher drops fewer tokens but
+    # pads more expert compute.
+    "moe_capacity_factor": (None, 1.0, 1.25, 1.5, 2.0),
     # Pallas kernel block shapes (env-overridable at
     # ops/pallas_kernels import; benchmarks/pallas_tune.py sweeps
     # them). Cost-model-neutral on CPU — they join the search through
@@ -124,7 +140,8 @@ KNOBS: Dict[str, tuple] = {
 # score cache keys on exactly these (xla/pallas knobs are neutral to
 # the HLO meter, so configs differing only there share a measurement).
 HLO_KNOBS = ("compute_dtype", "slot_dtype", "bn_stats_dtype",
-             "grad_accum", "remat_policy")
+             "grad_accum", "remat_policy", "mesh_geometry",
+             "pipeline_microbatches", "moe_capacity_factor")
 
 # Pallas knob -> the env var pallas_kernels reads at import, and the
 # module global it reads into (apply_config pokes the live module too
@@ -457,6 +474,36 @@ class CostModelScorer:
         from . import tensor as tensor_mod
 
         n = int(cfg["grad_accum"])
+        # Multi-axis knobs (ISSUE 10): a mesh geometry compiles the
+        # step as the SPMD program over a ParallelPlan mesh and the
+        # roofline divides by the device count (SPMD splits bytes and
+        # flops; the collectives' traffic is the documented
+        # approximation error). Infeasible geometries (axis product
+        # not dividing the process's devices) score -inf with a loud
+        # reason instead of erroring — the knob space is shared
+        # between 1-device CI and the 8-device mesh.
+        geom = cfg["mesh_geometry"]
+        plan = None
+        ndev = 1
+        if geom is not None:
+            from .parallel import plan as plan_mod
+
+            axes = plan_mod.parse_geometry(geom)
+            plan = plan_mod.ParallelPlan(**axes)
+            try:
+                # the real feasibility oracle: auto_mesh's own rules
+                # (explicit axes must use the devices exactly; a
+                # divisor-only pre-check would admit e.g. an 8-device
+                # geometry on a 16-device backend and then crash the
+                # sweep inside compile)
+                mesh = plan.build_mesh()
+            except ValueError as e:
+                _STATS.infeasible += 1
+                return {"feasible": False, "score": float("-inf"),
+                        "reason": f"mesh {geom}: {e}"}
+            ndev = 1
+            for v in mesh.shape.values():
+                ndev *= int(v)
         saved = stats_mod.get_config()
         saved_cd = tensor_mod.get_compute_dtype()
         try:
@@ -465,6 +512,8 @@ class CostModelScorer:
                 bn_stats_dtype=cfg["bn_stats_dtype"],
                 remat_policy=cfg["remat_policy"],
                 grad_accum=1,
+                pipeline_microbatches=cfg["pipeline_microbatches"],
+                moe_capacity_factor=cfg["moe_capacity_factor"],
                 # donation off for the measurement: the aliasing
                 # copies XLA inserts for donated buffers are noise on
                 # top of the program's real dataflow (the
@@ -481,9 +530,10 @@ class CostModelScorer:
                 return {"feasible": False, "score": float("-inf"),
                         "reason": f"batch {batch} not divisible by "
                                   f"grad_accum {n}"}
+            plan_kw = {} if plan is None else {"plan": plan}
             mb_inputs = [self._slice_mb(t, batch // n) for t in inputs]
             model.compile([mb_inputs[0]], is_train=True,
-                          use_graph=True, grad_accum=1)
+                          use_graph=True, grad_accum=1, **plan_kw)
             if self._fingerprint is None:
                 self._fingerprint = model.topology_fingerprint()
             opt_text = model.step_hlo_text(*mb_inputs)
@@ -503,7 +553,8 @@ class CostModelScorer:
                     full_opt.set_slot_dtype(cfg["slot_dtype"])
                 full_model.set_optimizer(full_opt)
                 full_model.compile([inputs[0]], is_train=True,
-                                   use_graph=True, grad_accum=n)
+                                   use_graph=True, grad_accum=n,
+                                   **plan_kw)
                 pre_text = full_model.step_hlo_text(
                     *inputs, optimized=False)
             else:
@@ -516,6 +567,8 @@ class CostModelScorer:
                 bn_stats_dtype=saved["bn_stats_dtype"],
                 remat_policy=saved["remat_policy"],
                 grad_accum=saved["grad_accum"],
+                pipeline_microbatches=saved["pipeline_microbatches"],
+                moe_capacity_factor=saved["moe_capacity_factor"],
                 buffer_donation=saved["buffer_donation"])
         spec = CHIP_SPECS[self.chip]
         step_bytes = n * mb_bytes
@@ -525,9 +578,13 @@ class CostModelScorer:
         # AMP knob (the bytes side is measured directly).
         peak_flops = spec["peak_flops"] * (
             1.0 if cfg["compute_dtype"] == "bfloat16" else 0.5)
-        est = max(step_bytes / (spec["hbm_gbps"] * 1e9),
-                  step_flops / peak_flops)
-        feasible = peak <= spec["hbm_bytes"]
+        # Mesh geometries meter the GLOBAL SPMD program: per-chip
+        # roofline time divides bytes/flops/liveness by the device
+        # count (SPMD splits the work; collective traffic rides inside
+        # the measured bytes — a conservative over-count per chip).
+        est = max(step_bytes / ndev / (spec["hbm_gbps"] * 1e9),
+                  step_flops / ndev / peak_flops)
+        feasible = peak / ndev <= spec["hbm_bytes"]
         if not feasible:
             _STATS.infeasible += 1
         return {
@@ -539,6 +596,7 @@ class CostModelScorer:
             "flops": step_flops,
             "mb_bytes": mb_bytes,
             "peak_bytes": peak,
+            "n_devices": ndev,
             "effective_batch": batch,
             "microbatch": batch // n,
         }
@@ -905,6 +963,27 @@ def apply_config(cfg: Dict, optimizer=None, apply_xla: bool = False,
         if optimizer is not None and cfg["slot_dtype"] is not None:
             optimizer.set_slot_dtype(cfg["slot_dtype"])
             applied["slot_dtype"] = cfg["slot_dtype"]
+        # multi-axis trainer knobs (ISSUE 10): training geometry —
+        # never armed for serving
+        if cfg["mesh_geometry"] is not None:
+            from .parallel import plan as plan_mod
+
+            device.set_parallel_plan(
+                plan_mod.plan_from_geometry(cfg["mesh_geometry"]))
+            applied["mesh_geometry"] = cfg["mesh_geometry"]
+        if cfg["pipeline_microbatches"] is not None:
+            from . import stats as _stats
+
+            _stats.configure(
+                pipeline_microbatches=cfg["pipeline_microbatches"])
+            applied["pipeline_microbatches"] = \
+                cfg["pipeline_microbatches"]
+        if cfg["moe_capacity_factor"] is not None:
+            from . import stats as _stats
+
+            _stats.configure(
+                moe_capacity_factor=cfg["moe_capacity_factor"])
+            applied["moe_capacity_factor"] = cfg["moe_capacity_factor"]
     return applied
 
 

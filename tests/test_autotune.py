@@ -61,6 +61,9 @@ def _reset_knobs():
     device.set_grad_accum(1)
     device.set_bn_stats_dtype(None)
     tensor.set_compute_dtype(None)
+    device.set_parallel_plan(None)
+    stats.configure(pipeline_microbatches=None,
+                    moe_capacity_factor=None)
 
 
 def _factory():
@@ -82,6 +85,8 @@ def _scorer(**kw):
 
 # A reduced space for fast in-process searches: every knob present
 # (the scorer's HLO key wants them all), values a subset of KNOBS.
+# The multi-axis knobs (ISSUE 10) are pinned to their defaults here —
+# the dedicated multi-axis tests below open them up.
 SMALL_SPACE = dict(
     tuning.KNOBS,
     compute_dtype=(None,),
@@ -90,6 +95,9 @@ SMALL_SPACE = dict(
     xla_profile=("default", "latency"),
     grad_accum=(1, 2),
     remat_policy=(None, "dots_saveable"),
+    mesh_geometry=(None,),
+    pipeline_microbatches=(None,),
+    moe_capacity_factor=(None,),
     pallas_attn_tq=(None,),
     pallas_row_budget=(None,),
     pallas_hist_budget=(None,),
@@ -485,3 +493,93 @@ def test_pallas_tune_cpu_sweep_emits_ingestible_jsonl(tmp_path,
     # and the search snaps its candidates to the measured best
     picks = tuning.propose(budget=2, seed=0, measured=ms)
     assert picks[0]["pallas_hist_budget"] == best
+
+
+# ---------------------------------------------------------------------------
+# Multi-axis knobs (ISSUE 10): mesh geometry / pipeline microbatches /
+# MoE capacity factor join the search space
+# ---------------------------------------------------------------------------
+def test_multi_axis_knobs_in_space():
+    for knob in ("mesh_geometry", "pipeline_microbatches",
+                 "moe_capacity_factor"):
+        assert knob in tuning.KNOBS
+        assert tuning.KNOBS[knob][0] is None  # default = off
+        assert knob in tuning.HLO_KNOBS  # they change the traced HLO
+
+
+MESH_SPACE = dict(
+    SMALL_SPACE,
+    slot_dtype=(None,),
+    xla_profile=("default",),
+    grad_accum=(1,),
+    remat_policy=(None,),
+    mesh_geometry=(None, "data=4,pipe=2"),
+)
+
+
+def test_mesh_geometry_flip_proposed_and_scored():
+    """The acceptance loop (ISSUE 10): a multi-axis config (mesh
+    flip) is PROPOSED by the single-flip sweep and SCORED end-to-end
+    on the 8-virtual-device CPU mesh — feasible, finite score, the
+    roofline normalized per device."""
+    scorer = _scorer()
+    result = tuning.autotune(scorer, budget=3, seed=0,
+                             space=MESH_SPACE)
+    rows = {r["config"]["mesh_geometry"]: r for r in result["rows"]}
+    assert "data=4,pipe=2" in rows, "mesh flip never proposed"
+    mesh_row = rows["data=4,pipe=2"]
+    assert mesh_row["feasible"] is True
+    assert np.isfinite(mesh_row["score"]) and mesh_row["score"] > 0
+    assert mesh_row["n_devices"] == 8
+    assert rows[None]["n_devices"] == 1
+
+
+def test_infeasible_mesh_geometry_excluded():
+    """A geometry whose axis product does not divide the available
+    devices scores -inf with a loud reason instead of erroring (the
+    shared-knob-space contract between 1-device CI and the mesh)."""
+    scorer = _scorer()
+    row = scorer._measure(dict(tuning.default_config(),
+                               mesh_geometry="data=2,model=3"))
+    assert row["feasible"] is False
+    assert "devices" in row.get("reason", "")
+    assert row["score"] == float("-inf")
+
+
+def test_multi_axis_winner_persists_and_loads(tmp_path):
+    """Winner with a mesh flip persists to the store and resolves by
+    alias — the `bench.py --tuned` consumption path."""
+    scorer = _scorer()
+    result = tuning.autotune(scorer, budget=3, seed=0,
+                             space=MESH_SPACE)
+    store = tuning.TunedStore(str(tmp_path / "tuned.json"))
+    store.put(scorer.fingerprint, "v5e", result["best"],
+              result["best_score"], alias=["autotune_net"])
+    ent = store.get(alias="autotune_net", chip="v5e")
+    assert ent is not None
+    cfg = tuning.validate_config(ent["config"])
+    assert cfg["mesh_geometry"] in (None, "data=4,pipe=2")
+
+
+def test_apply_config_arms_parallel_knobs():
+    from singa_tpu.parallel import plan as plan_mod
+
+    applied = tuning.apply_config(
+        {"mesh_geometry": "data=4,pipe=2",
+         "pipeline_microbatches": 4, "moe_capacity_factor": 1.5})
+    try:
+        assert applied["mesh_geometry"] == "data=4,pipe=2"
+        assert applied["pipeline_microbatches"] == 4
+        assert applied["moe_capacity_factor"] == 1.5
+        plan = plan_mod.process_plan()
+        assert plan is not None and plan.axes["pipe"] == 2
+        assert stats.get_config()["pipeline_microbatches"] == 4
+        assert stats.get_config()["moe_capacity_factor"] == 1.5
+        # the serving subset never arms training geometry
+        applied_s = tuning.apply_config(
+            {"mesh_geometry": "data=4,pipe=2"}, training=False)
+        assert "mesh_geometry" not in applied_s
+    finally:
+        device.set_parallel_plan(None)
+        stats.configure(pipeline_microbatches=None,
+                        moe_capacity_factor=None)

@@ -522,3 +522,66 @@ def test_fold_onchip_renders_tuned_marker(tmp_path, capsys,
     assert "tuned=✓" in tuned_line
     old_line = [ln for ln in out.splitlines() if "900.0" in ln][0]
     assert "tuned" not in old_line
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 10: the multi-axis parallel stage
+# ---------------------------------------------------------------------------
+def test_parallel_stage_contract():
+    """`bench.py --stage parallel` on the (virtual) 8-device CPU
+    mesh: the pipeline arm reports images/sec + measured-vs-analytic
+    bubble fraction, the MoE arm tokens/sec + dropped-token fraction,
+    and the result carries the shared stage breakdown + metrics
+    path."""
+    proc, r = _run_stage(["--stage", "parallel", "--steps", "4",
+                          "--deadline", "200"], timeout=280)
+    assert r is not None, proc.stderr[-2000:]
+    assert r.get("ok"), r
+    assert r["pipeline_images_per_sec"] > 0
+    assert r["mesh_devices"] == 8
+    assert r["schedule"] == "1f1b"
+    assert abs(r["bubble_fraction_analytic"]
+               - (r["pipe"] - 1)
+               / (r["microbatches"] + r["pipe"] - 1)) < 1e-3
+    # measured bubble is reported NEXT TO the analytic value (CPU
+    # virtual devices share cores, so only presence is pinned)
+    assert "bubble_fraction_measured" in r
+    assert r["moe_tokens_per_sec"] > 0
+    assert 0.0 <= r["dropped_token_fraction"] <= 1.0
+    assert r["parallel_stats"]["pipeline"]["schedule"] == "1f1b"
+    assert "stage_seconds" in r and "metrics_jsonl" in r
+
+
+def test_parallel_row_rides_the_driver_ramp():
+    src = open(os.path.join(_ROOT, "bench.py")).read()
+    assert 'run_stage("parallel"' in src
+    assert 'result_extra["pipeline_images_per_sec"]' in src
+    assert 'result_extra["moe_tokens_per_sec"]' in src
+
+
+def test_fold_onchip_renders_parallel_stage(tmp_path, capsys,
+                                            monkeypatch):
+    fold = _load_module("fold_onchip_for_test2",
+                        "tools/fold_onchip.py")
+    logs = tmp_path / "onchip_logs"
+    logs.mkdir()
+    (logs / "parallel.out").write_text(json.dumps(
+        {"ok": True, "pipeline_images_per_sec": 6492.7,
+         "bubble_fraction_measured": 0.31,
+         "bubble_fraction_analytic": 0.2727,
+         "pipe": 4, "microbatches": 8, "schedule": "1f1b",
+         "moe_tokens_per_sec": 33966.5,
+         "dropped_token_fraction": 0.021, "experts": 4}) + "\n")
+    # an old-format row in the same dir folds unchanged
+    (logs / "resnet_old.out").write_text(json.dumps(
+        {"ok": True, "ips": 100.0, "step_ms": 10.0, "batch": 32,
+         "precision": "fp32"}) + "\n")
+    monkeypatch.setattr(fold, "LOGS", str(logs))
+    assert fold.main() == 0
+    out = capsys.readouterr().out
+    assert "6492.7 img/s" in out
+    assert "P=4 M=8 1f1b" in out
+    assert "0.31" in out and "0.2727 analytic" in out
+    assert "33966 tok/s" in out or "33967 tok/s" in out
+    assert "dropped 0.021" in out
+    assert "100.0 img/s" in out  # old log unchanged

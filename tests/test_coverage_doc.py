@@ -142,6 +142,28 @@ def test_autotune_row_and_readme_sections_present():
         assert policy in readme, policy
 
 
+def test_parallel_trainer_row_and_readme_section_present():
+    """ISSUE 10 doc contract: the P20 multi-axis trainer row and the
+    README "Multi-axis parallelism" section exist (path rot in either
+    is caught by test_all_cited_paths_exist)."""
+    cov = open(os.path.join(_ROOT, "COVERAGE.md")).read()
+    assert "| P20 |" in cov
+    assert "singa_tpu/parallel/plan.py" in cov
+    assert "tests/test_pipeline.py" in cov
+    assert "tests/test_moe.py" in cov
+    readme = open(os.path.join(_ROOT, "README.md")).read()
+    assert "## Multi-axis parallelism" in readme
+    assert "ParallelPlan" in readme
+    assert "set_parallel_plan" in readme
+    assert "PipelineStack" in readme
+    assert "1f1b" in readme and "gpipe" in readme
+    assert "pipeline_images_per_sec" in readme
+    assert "moe_tokens_per_sec" in readme
+    assert "dropped_frac" in readme
+    assert "mesh_geometry" in readme
+    assert "--stage parallel" in readme
+
+
 def test_all_cited_paths_exist():
     text = open(os.path.join(_ROOT, "COVERAGE.md")).read()
     missing = []

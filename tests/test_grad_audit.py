@@ -31,6 +31,11 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+try:  # jax >= 0.5 top-level spelling; 0.4.x keeps it in experimental
+    _enable_x64 = jax.enable_x64
+except AttributeError:
+    from jax.experimental import enable_x64 as _enable_x64
+
 from singa_tpu import autograd, tensor
 from singa_tpu.ops import native
 from singa_tpu.ops.rnn import RNNHandle
@@ -69,7 +74,7 @@ def _grad_check(make_op, arrays, diff=None, eps=1e-5, rtol=1e-4,
     old_training = autograd.training
     autograd.training = train
     try:
-        with jax.enable_x64():
+        with _enable_x64():
             arrays = [np.asarray(a, np.float64)
                       if np.issubdtype(np.asarray(a).dtype, np.floating)
                       else np.asarray(a) for a in arrays]
@@ -113,6 +118,11 @@ _RS = np.random.RandomState(42)
 
 def _rand(*shape):
     return _RS.randn(*shape)
+
+
+def _pipe_audit_stage(p, h):
+    """Homogeneous pipeline stage for the PipelineApply audit entry."""
+    return jnp.tanh(h @ p["W"]) + h
 
 
 # ---------------------------------------------------------------------------
@@ -295,6 +305,22 @@ DIFF_CASES = {
     "_RNNGru": (lambda: A._RNN(_GRU),
                 [_rand(3, 2, 3), _rand(1, 2, 4), _rand(1, 2, 4),
                  _rand(_GRU.weights_size)], {}),
+    # --- multi-axis parallel ops (ISSUE 10; single-device paths:
+    # PipelineApply runs its sequential composition, MoEFFN its dense
+    # dispatch — the mesh variants are covered by tests/test_pipeline
+    # and tests/test_moe parity suites) -----------------------------------
+    "PipelineApply": (
+        lambda: A.PipelineApply(_pipe_audit_stage, ("W",), 2),
+        [_rand(3, 4), _rand(2, 4, 4) * 0.5], {}),
+    # router math pins f32 (the GShard convention), so the central
+    # difference floor is f32 eps — widen like SoftMaxCrossEntropy;
+    # dropped_frac is stop_gradient'ed and piecewise constant, so its
+    # cotangent contributes zero to both sides
+    "MoEFFN": (
+        lambda: A.MoEFFN(capacity_factor=1.5),
+        [_rand(6, 4), _rand(4, 3) * 0.5, _rand(3, 4, 8) * 0.5,
+         _rand(3, 8) * 0.1, _rand(3, 8, 4) * 0.5, _rand(3, 4) * 0.1],
+        {"eps": 1e-3, "rtol": 5e-3, "atol": 1e-3}),
 }
 
 # non-differentiable ops: forward works, gradient flow is refused

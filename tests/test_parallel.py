@@ -254,6 +254,283 @@ class TestTransformerParallel:
         np.testing.assert_allclose(single, meshed, rtol=2e-4)
 
 
+# ---------------------------------------------------------------------------
+# ParallelPlan: multi-axis trainer (ISSUE 10)
+# ---------------------------------------------------------------------------
+from singa_tpu import device as device_mod  # noqa: E402
+from singa_tpu.parallel import (  # noqa: E402
+    ParallelPlan,
+    parse_geometry,
+    plan_from_geometry,
+)
+
+
+class TestPlanObject:
+    def test_build_mesh_and_fingerprint(self):
+        plan = ParallelPlan(data=2, pipe=4)
+        mesh = plan.build_mesh()
+        assert mesh.shape == {"data": 2, "pipe": 4}
+        fp = plan.fingerprint()
+        assert fp["axes"] == {"data": 2, "pipe": 4}
+        assert fp["pipeline_schedule"] == "1f1b"
+        # a flip changes the fingerprint; flipping back restores it
+        fp2 = ParallelPlan(data=4, pipe=2).fingerprint()
+        assert fp2 != fp
+        assert ParallelPlan(data=2, pipe=4).fingerprint() == fp
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="pipeline_schedule"):
+            ParallelPlan(pipeline_schedule="zigzag")
+        with pytest.raises(ValueError, match=">= 0"):
+            ParallelPlan(data=-1)
+        with pytest.raises(ValueError, match="moe_capacity_factor"):
+            ParallelPlan(moe_capacity_factor=0)
+
+    def test_parse_geometry(self):
+        assert parse_geometry("data=4,pipe=2") == {"data": 4,
+                                                   "pipe": 2}
+        assert parse_geometry("data=4:expert=2") == {"data": 4,
+                                                     "expert": 2}
+        with pytest.raises(ValueError, match="unknown axis"):
+            parse_geometry("data=4,rows=2")
+        with pytest.raises(ValueError, match="empty"):
+            parse_geometry("")
+        plan = plan_from_geometry("data=2,model=2,pipe=2")
+        assert plan.build_mesh().shape == {"data": 2, "model": 2,
+                                           "pipe": 2}
+
+    def test_process_plan_knob(self):
+        """device.set_parallel_plan arms a process default that a bare
+        compile() adopts; clearing restores single-device compiles."""
+        try:
+            device_mod.set_parallel_plan(data=8)
+            m = _MLP()
+            m.set_optimizer(opt.SGD(lr=0.1))
+            tx = tensor.from_numpy(
+                np.random.RandomState(0).randn(16, 32).astype(
+                    np.float32))
+            m.compile([tx], is_train=True, use_graph=True)
+            assert m._mesh is not None
+            assert m._mesh.shape == {"data": 8}
+        finally:
+            device_mod.set_parallel_plan(None)
+        with pytest.raises(ValueError, match="not both"):
+            device_mod.set_parallel_plan(ParallelPlan(data=2), pipe=2)
+
+
+class _ExactPipeNet(model.Model):
+    """Exact-arithmetic pipeline workload: linear residual stages +
+    mean-|diff| loss on small dyadic rationals — the gradient seed is
+    always a single-bit power of two (sign/n), so one whole training
+    step stays exactly representable and the pipelined / sharded /
+    accumulated steps can be compared BIT-for-bit against the
+    single-mesh step."""
+
+    def __init__(self, stages=4):
+        super().__init__(name="exactpipe")
+        self.stack = layer.PipelineStack(
+            stages, self._stage_fn, self._init_stage)
+
+    @staticmethod
+    def _stage_fn(p, h):
+        return h + h @ p["W"]
+
+    @staticmethod
+    def _init_stage(key, x_shape):
+        import jax
+
+        d = int(x_shape[-1])
+        # dyadic params: ints in [-2, 2] / 16
+        w = jax.random.randint(key, (d, d), -2, 3).astype(
+            jnp.float32) / 16.0
+        return {"W": w}
+
+    def forward(self, x):
+        return self.stack(x)
+
+    def train_one_batch(self, x, y):
+        out = self.forward(x)
+        # mean |out - y|: abs/sub/mean are exact on dyadic data and
+        # the backward seed is sign/n — a single-bit power of two
+        loss = autograd.reduce_mean(
+            autograd.Abs()(autograd.sub(out, y)))
+        self._optimizer.backward_and_update(loss)
+        return out, loss
+
+
+def _dyadic(rs, *shape):
+    return (rs.randint(-4, 5, shape) / 4.0).astype(np.float32)
+
+
+def _train_exact_pipe(plan, accum=None, steps=4, guard_nan_step=None):
+    from singa_tpu import resilience  # noqa: F401
+
+    dev = device_mod.get_default_device()
+    dev.SetRandSeed(13)
+    rs = np.random.RandomState(0)
+    X = _dyadic(rs, 16, 8)
+    Y = _dyadic(rs, 16, 8)
+    m = _ExactPipeNet()
+    m.set_optimizer(opt.SGD(lr=0.25))
+    tx, ty = tensor.from_numpy(X), tensor.from_numpy(Y)
+    kw = {}
+    if plan is not None:
+        kw["plan"] = plan
+    if accum:
+        kw["grad_accum"] = accum
+    m.compile([tx], is_train=True, use_graph=True, **kw)
+    losses = []
+    for i in range(steps):
+        if guard_nan_step == i:
+            bad = X.copy()
+            bad[0, 0] = np.nan
+            losses.append(float(
+                m(tensor.from_numpy(bad), ty)[1].to_numpy()))
+        else:
+            losses.append(float(m(tx, ty)[1].to_numpy()))
+    params = {k: np.asarray(v.data)
+              for k, v in m.get_params().items()}
+    return m, losses, params
+
+
+_GEOMETRIES = [dict(data=2, pipe=4), dict(data=4, pipe=2),
+               dict(data=4, model=2), dict(data=2, model=2, pipe=2)]
+
+
+class TestPipelinePlanParity:
+    """THE acceptance pin (ISSUE 10): the 1F1B pipeline step on the
+    8-device CPU mesh matches the single-mesh step on
+    exact-arithmetic data — the step's produced STATE (every updated
+    param array) is BIT-identical with grad accumulation on and off,
+    and the multi-step loss trajectory matches within a few f32 ulp
+    (the reported loss scalar's reduction GROUPING differs between
+    the monolithic 128-term sum and the per-shard/per-microbatch
+    partial sums; once values carry freshly-rounded mantissas, equal
+    sums in different groupings can differ in the last bit — the same
+    boundary PR 4's accum bit-identity drew by comparing same-layout
+    runs)."""
+
+    @pytest.mark.parametrize("accum", [None, 2])
+    def test_1f1b_step_state_bit_identical(self, accum):
+        _, l_s, p_s = _train_exact_pipe(None, accum=accum, steps=1)
+        _, l_p, p_p = _train_exact_pipe(
+            ParallelPlan(data=2, pipe=4), accum=accum, steps=1)
+        for k in p_s:
+            assert np.array_equal(p_s[k], p_p[k]), k
+        if accum:
+            # with accumulation on, even the loss scalar's grouping
+            # (per-microbatch partials) aligns: full bit identity
+            assert l_p == l_s
+        else:
+            np.testing.assert_allclose(l_p, l_s, rtol=1e-6)
+
+    def test_1f1b_accum_step_fully_bit_identical_all_geometries(self):
+        """accum=2: loss AND params bit-identical for every 2D/3D
+        geometry in one swing (incl. the stage folding at pipe=2 and
+        the dp x model x pipe 3D mesh)."""
+        _, l_s, p_s = _train_exact_pipe(None, accum=2, steps=1)
+        for geom in _GEOMETRIES:
+            _, l_p, p_p = _train_exact_pipe(
+                ParallelPlan(**geom), accum=2, steps=1)
+            assert l_p == l_s, geom
+            for k in p_s:
+                assert np.array_equal(p_s[k], p_p[k]), (geom, k)
+
+    @pytest.mark.parametrize("accum", [None, 2])
+    def test_1f1b_trajectory_parity(self, accum):
+        _, single, _ = _train_exact_pipe(None, accum=accum)
+        _, piped, _ = _train_exact_pipe(
+            ParallelPlan(data=2, pipe=4), accum=accum)
+        np.testing.assert_allclose(piped, single, rtol=2e-6)
+
+    def test_dp_pipe_vs_dp_model_2d_parity(self):
+        """2D smoke subset (tier-1): dp x pipe and dp x model both
+        reproduce the single-mesh trajectory (the full sweep is
+        `-m slow`)."""
+        _, single, _ = _train_exact_pipe(None)
+        _, dp_pipe2, _ = _train_exact_pipe(ParallelPlan(data=4,
+                                                        pipe=2))
+        _, dp_model, _ = _train_exact_pipe(ParallelPlan(data=4,
+                                                        model=2))
+        np.testing.assert_allclose(dp_pipe2, single, rtol=2e-6)
+        np.testing.assert_allclose(dp_model, single, rtol=2e-6)
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("geometry", _GEOMETRIES)
+    @pytest.mark.parametrize("accum", [None, 2, 4])
+    @pytest.mark.parametrize("schedule", ["1f1b", "gpipe"])
+    def test_full_geometry_sweep(self, geometry, accum, schedule):
+        """The exhaustive 2D/3D x accum x schedule sweep — beyond the
+        tier-1 budget, `-m slow` (the chaos-soak split idiom): step
+        state bit-identical, trajectory within a few ulp."""
+        _, single, p_s = _train_exact_pipe(None, accum=accum)
+        plan = ParallelPlan(pipeline_schedule=schedule, **geometry)
+        _, piped, p_p = _train_exact_pipe(plan, accum=accum)
+        np.testing.assert_allclose(piped, single, rtol=2e-6)
+        _, _, p_s1 = _train_exact_pipe(None, accum=accum, steps=1)
+        _, _, p_p1 = _train_exact_pipe(plan, accum=accum, steps=1)
+        for k in p_s1:
+            assert np.array_equal(p_s1[k], p_p1[k]), k
+
+    def test_guard_skip_fires_identically_across_stages(self):
+        """PR 3 step guard on the pipeline mesh: a NaN batch skips the
+        apply on EVERY stage (params bit-identical to pre-step on all
+        chips), and the trajectory re-joins the clean run afterwards."""
+        from singa_tpu import resilience
+
+        try:
+            device_mod.set_step_guard(True)
+            _, single, p_s = _train_exact_pipe(None, guard_nan_step=1)
+            resilience.reset_state()
+            m, piped, p_p = _train_exact_pipe(
+                ParallelPlan(data=2, pipe=4), guard_nan_step=1)
+            assert np.isnan(single[1]) and np.isnan(piped[1])
+            # the clean steps re-join the single-mesh trajectory: the
+            # skipped step left every stage's params bit-identical to
+            # pre-step on both runs
+            np.testing.assert_allclose(
+                [piped[0]] + piped[2:], [single[0]] + single[2:],
+                rtol=1e-5)
+            for k in p_s:
+                np.testing.assert_allclose(p_s[k], p_p[k], rtol=2e-6,
+                                           atol=1e-7, err_msg=k)
+            snap = m.cache_stats()["resilience"]
+            assert snap["steps_skipped"] >= 1
+        finally:
+            device_mod.set_step_guard(False)
+            resilience.reset_state()
+
+    def test_export_cache_miss_on_plan_flip_rehit_on_flip_back(
+            self, tmp_path):
+        """PR 6 contract: the AOT artifact key carries the plan
+        fingerprint — flip => miss (new artifact), flip back =>
+        warm hit."""
+        from singa_tpu import export_cache
+
+        plan_a = ParallelPlan(data=2, pipe=4)
+        plan_b = ParallelPlan(data=4, pipe=2)
+        try:
+            device_mod.set_export_cache(str(tmp_path))
+
+            def counters():
+                s = export_cache.export_stats()
+                return s.hits, s.misses, s.saves
+
+            _train_exact_pipe(plan_a, steps=1)
+            h0, m0, s0 = counters()
+            assert s0 >= 1  # plan A's artifact published
+            _train_exact_pipe(plan_b, steps=1)
+            h1, m1, s1 = counters()
+            assert m1 > m0 and s1 > s0  # flip: miss + new artifact
+            assert h1 == h0
+            _train_exact_pipe(plan_a, steps=1)
+            h2, m2, s2 = counters()
+            assert h2 > h1  # flip back: warm hit, no new trace
+            assert s2 == s1
+        finally:
+            device_mod.set_export_cache(None)
+
+
 def test_mesh_checkpoint_restores_on_single_device(tmp_path):
     """save_states from a mesh-sharded model -> load into a fresh
     single-device model: outputs equal, optimizer slots carried."""

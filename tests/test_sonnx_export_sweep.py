@@ -212,6 +212,17 @@ EXPORT_UNSUPPORTED = {
                            "exports inference graphs only",
     "MeanSquareError": "loss head (inference-graph export only)",
     "BinaryCrossEntropy": "loss head (inference-graph export only)",
+    # Multi-axis parallel ops (ISSUE 10): schedule/dispatch composites
+    # over mesh collectives — ONNX has no pipeline-schedule or
+    # expert-dispatch representation; inference export of models using
+    # them goes through their sequential/dense math by re-tracing, not
+    # through a single node.
+    "PipelineApply": "pipeline schedule composite (shard_map/ppermute "
+                     "collectives have no ONNX node; off-mesh it is a "
+                     "plain composition of exportable ops)",
+    "MoEFFN": "GShard expert-dispatch composite (capacity-factored "
+              "one-hot dispatch + aux loss head; no single ONNX node, "
+              "loss-head semantics are train-only)",
 }
 
 
@@ -337,6 +348,16 @@ def test_unexportable_actually_raise(name):
             lambda x: A.BinaryCrossEntropy(
                 _RS.rand(3, 4).round().astype(np.float32))(x),
             [_t(_RS.rand(3, 4).astype(np.float32) * 0.8 + 0.1)]),
+        "PipelineApply": (
+            lambda x: A.PipelineApply(
+                lambda p, h: h @ p["W"], ("W",), 2)(
+                    x, _t(_r(2, 4, 4))),
+            [_t(_r(3, 4))]),
+        "MoEFFN": (
+            lambda x: A.MoEFFN()(
+                x, _t(_r(4, 2)), _t(_r(2, 4, 8)), _t(_r(2, 8)),
+                _t(_r(2, 8, 4)), _t(_r(2, 4)))[0],
+            [_t(_r(6, 4))]),
     }[name]
     fn, inputs = build
     with pytest.raises(ValueError, match="no ONNX mapping"):

@@ -33,10 +33,19 @@ def log(msg):
           file=sys.stderr, flush=True)
 
 
-def _setup_platform(platform):
+def _setup_platform(platform, devices=0):
     """Force a jax platform before backend init (the bench.py
     BENCH_PLATFORM idiom — this image's sitecustomize force-registers
-    the TPU plugin, so plain env vars are not enough)."""
+    the TPU plugin, so plain env vars are not enough). `devices` > 0
+    requests that many VIRTUAL host devices (CPU only) so the
+    multi-axis mesh-geometry knobs (ISSUE 10) can be scored without a
+    chip — must land in XLA_FLAGS before the backend client exists."""
+    if devices:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={devices}"
+        ).strip()
+
     import jax
 
     if platform:
@@ -122,6 +131,46 @@ def _factories(args):
 
         return model_factory, make_inputs, ["tiny-cnn"]
 
+    if args.model == "pipe-mlp":
+        # Multi-axis workload (ISSUE 10): a PipelineStack + MoE MLP
+        # whose program genuinely changes under the mesh_geometry /
+        # pipeline_microbatches / moe_capacity_factor knobs — the
+        # model the multi-axis search smoke exercises on the
+        # 8-virtual-device CPU mesh (--devices 8 --platform cpu).
+        from singa_tpu import autograd
+
+        class PipeMLP(model.Model):
+            def __init__(self):
+                super().__init__(name="pipe_mlp")
+                self.stack = layer.PipelineStack.mlp(4)
+                self.moe = layer.MoE(4, 32)
+                self.fc = layer.Linear(10)
+
+            def forward(self, x):
+                return self.fc(self.moe(self.stack(x)))
+
+            def train_one_batch(self, x, y):
+                out = self.forward(x)
+                loss = autograd.softmax_cross_entropy(out, y)
+                loss = autograd.add(loss, autograd.mul(
+                    self.moe.aux_loss, np.float32(0.01)))
+                self._optimizer.backward_and_update(loss)
+                return out, loss
+
+        def model_factory():
+            dev.SetRandSeed(7)
+            return PipeMLP(), opt.SGD(lr=0.1, momentum=0.9)
+
+        def make_inputs():
+            rs = np.random.RandomState(0)
+            x = tensor.from_numpy(
+                rs.randn(batch, 16).astype(np.float32))
+            y = tensor.from_numpy(
+                rs.randint(0, 10, batch).astype(np.int32))
+            return [x, y]
+
+        return model_factory, make_inputs, ["pipe-mlp"]
+
     if args.model == "mlp":
         from singa_tpu import autograd
 
@@ -161,7 +210,11 @@ def _factories(args):
 def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--model", default="resnet",
-                   choices=["resnet", "tiny-cnn", "mlp"])
+                   choices=["resnet", "tiny-cnn", "mlp", "pipe-mlp"])
+    p.add_argument("--devices", type=int, default=0,
+                   help="force N virtual host devices (CPU) so the "
+                   "multi-axis mesh-geometry knobs score without a "
+                   "chip; 0 = whatever the backend has")
     p.add_argument("--depth", type=int, default=18,
                    help="resnet depth (18 keeps the CPU search fast; "
                    "the fingerprint keys per depth)")
@@ -200,7 +253,7 @@ def main():
                    help="search only; do not persist the winner")
     args = p.parse_args()
 
-    jax = _setup_platform(args.platform)
+    jax = _setup_platform(args.platform, devices=args.devices)
     from singa_tpu import tuning
 
     d = jax.devices()[0]

@@ -169,6 +169,24 @@ def main():
                          f"(p50 {r.get('p50_ms')} ms/p99 "
                          f"{r.get('p99_ms')} ms{occ}{sx}{ch}"
                          + _stage_breakdown(r) + ")" + mark))
+        elif "pipeline_images_per_sec" in r:
+            # multi-axis parallel stage (ISSUE 10): pipeline img/s +
+            # measured-vs-analytic bubble, MoE tok/s + dropped
+            # fraction; old logs (no key) fold unchanged
+            bm = r.get("bubble_fraction_measured")
+            ba = r.get("bubble_fraction_analytic")
+            tuned = ", tuned=✓" if r.get("tuned_config") is not None \
+                else ""
+            rows.append((stage,
+                         f"{r['pipeline_images_per_sec']:.1f} img/s "
+                         f"(P={r.get('pipe')} M={r.get('microbatches')}"
+                         f" {r.get('schedule')}, bubble "
+                         f"{bm if bm is not None else '-'}"
+                         f" vs {ba} analytic); moe "
+                         f"{r.get('moe_tokens_per_sec', 0):.0f} tok/s "
+                         f"(E={r.get('experts')}, dropped "
+                         f"{r.get('dropped_token_fraction')})"
+                         + tuned + _stage_breakdown(r) + mark))
         elif "tokens_per_sec" in r:
             diet = ("" if r.get("slot_dtype") in (None, "fp32")
                     else f", slot_dtype={r['slot_dtype']}")

@@ -82,6 +82,46 @@ for line in sys.stdin:
   exit $?
 fi
 
+if [ "$1" = "parallel" ]; then
+  dir=${2:-metrics}
+  # multi-axis trainer streams are tagged *parallel* (ISSUE 10:
+  # bench.py --stage parallel appends per-block records there)
+  f=$(ls -t "$dir"/*parallel*.jsonl 2>/dev/null | head -1)
+  if [ -z "$f" ]; then
+    echo "tpu_watch: no parallel metrics JSONL under $dir/ yet" >&2
+    exit 1
+  fi
+  echo "tpu_watch: tailing $f" >&2
+  tail -n +1 -F "$f" | python3 -u -c '
+import json, sys
+
+for line in sys.stdin:
+    line = line.strip()
+    if not line:
+        continue
+    try:
+        r = json.loads(line)
+    except ValueError:
+        continue  # partial trailing line from a killed writer
+    if not isinstance(r, dict):
+        continue
+    x = r.get("extra") or {}
+    arm = x.get("arm", "?")
+    bits = ["step " + str(r.get("step", "?")).rjust(5),
+            "arm " + str(arm),
+            "loss " + str(r.get("loss")),
+            "ex/s " + str(round(r.get("examples_per_sec", 0)))]
+    if arm == "pipeline":
+        bits.append(f"P={x.get('pipe')} M={x.get('microbatches')} "
+                    f"{x.get('schedule')}")
+    elif arm == "moe":
+        bits.append(f"E={x.get('experts')} dropped "
+                    f"{x.get('dropped_frac')}")
+    print("  ".join(bits))
+'
+  exit $?
+fi
+
 if [ "$1" = "serve" ]; then
   dir=${2:-metrics}
   # serving streams are tagged *serve*; fall back to the newest JSONL
