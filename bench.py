@@ -1332,7 +1332,15 @@ def stage_serve(requests, deadline_s, rate=0.0, max_batch=64,
             if now < arrivals[i]:
                 time.sleep(arrivals[i] - now)
             try:
-                futures[i] = ceng.submit(x)
+                # BUGFIX (ISSUE 11): the client used to treat
+                # ServeOverloadError as terminal, refusing requests
+                # the documented retry_after_ms contract says to
+                # retry — measured availability under-reported the
+                # engine. submit_with_backoff honors the hint (seed-
+                # jittered, capped so the open loop stays open).
+                futures[i] = serve.submit_with_backoff(
+                    ceng.submit, x, seed=2, max_attempts=3,
+                    max_sleep_s=0.05)
             except (serve.ServeOverloadError,
                     serve.ServeQueueFullError):
                 refused += 1
@@ -1419,6 +1427,293 @@ def stage_serve(requests, deadline_s, rate=0.0, max_batch=64,
         "forward_traces": traces,
         "n_buckets": pol.n_buckets(),
         "retrace_bound_ok": bool(traces <= pol.n_buckets()),
+        "max_batch": max_batch,
+        "max_wait_ms": max_wait_ms,
+        "stage_seconds": stage_secs,
+        "export_cache": export_info,
+        "metrics_jsonl": os.path.relpath(mpath, HERE),
+    }
+    if chaos_out is not None:
+        out["chaos"] = chaos_out
+    log(f"RESULT {out}")
+    print(json.dumps(out), flush=True)
+
+
+def stage_fleet(requests, deadline_s, rate=0.0, replicas=3,
+                max_batch=32, max_wait_ms=1.0, chaos=False):
+    """Fleet serving (ISSUE 11): drive `singa_tpu.fleet.FleetRouter`
+    over N in-process `EngineReplica`s with a seeded Poisson
+    OPEN-LOOP generator (retry-after-aware client:
+    `serve.submit_with_backoff`) and report `fleet_requests_per_sec`
+    + p50/p99 vs the batch=1 sequential baseline, plus the fleet-wide
+    zero-silent-loss reconciliation flag (`fleet.reconcile` — all
+    three equations exact).
+
+    `--chaos` adds a second fleet over the SAME arrival schedule with
+    per-replica engine injectors (transient dispatch fails/hangs,
+    poison, device loss) AND a router-level injector firing hard
+    `replica_kill`s mid-load plus `replica_hang`/`stale_health` —
+    reporting availability %, failover/restart/ejection counters, and
+    the reconciliation flag under fire. CPU-runnable by design, like
+    the serve stage: dyadic params make replies bit-identical to the
+    unbatched forward by arithmetic, across failovers and restarts.
+    """
+    import numpy as np
+
+    t_stage0 = time.time()
+    _setup_jax()
+    import jax.numpy as jnp
+
+    from singa_tpu import device, export_cache, fleet, layer, model, \
+        resilience, serve, stats, tensor
+    from singa_tpu import trace as trace_mod
+
+    hard_stop = time.time() + deadline_s
+    FEATS, HIDDEN, CLASSES = 32, 32, 8
+
+    class ServeMLP(model.Model):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = layer.Linear(HIDDEN)
+            self.r1 = layer.ReLU()
+            self.fc2 = layer.Linear(CLASSES)
+
+        def forward(self, x):
+            return self.fc2(self.r1(self.fc1(x)))
+
+    def make_factory(i):
+        # Each replica owns its device (fleet.EngineReplica contract:
+        # N dispatcher threads must not share RNG-key state) and
+        # rebuilds the SAME dyadic params from the fixed seed, so a
+        # restarted replica's replies stay bit-identical.
+        def factory():
+            dev = device.create_replica_device(i)
+            dev.SetRandSeed(0)
+            m = ServeMLP()
+            m.compile([tensor.from_numpy(
+                np.zeros((max_batch, FEATS), np.float32), device=dev)],
+                is_train=False, use_graph=True)
+            m.eval()
+            for p in m.param_tensors():
+                p.data = jnp.round(p.data * 16.0) / 16.0
+            return m
+        return factory
+
+    device.set_shape_buckets(max_batch=max_batch)
+    ref = make_factory(replicas)()  # off-fleet reference model
+    ref_dev = ref.param_tensors()[0].device
+    setup_s = time.time() - t_stage0
+
+    # Populate-once-start-N (the tools/prewarm.py flow): with the
+    # shared store armed, every replica start AND every supervisor
+    # restart is deserialize-only.
+    t0 = time.time()
+    if export_cache.active():
+        built = serve.prewarm_forward(
+            ref, [((FEATS,), "float32")], max_batch=max_batch)
+        log(f"prewarm: {sum(1 for r in built if r['status'] != 'present')}"
+            f" built / {len(built)} buckets (shared store)")
+    rs = np.random.RandomState(0)
+    reqs = [(rs.randint(-16, 16, (1, FEATS)) / 8.0).astype(np.float32)
+            for _ in range(requests)]
+    refs = [None] * requests
+    for x in reqs[:5]:
+        ref.forward_graph(tensor.from_numpy(x, device=ref_dev))
+    t_cal = time.time()
+    n_cal = min(40, requests)
+    for i, x in enumerate(reqs[:n_cal]):
+        refs[i] = np.asarray(ref.forward_graph(
+            tensor.from_numpy(x, device=ref_dev)).data).copy()
+    seq_est_rps = n_cal / max(time.time() - t_cal, 1e-9)
+    for i in range(n_cal, requests):
+        refs[i] = np.asarray(ref.forward_graph(
+            tensor.from_numpy(reqs[i], device=ref_dev)).data).copy()
+    rate = float(rate) or 4.0 * seq_est_rps * replicas
+    compile_s = time.time() - t0
+    log(f"calibrated sequential ~{seq_est_rps:.0f} req/s; poisson "
+        f"rate {rate:.0f} req/s over {replicas} replicas")
+    rs_arr = np.random.RandomState(1)
+    arrivals = np.cumsum(rs_arr.exponential(1.0 / rate, requests))
+
+    def run_fleet(router, seed):
+        """One pass over the arrival schedule; returns (futures,
+        refused, makespan_s)."""
+        futures = [None] * requests
+        refused = 0
+        t0 = time.perf_counter()
+        for i, x in enumerate(reqs):
+            now = time.perf_counter() - t0
+            if now < arrivals[i]:
+                time.sleep(arrivals[i] - now)
+            try:
+                futures[i] = serve.submit_with_backoff(
+                    router.submit, x, seed=seed, max_attempts=3,
+                    max_sleep_s=0.05)
+            except (serve.ServeOverloadError, serve.ServeQueueFullError,
+                    fleet.FleetUnavailableError):
+                refused += 1
+        return futures, refused, t0
+
+    def resolve(futures, collect_latency=True):
+        """(delivered, failed, match, latencies, t_last) resolving
+        every future; None on deadline."""
+        delivered, failed, match = 0, 0, True
+        lats, t_last = [], 0.0
+        for i, r in enumerate(futures):
+            if r is None:
+                continue
+            try:
+                got = r.result(timeout=max(hard_stop - time.time(), 5))
+            except TimeoutError:
+                return None
+            except (serve.ServeDispatchError, serve.ServeDeadlineError,
+                    serve.ServeClosedError, serve.ServeOverloadError,
+                    fleet.FleetUnavailableError):
+                failed += 1
+                continue
+            match = match and np.array_equal(got, refs[i])
+            if collect_latency and r.latency_s is not None:
+                lats.append(r.latency_s)
+            if r.t_reply and r.t_reply > t_last:
+                t_last = r.t_reply
+            delivered += 1
+        return delivered, failed, match, lats, t_last
+
+    # -- clean fleet arm ---------------------------------------------------
+    t_steady0 = time.time()
+    mpath = os.path.join(HERE, "metrics", "bench_fleet.jsonl")
+    mlog = trace_mod.MetricsLogger(mpath)
+    s0 = stats.cache_stats()
+    reps = [fleet.EngineReplica(
+        f"r{i}", make_factory(i),
+        {"max_batch": max_batch, "max_wait_ms": max_wait_ms})
+        for i in range(replicas)]
+    router = fleet.FleetRouter(reps, metrics=mlog,
+                               supervise_interval_s=0.01).start()
+    warmed = router.warmup(reqs[0])
+    log(f"fleet warmup: {warmed} bucket programs over {replicas} "
+        "replicas")
+    futures, refused, t0 = run_fleet(router, seed=0)
+    res = resolve(futures)
+    if res is None:
+        router.stop()
+        mlog.close()
+        print(json.dumps({"ok": False,
+                          "error": "deadline inside fleet run"}),
+              flush=True)
+        return
+    delivered, failed_n, match, lats, t_last = res
+    # throughput counts DELIVERED replies only (refused/failed
+    # requests were not served), and a zero-delivery run must report
+    # 0, not requests/epsilon
+    fleet_rps = (delivered / (t_last - t0)
+                 if delivered and t_last > t0 else 0.0)
+    router.stop()
+    s1 = stats.cache_stats()
+    rec = fleet.reconcile(s0["serve"], s1["serve"],
+                          s0["fleet"], s1["fleet"])
+    steady_s = time.time() - t_steady0
+    lat = np.asarray(lats) * 1e3
+    fsnap = s1["fleet"]
+
+    # -- chaos arm (--chaos): same schedule, kills mid-load ----------------
+    chaos_out = None
+    if chaos:
+        t_chaos0 = time.time()
+        c0 = stats.cache_stats()
+        creps = []
+        for i in range(replicas):
+            inj = resilience.FaultInjector(seed=3 + i, schedule={
+                "dispatch_fail": 0.04,
+                "dispatch_hang": 0.02,
+                "poison_request": 0.01,
+                "device_lost_serve": 0.02,
+            }, hang_s=0.002)
+            creps.append(fleet.EngineReplica(
+                f"c{i}", make_factory(i),
+                {"max_batch": max_batch, "max_wait_ms": max_wait_ms,
+                 "max_retries": 1, "backoff_ms": 0.2,
+                 "shed_watermark": 512, "max_restarts": 1000,
+                 "fault_injector": inj}))
+        finj = resilience.FaultInjector(seed=7, schedule={
+            # hard kills pinned mid-load (the acceptance scenario),
+            # plus probabilistic hangs/stale snapshots
+            "replica_kill": {max(2, requests // 3),
+                             max(3, (2 * requests) // 3)},
+            "replica_hang": 0.01,
+            "stale_health": 0.01,
+        }, hang_s=0.02)
+        crouter = fleet.FleetRouter(
+            creps, fault_injector=finj, supervise_interval_s=0.01,
+            health_max_age_s=0.5, probe_backoff_ms=20.0,
+            max_restarts=100, max_failover_hops=3, seed=7).start()
+        crouter.warmup(reqs[0])
+        cfutures, crefused, _ = run_fleet(crouter, seed=7)
+        cres = resolve(cfutures)
+        if cres is None:
+            crouter.stop()
+            mlog.close()
+            print(json.dumps({"ok": False,
+                              "error": "deadline inside fleet chaos "
+                                       "arm"}), flush=True)
+            return
+        cdelivered, cfailed, cmatch, clats, _ = cres
+        crouter.stop()
+        c1 = stats.cache_stats()
+        crec = fleet.reconcile(c0["serve"], c1["serve"],
+                               c0["fleet"], c1["fleet"])
+        cd = {k: c1["fleet"][k] - c0["fleet"][k] for k in
+              ("failovers", "restarts", "ejections", "rejoins",
+               "kills_injected", "refused", "shed_retries")}
+        submitted = len([f for f in cfutures if f is not None])
+        clat = np.asarray(clats) * 1e3
+        chaos_out = {
+            "availability_pct": round(
+                100.0 * cdelivered / max(submitted, 1), 2),
+            "delivered": cdelivered,
+            "failed": cfailed,
+            "refused": crefused,
+            "p50_ms": (round(float(np.percentile(clat, 50)), 3)
+                       if cdelivered else None),
+            "p99_ms": (round(float(np.percentile(clat, 99)), 3)
+                       if cdelivered else None),
+            "replies_match": bool(cmatch),
+            "failovers": cd["failovers"],
+            "restarts": cd["restarts"],
+            "ejections": cd["ejections"],
+            "kills": cd["kills_injected"],
+            "counters_reconcile": bool(crec["ok"]),
+            "seconds": round(time.time() - t_chaos0, 2),
+        }
+        log(f"fleet chaos arm: availability "
+            f"{chaos_out['availability_pct']}% p99 "
+            f"{chaos_out['p99_ms']} ms ({cd['kills_injected']} kills, "
+            f"{cd['failovers']} failovers, {cd['restarts']} restarts, "
+            f"reconcile={crec['ok']})")
+
+    stage_secs, export_info = _stage_obs(setup_s, compile_s, 0.0,
+                                         steady_s)
+    mlog.close()
+    out = {
+        "ok": True, "metric": "fleet_requests_per_sec",
+        "requests": requests,
+        "replicas": replicas,
+        "rate_rps": round(rate, 1),
+        "fleet_requests_per_sec": round(fleet_rps, 1),
+        "sequential_requests_per_sec": round(seq_est_rps, 1),
+        "speedup_vs_sequential": round(fleet_rps / seq_est_rps, 2),
+        "p50_ms": (round(float(np.percentile(lat, 50)), 3)
+                   if len(lat) else None),
+        "p99_ms": (round(float(np.percentile(lat, 99)), 3)
+                   if len(lat) else None),
+        "delivered": delivered,
+        "failed": failed_n,
+        "refused": refused,
+        "replies_match": bool(match),
+        "routed": fsnap["routed"] - s0["fleet"]["routed"],
+        "failovers": fsnap["failovers"] - s0["fleet"]["failovers"],
+        "restarts": fsnap["restarts"] - s0["fleet"]["restarts"],
+        "counters_reconcile": bool(rec["ok"]),
         "max_batch": max_batch,
         "max_wait_ms": max_wait_ms,
         "stage_seconds": stage_secs,
@@ -1520,10 +1815,14 @@ def main():
                    help="serve stage: rows per fused dispatch "
                    "(pow2; also the bucket ceiling)")
     p.add_argument("--chaos", action="store_true",
-                   help="serve stage: add an injected-fault arm "
-                   "(seed-keyed dispatch_fail/hang/poison/device-"
-                   "lost) reporting availability %% and p99 under "
+                   help="serve/fleet stages: add an injected-fault "
+                   "arm (seed-keyed dispatch_fail/hang/poison/device-"
+                   "lost; fleet adds hard replica kills + stale "
+                   "health) reporting availability %% and p99 under "
                    "faults next to the clean row")
+    p.add_argument("--replicas", type=int, default=3,
+                   help="fleet stage: in-process serving replicas "
+                   "behind the router")
     p.add_argument("--pipe", type=int, default=4,
                    help="parallel stage: pipeline depth (stages = "
                    "pipe; mesh is data=8/pipe x pipe)")
@@ -1560,6 +1859,11 @@ def main():
     if a.stage == "serve":
         return stage_serve(a.requests, a.deadline, rate=a.rate,
                            max_batch=a.serve_max_batch,
+                           max_wait_ms=a.max_wait_ms, chaos=a.chaos)
+    if a.stage == "fleet":
+        return stage_fleet(a.requests, a.deadline, rate=a.rate,
+                           replicas=a.replicas,
+                           max_batch=min(a.serve_max_batch, 32),
                            max_wait_ms=a.max_wait_ms, chaos=a.chaos)
     if a.stage == "parallel":
         return stage_parallel(a.steps, a.deadline, pipe=a.pipe,
@@ -1762,6 +2066,20 @@ def main():
                 result_extra["serve_p99_ms"] = srv["p99_ms"]
                 result_extra["serve_speedup_vs_sequential"] = (
                     srv["speedup_vs_sequential"])
+        # Fleet serving (ISSUE 11): router over N replicas with a
+        # replica-kill chaos arm — availability + fleet-wide
+        # reconciliation next to the single-engine serve row.
+        if remaining() > 240:
+            flt = run_stage("fleet", ["--requests", "300",
+                                      "--deadline", "200",
+                                      "--chaos"], 270)
+            if flt and flt.get("ok"):
+                result_extra["fleet_requests_per_sec"] = (
+                    flt["fleet_requests_per_sec"])
+                result_extra["fleet_p99_ms"] = flt["p99_ms"]
+                if isinstance(flt.get("chaos"), dict):
+                    result_extra["fleet_chaos_availability_pct"] = (
+                        flt["chaos"]["availability_pct"])
         # Multi-axis parallel trainer (ISSUE 10): 1F1B pipeline img/s
         # + bubble fraction and MoE tok/s + dropped fraction on the
         # 8-virtual-device CPU mesh — chip-independent mesh

@@ -17,6 +17,9 @@ Layer map (mirrors SURVEY.md §1, re-designed TPU-first):
     serve                continuous-batching inference serving tier
                          (admission queue, bucket-padded fused
                          dispatch, SLO percentiles, prewarm)
+    fleet                health-aware router over N serving replicas
+                         (failover, shed-aware retry, drain,
+                         supervised restarts, replica-kill chaos)
     converter            Caffe prototxt importer
     io/ + native/        record IO, snapshot, C++ runtime pieces
 """
@@ -28,6 +31,7 @@ from . import checkpoint  # noqa: F401
 from . import data  # noqa: F401
 from . import device  # noqa: F401
 from . import export_cache  # noqa: F401
+from . import fleet  # noqa: F401
 from . import initializer  # noqa: F401
 from . import io  # noqa: F401
 from . import layer  # noqa: F401

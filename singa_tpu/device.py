@@ -45,6 +45,7 @@ __all__ = [
     "create_cpu_device",
     "create_tpu_device",
     "create_tpu_device_on",
+    "create_replica_device",
     "create_tpu_devices",
     "get_default_device",
     "enable_lazy_alloc",  # no-op parity shim
@@ -82,6 +83,7 @@ __all__ = [
     # the state) + its resilience layer (ISSUE 8).
     "set_serving",
     "set_serving_resilience",
+    "set_fleet",
     # Migration aliases (reference names):
     "create_cuda_gpu",
     "create_cuda_gpu_on",
@@ -369,6 +371,19 @@ def create_tpu_device() -> Device:
 
 def create_tpu_device_on(device_id: int) -> Device:
     return Platform.CreateTpuDeviceOn(device_id)
+
+
+def create_replica_device(index: int = 0) -> Device:
+    """A PRIVATE Device object for serving replica `index` — NOT the
+    `Platform._cache` singleton `create_tpu_device()` returns. A
+    Device owns single-writer dispatch state (its RNG key); a fleet
+    runs one dispatcher thread per replica, so replicas sharing the
+    cached Device object would race it (`singa_tpu.fleet` docs the
+    failure mode). Replica `index` lands on accelerator
+    `index % n_devices`, so an N-chip host spreads an N-replica fleet
+    one-per-chip while a 1-chip (or CPU) host stacks them safely."""
+    devs = _accel_devices()
+    return TpuDevice(devs[int(index) % len(devs)])
 
 
 def create_tpu_devices(num: int):
@@ -717,6 +732,45 @@ def set_serving_resilience(**kw) -> None:
 
     if kw:
         serve.configure_resilience(**kw)
+
+
+def set_fleet(**kw) -> None:
+    """Process defaults for the fleet serving tier
+    (`singa_tpu.fleet.FleetRouter`; ISSUE 11). Only the keys given
+    change; routers constructed afterwards read them (constructor
+    args override per-router). Keys:
+
+      max_failover_hops     re-submits of one request to DIFFERENT
+                            replicas after a replica fails it
+                            (`ServeDispatchError` / replica death).
+                            Poison verdicts (`ServePoisonedError`)
+                            never fail over. 0 = single-engine
+                            semantics.
+      max_shed_retries      rounds of honoring the smallest
+                            `retry_after_ms` (seed-jittered) when
+                            EVERY replica in rotation sheds; trying a
+                            different replica costs no wait and
+                            always comes first.
+      max_shed_sleep_s      cap on one shed wait.
+      health_max_age_s      health-snapshot age beyond which a
+                            replica is ejected as stale (a wedged
+                            writer stops refreshing; fail closed).
+      probe_backoff_ms      base backoff between rejoin probes of an
+                            ejected replica (doubles per failed
+                            probe, seed-jittered).
+      max_restarts          supervisor restarts per dead replica
+                            before it is abandoned ("failed").
+      supervise_interval_s  supervisor sweep period (restart/rejoin
+                            latency floor).
+      metrics_every         fleet metrics JSONL record every N routed
+                            requests (transitions always log).
+
+    Counters: `cache_stats()["fleet"]` (routed/failovers/refused/
+    rejected, ejections/rejoins/restarts, per-replica state)."""
+    from . import fleet
+
+    if kw:
+        fleet.configure(**kw)
 
 
 def set_dag_auto_flops_per_op(v: float) -> None:

@@ -1,0 +1,1099 @@
+"""Fleet serving: a health-aware router over N serving replicas
+(ISSUE 11; ROADMAP item 2(a) — the fleet leg of "millions of users").
+
+PR 8 made a single `ServingEngine` survive poison inputs, hung
+dispatches, and dispatcher death. A fleet's failure modes live one
+level up: a whole REPLICA dies, a replica's health snapshot goes
+stale while it wedges, a shed storm on one replica starves callers
+that another replica could have served. `FleetRouter` owns that
+level, built on the primitives PR 8 already made fleet-shaped —
+`health()` snapshots, structured `ServeOverloadError.retry_after_ms`,
+terminal-outcome reconciliation, and the shared prewarmed
+export-cache store that makes a new replica deserialize-only at cold
+start (the portable-compiled-artifact lesson of PHAST, arxiv
+2005.13076):
+
+  routing    — every request goes to the LEAST-LOADED replica whose
+      fresh health snapshot says `ready` (`degraded` replicas serve
+      only when nothing is ready — still alive, but under pressure);
+      `unhealthy` replicas and replicas whose snapshot is older than
+      `health_max_age_s` are EJECTED from rotation (a wedged process
+      stops writing transitions, so a stale READY must not route) and
+      probed for rejoin with seed-jittered exponential backoff.
+  failover   — a request whose replica fails it (`ServeDispatchError`
+      after the engine's own retries, or `ServeClosedError` from a
+      replica dying with the request queued) is re-submitted to a
+      DIFFERENT replica, up to `max_failover_hops` hops, each counted.
+      A `ServePoisonedError` NEVER fails over: the bisection verdict
+      says the input itself is bad, and re-submitting would poison
+      every replica in turn.
+  shed-aware retry — a replica shedding load refuses with
+      `retry_after_ms`; the router first tries the OTHER replicas
+      (that is what a fleet is for), and only when everything in
+      rotation sheds does it honor the smallest hint — scaled by the
+      deterministic seed-keyed jitter of `resilience.backoff_delay_s`
+      so a fleet of routers never re-arrives in lockstep — up to
+      `max_shed_retries` rounds before the overload propagates.
+  drain      — `drain(name)` takes a replica out of rotation, lets
+      its in-flight dispatch finish, and REROUTES its queued requests
+      through the failover path (their futures fail `ServeClosedError`
+      on the drained replica; the router re-submits elsewhere) — a
+      rolling restart loses nothing.
+  supervision — a fleet supervisor thread restarts dead (killed)
+      replicas, bounded by `max_restarts` per replica; with the
+      shared export-cache store armed the restarted replica's model
+      is fresh (nothing cached in-process) yet its first dispatch is
+      deserialize-only: store hits >= 1, traces == 0.
+  chaos      — `FleetRouter(..., fault_injector=...)` consumes the
+      fleet-level `resilience.FaultInjector` kinds keyed by the
+      router submit ordinal: `replica_kill` (hard-kill the replica
+      the request just routed to), `replica_hang` (its next dispatch
+      sleeps `hang_s`), `stale_health` (its health snapshot freezes,
+      aging into ejection). The soak in `tests/test_fleet.py` proves
+      availability stays bounded, replies stay bit-identical to the
+      unbatched forward, and the reconciliation below holds exactly.
+
+Zero silent loss, fleet-wide: three equations, all EXACT at
+quiescence (every returned future resolved), checked by
+`fleet.reconcile`:
+
+  engine terminals   serve.requests == replies + expired + shed +
+                     dropped + overflowed + failed      (per PR 8)
+  routing            serve.requests == fleet.routed + fleet.failovers
+                     + fleet.refused   (every engine submit the
+                     router made lands in exactly one bucket)
+  router terminals   fleet.requests == fleet.replies + fleet.failed
+                     + fleet.rejected  (every router future resolves
+                     into exactly one terminal bucket)
+
+`Replica` is a small duck-typed protocol (start/kill/restart/submit/
+health/depth/...) so a later multi-process transport slots in without
+touching the routing logic; `EngineReplica` is the in-process
+implementation over one `ServingEngine`.
+
+Observability: `cache_stats()["fleet"]` (counters + per-replica
+state), `route`/`failover` spans through the PR 5 tracer, and a
+fleet metrics JSONL (one record per state transition plus every
+`metrics_every` routes). Knobs: `device.set_fleet(...)`.
+"""
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from . import export_cache, stats as stats_mod, trace as trace_mod
+from .serve import (
+    ServeClosedError,
+    ServeDeadlineError,
+    ServeDispatchError,
+    ServeOverloadError,
+    ServePoisonedError,
+    ServeQueueFullError,
+    ServingEngine,
+)
+
+__all__ = [
+    "FleetRouter",
+    "FleetReply",
+    "EngineReplica",
+    "FleetUnavailableError",
+    "configure",
+    "get_config",
+    "reconcile",
+]
+
+
+class FleetUnavailableError(RuntimeError):
+    """No replica in rotation could accept the request: everything is
+    ejected/dead/draining, or every live replica refused (queue full)
+    and the shed-retry budget is spent. Counted `rejected` — the
+    router-terminal analogue of the engine's loud queue-full drop."""
+
+
+# ---------------------------------------------------------------------------
+# Process-default knobs (user-facing setter: device.set_fleet).
+# ---------------------------------------------------------------------------
+_CONFIG: Dict = {
+    # Failover re-submits per request after a replica fails it.
+    # 0 = a replica failure is terminal (single-engine semantics).
+    "max_failover_hops": 2,
+    # Rounds of honor-the-hint waiting when EVERY replica in rotation
+    # sheds (trying a different replica costs no wait and comes first).
+    "max_shed_retries": 2,
+    # Cap on one shed wait (retry_after_ms is an estimate; a wild one
+    # must not park the caller for minutes).
+    "max_shed_sleep_s": 1.0,
+    # Health snapshot age beyond which a replica counts as stale =>
+    # ejected (a wedged writer stops refreshing; fail closed).
+    "health_max_age_s": 5.0,
+    # Base backoff between rejoin probes of an ejected replica
+    # (doubles per failed probe, seed-jittered).
+    "probe_backoff_ms": 50.0,
+    # Supervisor restarts per DEAD replica before it is abandoned
+    # ("failed" state, permanently out of rotation).
+    "max_restarts": 3,
+    # Supervisor sweep period (restart/rejoin latency floor).
+    "supervise_interval_s": 0.02,
+    # Emit a fleet metrics record every N routed requests (state
+    # transitions always log). 0 = transitions only.
+    "metrics_every": 32,
+}
+
+
+def configure(**kw) -> Dict:
+    """Update fleet-router defaults. User-facing setter:
+    `device.set_fleet`."""
+    for k, v in kw.items():
+        if k not in _CONFIG:
+            raise KeyError(f"unknown fleet config key {k!r}; known: "
+                           f"{sorted(_CONFIG)}")
+        if k in ("max_failover_hops", "max_shed_retries",
+                 "max_restarts", "metrics_every"):
+            v = int(v)
+            if v < 0:
+                raise ValueError(f"{k} must be >= 0")
+        else:
+            v = float(v)
+            if v <= 0:
+                raise ValueError(f"{k} must be > 0")
+        _CONFIG[k] = v
+    return dict(_CONFIG)
+
+
+def get_config() -> Dict:
+    return dict(_CONFIG)
+
+
+# ---------------------------------------------------------------------------
+# Observability: cache_stats()["fleet"]
+# ---------------------------------------------------------------------------
+class _FleetStats:
+    """Fleet counters. Three families, mirroring the reconciliation
+    equations in the module docstring: router terminals
+    (requests/replies/failed/rejected), engine-submit attempts
+    (routed/failovers/refused), and rotation events
+    (ejections/rejoins/restarts/probes + the chaos injection tallies).
+    `per_replica` in the snapshot is LIVE state assembled from the
+    routers alive right now."""
+
+    def __init__(self):
+        self._routers: "weakref.WeakSet[FleetRouter]" = weakref.WeakSet()
+        self.reset()
+
+    def reset(self) -> None:
+        # router terminals
+        self.requests = 0
+        self.replies = 0
+        self.failed = 0
+        self.rejected = 0
+        # engine-submit attempts
+        self.routed = 0
+        self.failovers = 0
+        self.refused = 0
+        self.shed_retries = 0
+        # rotation events
+        self.ejections = 0
+        self.rejoins = 0
+        self.restarts = 0
+        self.probes = 0
+        self.drains = 0
+        # chaos injections (fleet-level kinds that actually fired)
+        self.kills_injected = 0
+        self.hangs_injected = 0
+        self.stale_injected = 0
+
+    def snapshot(self) -> Dict:
+        per: Dict[str, Dict] = {}
+        for router in list(self._routers):
+            per.update(router.replica_snapshot())
+        return {
+            "requests": self.requests,
+            "replies": self.replies,
+            "failed": self.failed,
+            "rejected": self.rejected,
+            "routed": self.routed,
+            "failovers": self.failovers,
+            "refused": self.refused,
+            "shed_retries": self.shed_retries,
+            "ejections": self.ejections,
+            "rejoins": self.rejoins,
+            "restarts": self.restarts,
+            "probes": self.probes,
+            "drains": self.drains,
+            "kills_injected": self.kills_injected,
+            "hangs_injected": self.hangs_injected,
+            "stale_injected": self.stale_injected,
+            "per_replica": per,
+        }
+
+
+_STATS = _FleetStats()
+stats_mod.register_cache("fleet", _STATS)
+
+
+def fleet_stats() -> _FleetStats:
+    return _STATS
+
+
+def reconcile(serve0: Dict, serve1: Dict, fleet0: Dict,
+              fleet1: Dict) -> Dict:
+    """Check the three zero-silent-loss equations over a
+    (before, after) window of `cache_stats()["serve"]` /
+    `cache_stats()["fleet"]` snapshots. Exact integer equality — one
+    lost future anywhere breaks one of them. Returns the per-equation
+    booleans, the combined `ok`, and the deltas for the failure
+    message."""
+    sd = {k: serve1[k] - serve0[k] for k in
+          ("requests", "replies", "expired", "shed", "dropped",
+           "overflowed", "failed")}
+    fd = {k: fleet1[k] - fleet0[k] for k in
+          ("requests", "replies", "failed", "rejected", "routed",
+           "failovers", "refused")}
+    engine_ok = sd["requests"] == (sd["replies"] + sd["expired"]
+                                   + sd["shed"] + sd["dropped"]
+                                   + sd["overflowed"] + sd["failed"])
+    routing_ok = sd["requests"] == (fd["routed"] + fd["failovers"]
+                                    + fd["refused"])
+    router_ok = fd["requests"] == (fd["replies"] + fd["failed"]
+                                   + fd["rejected"])
+    return {
+        "ok": bool(engine_ok and routing_ok and router_ok),
+        "engine_terminals": bool(engine_ok),
+        "routing": bool(routing_ok),
+        "router_terminals": bool(router_ok),
+        "serve_delta": sd,
+        "fleet_delta": fd,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Replica protocol + the in-process implementation
+# ---------------------------------------------------------------------------
+class EngineReplica:
+    """One in-process serving replica: a `ServingEngine` built from a
+    `model_factory` so `restart()` can rebuild the MODEL too — a
+    restarted replica holds nothing in process memory, which is what
+    makes the deserialize-only cold start from the shared export-cache
+    store provable (store hits, zero traces) rather than an artifact
+    of a still-warm `_JitForward`.
+
+    This class IS the `Replica` protocol a future multi-process
+    transport reimplements (the router calls nothing else):
+
+      start() / kill() / drain_stop() / restart()
+      submit(*arrays, deadline_ms=...) -> ServeReply-like future
+      health() -> dict with "state" and a wall-clock "time" stamp
+                  (no/old "time" reads as stale => ejected; fail
+                  closed, like tools/serve_health.py)
+      depth() -> queued requests right now (the load signal)
+      warmup(*arrays), killed (bool attr)
+
+    plus the chaos hooks the fleet FaultInjector kinds drive:
+    `hang_once(s)` and `freeze_health(s)`.
+
+    `model_factory` must be deterministic (same params every call) if
+    the fleet's bit-identity guarantees are to survive a restart —
+    seed it, or close over a checkpoint path. It must also build the
+    model on its OWN device (`device.create_tpu_device()`), not the
+    shared process default: a fleet runs N dispatcher threads, and
+    the per-device RNG key (`dev._rng_key`) is single-writer state —
+    two replicas tracing on one shared device object race it (a
+    leaked tracer poisons whichever dispatch reads mid-trace). The
+    router warns loudly at `start()` when replicas share a device.
+    """
+
+    def __init__(self, name: str, model_factory,
+                 engine_kwargs: Optional[Dict] = None):
+        self.name = str(name)
+        self._factory = model_factory
+        self._kwargs = dict(engine_kwargs or {})
+        self.engine: Optional[ServingEngine] = None
+        self.killed = False
+        self.restarts = 0
+        self._frozen_snap: Optional[Dict] = None
+        self._frozen_until = 0.0
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "EngineReplica":
+        if self.engine is None:
+            self.engine = ServingEngine(self._factory(), **self._kwargs)
+        self.engine.start()
+        self.killed = False
+        return self
+
+    def kill(self) -> None:
+        """Hard replica death: the queue fails loudly
+        (`ServeClosedError` — the router's failover reroutes those
+        futures), the current in-flight dispatch is given a short
+        bound to finish, and the replica stays dead until
+        `restart()`. The in-process stand-in for a killed worker
+        process whose router tier detects the death."""
+        self.killed = True
+        eng = self.engine
+        if eng is not None:
+            eng.stop(drain=False, drain_timeout_s=0.5)
+
+    def drain_stop(self) -> None:
+        """Drain semantics for the router: stop admitting, let the
+        in-flight dispatch finish, fail the still-queued futures so
+        the router reroutes them (`ServeClosedError` -> failover)."""
+        eng = self.engine
+        if eng is not None:
+            eng.stop(drain=False, drain_timeout_s=1.0)
+
+    def restart(self) -> "EngineReplica":
+        """Fresh model + fresh engine (the old one is torn down if it
+        still runs). With the shared export-cache store armed and
+        prewarmed, the new engine's first dispatch of every bucket is
+        a store LOAD — deserialize-only cold start."""
+        old, self.engine = self.engine, None
+        if old is not None:
+            try:
+                old.stop(drain=False, drain_timeout_s=0.2)
+            except Exception:
+                pass
+        self.restarts += 1
+        self._frozen_snap = None
+        return self.start()
+
+    def stop(self, drain: bool = True) -> None:
+        eng = self.engine
+        if eng is not None:
+            eng.stop(drain=drain)
+
+    # -- request path -----------------------------------------------------
+    def submit(self, *arrays, deadline_ms: Optional[float] = None):
+        eng = self.engine
+        if eng is None or self.killed:
+            raise ServeClosedError(f"replica {self.name} is dead")
+        return eng.submit(*arrays, deadline_ms=deadline_ms)
+
+    def warmup(self, *arrays) -> int:
+        eng = self.engine
+        if eng is None:
+            raise ServeClosedError(f"replica {self.name} not started")
+        return eng.warmup(*arrays)
+
+    # -- health/load signals ----------------------------------------------
+    def health(self) -> Dict:
+        """Engine health + the wall-clock stamp the router's staleness
+        check reads. Under an injected `stale_health` the LAST
+        truthful snapshot keeps being returned with its old stamp —
+        exactly what a wedged snapshot writer looks like from the
+        router's side."""
+        if (self._frozen_snap is not None
+                and time.perf_counter() < self._frozen_until):
+            return dict(self._frozen_snap)
+        eng = self.engine
+        if eng is None or self.killed:
+            snap = {"state": "unhealthy",
+                    "reasons": [f"replica {self.name} is dead"]}
+        else:
+            snap = eng.health()
+        snap["time"] = round(time.time(), 3)
+        snap["name"] = self.name
+        return snap
+
+    def depth(self) -> int:
+        eng = self.engine
+        if eng is None:
+            return 0
+        return eng._depth
+
+    def device_token(self):
+        """Identity of the device object this replica dispatches on —
+        the router's shared-device check (see class docstring)."""
+        eng = self.engine
+        if eng is None:
+            return None
+        ps = eng.model.param_tensors()
+        return id(ps[0].device) if ps else None
+
+    # -- chaos hooks (fleet FaultInjector kinds) --------------------------
+    def hang_once(self, hang_s: float) -> None:
+        """`replica_hang`: the replica's NEXT dispatch attempt sleeps
+        `hang_s` before proceeding (one-shot, then the hook restores
+        itself) — the mid-fleet stall the drain timeout and the
+        router's depth signal are supposed to absorb."""
+        eng = self.engine
+        if eng is None:
+            return
+        orig = eng._chaos_attempt
+        fired: List[int] = []
+
+        def hooked(group):
+            if not fired:
+                fired.append(1)
+                eng._chaos_attempt = orig
+                time.sleep(float(hang_s))
+            return orig(group)
+
+        eng._chaos_attempt = hooked
+
+    def freeze_health(self, for_s: float) -> None:
+        """`stale_health`: freeze the health surface on the current
+        snapshot for `for_s` seconds. Its timestamp stops advancing,
+        so once `health_max_age_s` passes the router must eject the
+        replica no matter what state the frozen snapshot claims."""
+        self._frozen_snap = self.health()
+        self._frozen_until = time.perf_counter() + float(for_s)
+
+
+class _ReplicaSlot:
+    """Router-side bookkeeping for one replica handle."""
+
+    __slots__ = ("handle", "name", "state", "reason", "routed",
+                 "refusals", "failures", "restarts", "probe_attempt",
+                 "next_probe_t")
+
+    def __init__(self, handle):
+        self.handle = handle
+        self.name = handle.name
+        self.state = "ready"  # ready|degraded|ejected|dead|draining|stopped|failed
+        self.reason = ""
+        self.routed = 0
+        self.refusals = 0
+        self.failures = 0
+        self.restarts = 0
+        self.probe_attempt = 0
+        self.next_probe_t = 0.0
+
+    def in_rotation(self) -> bool:
+        return self.state in ("ready", "degraded")
+
+
+# ---------------------------------------------------------------------------
+# The fleet future
+# ---------------------------------------------------------------------------
+class FleetReply:
+    """Future for one fleet request. `result(timeout)` blocks like
+    `ServeReply.result` — and performs the failover hops IN the
+    caller's wait: when the current replica fails the request
+    retryably (`ServeDispatchError`, `ServeClosedError`) and hops
+    remain, the router re-submits to a different replica and the wait
+    continues. Terminal errors (`ServePoisonedError`,
+    `ServeDeadlineError`, exhausted hops, nothing left to route to)
+    re-raise. A `TimeoutError` is NOT terminal — call again.
+
+    `replica` names where the request currently lives; `hops` counts
+    completed failovers. Exactly one terminal outcome is counted into
+    `cache_stats()["fleet"]` (`replies`/`failed`) per future, however
+    many threads call `result()`."""
+
+    __slots__ = ("_router", "_arrays", "_deadline_abs", "_inner",
+                 "replica", "hops", "_tried", "_lock", "_state_lock",
+                 "_terminal", "_error", "t_submit", "t_reply")
+
+    def __init__(self, router: "FleetRouter", arrays,
+                 deadline_abs: Optional[float], inner, replica: str):
+        self._router = router
+        self._arrays = arrays
+        self._deadline_abs = deadline_abs
+        self._inner = inner
+        self.replica = replica
+        self.hops = 0
+        self._tried = {replica}
+        self._lock = threading.RLock()  # serializes failover work
+        self._state_lock = threading.Lock()  # guards terminal counting
+        self._terminal = False
+        self._error: Optional[BaseException] = None
+        self.t_submit = time.perf_counter()
+        self.t_reply: Optional[float] = None
+
+    def done(self) -> bool:
+        """True when `result()` will return/raise without waiting on a
+        replica. A retryably-failed inner future reads done until
+        `result()` runs the failover, so poll `result(timeout=...)`
+        rather than spinning on `done()` when hops matter."""
+        return self._terminal or self._inner.done()
+
+    @property
+    def state(self) -> str:
+        if self._terminal:
+            return "failed" if self._error is not None else "done"
+        return f"{self._inner.state}@{self.replica}"
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        return (None if self.t_reply is None
+                else self.t_reply - self.t_submit)
+
+    def _finish(self, err: Optional[BaseException]) -> None:
+        with self._state_lock:
+            if self._terminal:
+                return
+            self._terminal = True
+            self._error = err
+            # Latency is submit -> the replica's DELIVERY time when
+            # the inner future has one — the caller may observe the
+            # result long after the reply landed, and that wait is
+            # not serving latency.
+            t = getattr(self._inner, "t_reply", None)
+            self.t_reply = t if t is not None else time.perf_counter()
+        if err is None:
+            _STATS.replies += 1
+        else:
+            _STATS.failed += 1
+
+    def result(self, timeout: Optional[float] = None):
+        t_end = (None if timeout is None
+                 else time.perf_counter() + timeout)
+        with self._lock:
+            while True:
+                if self._terminal:
+                    if self._error is not None:
+                        raise self._error
+                    return self._inner.result(0.0)
+                rem = (None if t_end is None
+                       else max(t_end - time.perf_counter(), 0.0))
+                inner = self._inner
+                try:
+                    val = inner.result(rem)
+                except TimeoutError:
+                    raise  # not terminal: the request is still live
+                except (ServePoisonedError, ServeDeadlineError) as e:
+                    # poison verdicts and deadline expiries are
+                    # terminal BY CONTRACT: re-submitting a poison
+                    # input poisons the next replica, and a deadline
+                    # the caller set has simply passed
+                    self._finish(e)
+                    raise
+                except (ServeDispatchError, ServeClosedError) as e:
+                    if self.hops >= self._router.max_failover_hops:
+                        from .resilience import annotate_exception
+
+                        annotate_exception(
+                            e, f"fleet: {self.hops} failover hop(s) "
+                               f"exhausted (max_failover_hops "
+                               f"{self._router.max_failover_hops})")
+                        self._finish(e)
+                        raise
+                    try:
+                        self._failover(e)
+                    except BaseException as e2:
+                        self._finish(e2)
+                        raise
+                    continue
+                except BaseException as e:
+                    self._finish(e)
+                    raise
+                self._finish(None)
+                return val
+
+    def _failover(self, err: BaseException) -> None:
+        """Re-submit to a different replica (prefer untried ones).
+        Raises when the deadline already passed or nothing can accept
+        — the caller terminalizes with THAT error."""
+        deadline_ms = None
+        if self._deadline_abs is not None:
+            deadline_ms = (self._deadline_abs
+                           - time.perf_counter()) * 1e3
+            if deadline_ms <= 0:
+                raise ServeDeadlineError(
+                    f"deadline passed during failover from "
+                    f"{self.replica}: {err!r}")
+        t0 = time.perf_counter()
+        inner, name = self._router._route_submit(
+            self._arrays, deadline_ms, exclude=set(self._tried),
+            failover=True)
+        self.hops += 1
+        self._tried.add(name)
+        self.replica = name
+        self._inner = inner
+        trace_mod.record_span("failover", t0, time.perf_counter(),
+                              hop=self.hops, to=name, error=repr(err))
+
+
+# ---------------------------------------------------------------------------
+# The router
+# ---------------------------------------------------------------------------
+class FleetRouter:
+    """Health-aware router + supervisor over N replicas. `replicas`
+    are `Replica`-protocol handles (`EngineReplica`, or anything
+    duck-typing it); the router starts them, routes `submit()` to the
+    least-loaded ready one, fails requests over on replica failure,
+    honors shed hints, drains on request, and restarts dead replicas
+    (bounded). See the module docstring for the full contract.
+
+    One router per fleet; `submit()` is safe from any number of
+    caller threads. The supervisor is one daemon thread; failover
+    work runs in the waiting caller's thread (`FleetReply.result`)."""
+
+    def __init__(self, replicas: Sequence, *,
+                 max_failover_hops: Optional[int] = None,
+                 max_shed_retries: Optional[int] = None,
+                 max_shed_sleep_s: Optional[float] = None,
+                 health_max_age_s: Optional[float] = None,
+                 probe_backoff_ms: Optional[float] = None,
+                 max_restarts: Optional[int] = None,
+                 supervise_interval_s: Optional[float] = None,
+                 metrics_every: Optional[int] = None,
+                 metrics: Optional["trace_mod.MetricsLogger"] = None,
+                 fault_injector=None,
+                 seed: Optional[int] = None):
+        if not replicas:
+            raise ValueError("FleetRouter needs at least one replica")
+        cfg = get_config()
+
+        def knob(v, key, cast):
+            return cast(v if v is not None else cfg[key])
+
+        self.max_failover_hops = knob(max_failover_hops,
+                                      "max_failover_hops", int)
+        self.max_shed_retries = knob(max_shed_retries,
+                                     "max_shed_retries", int)
+        self.max_shed_sleep_s = knob(max_shed_sleep_s,
+                                     "max_shed_sleep_s", float)
+        self.health_max_age_s = knob(health_max_age_s,
+                                     "health_max_age_s", float)
+        self.probe_backoff_s = knob(probe_backoff_ms,
+                                    "probe_backoff_ms", float) / 1e3
+        self.max_restarts = knob(max_restarts, "max_restarts", int)
+        self.supervise_interval_s = knob(supervise_interval_s,
+                                         "supervise_interval_s", float)
+        self.metrics_every = knob(metrics_every, "metrics_every", int)
+        self.metrics = metrics
+        self.fault_injector = fault_injector
+        if seed is not None:
+            self._seed = int(seed)
+        elif fault_injector is not None:
+            self._seed = int(getattr(fault_injector, "seed", 0))
+        else:
+            import os
+
+            self._seed = (os.getpid() << 16) ^ (id(self) & 0xFFFF)
+        self._slots: Dict[str, _ReplicaSlot] = {}
+        for h in replicas:
+            if h.name in self._slots:
+                raise ValueError(f"duplicate replica name {h.name!r}")
+            self._slots[h.name] = _ReplicaSlot(h)
+        self._lock = threading.Lock()
+        # Serializes state transitions: a caller thread's _refresh
+        # (inside _pick) races the supervisor's sweep — both seeing
+        # ready->ejected would double-count the ejection.
+        self._tlock = threading.Lock()
+        self._running = False
+        self._stop_ev = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._submit_idx = 0
+        self._event_idx = 0
+        # (time, event, replica, reason) — the fleet transition log
+        self.events: List = []
+        _STATS._routers.add(self)
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "FleetRouter":
+        if self._running:
+            return self
+        seen: Dict = {}
+        for slot in self._slots.values():
+            slot.handle.start()
+            slot.state = "ready"
+            tok = getattr(slot.handle, "device_token", lambda: None)()
+            if tok is not None and tok in seen:
+                import sys
+
+                print(f"singa_tpu: fleet replicas {seen[tok]!r} and "
+                      f"{slot.name!r} share one device object; the "
+                      "per-device RNG key is single-writer state and "
+                      "concurrent dispatcher threads will race it — "
+                      "build each replica's model on its own "
+                      "device.create_tpu_device()", file=sys.stderr)
+            elif tok is not None:
+                seen[tok] = slot.name
+        self._running = True
+        self._stop_ev.clear()
+        self._thread = threading.Thread(target=self._supervise,
+                                        name="singa_tpu-fleet",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True) -> None:
+        if not self._running:
+            return
+        self._running = False
+        self._stop_ev.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(5.0)
+        for slot in self._slots.values():
+            if slot.state in ("dead", "failed"):
+                continue
+            try:
+                slot.handle.stop(drain=drain)
+            except Exception:
+                pass
+            slot.state = "stopped"
+
+    def __enter__(self) -> "FleetRouter":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def warmup(self, *arrays) -> int:
+        """Warm every replica's bucket programs (each engine's
+        `warmup`); with a prewarmed shared store this is N×
+        deserialize, zero traces. Returns total programs warmed."""
+        return sum(s.handle.warmup(*arrays)
+                   for s in self._slots.values()
+                   if s.in_rotation())
+
+    # -- admission --------------------------------------------------------
+    def submit(self, *arrays,
+               deadline_ms: Optional[float] = None) -> FleetReply:
+        """Route one request; returns a `FleetReply`. Raises (counted
+        `rejected`) when nothing in rotation can accept it — a loud
+        router-terminal refusal, mirroring the engine's submit-time
+        errors. `ServeOverloadError` (every replica still shedding
+        after the retry budget) and `BucketOverflowError` (no replica
+        could ever serve it) propagate with their original types;
+        queue-full-everywhere and empty-rotation surface as
+        `FleetUnavailableError` — the per-replica `ServeQueueFullError`
+        names one replica's queue, which is not what the caller of a
+        fleet exhausted on every replica needs to hear."""
+        if not self._running:
+            raise ServeClosedError("fleet router not running: call "
+                                   "start()")
+        _STATS.requests += 1
+        with self._lock:
+            self._submit_idx += 1
+            idx = self._submit_idx
+        deadline_abs = (None if deadline_ms is None
+                        else time.perf_counter() + float(deadline_ms)
+                        / 1e3)
+        try:
+            inner, name = self._route_submit(arrays, deadline_ms,
+                                             exclude=set(),
+                                             failover=False)
+        except BaseException:
+            _STATS.rejected += 1
+            raise
+        self._chaos_route(idx, self._slots[name])
+        if (self.metrics_every
+                and idx % self.metrics_every == 0):
+            self._log_metrics("route", replica=name)
+        return FleetReply(self, arrays, deadline_abs, inner, name)
+
+    def infer(self, *arrays, timeout: Optional[float] = None,
+              deadline_ms: Optional[float] = None):
+        return self.submit(*arrays,
+                           deadline_ms=deadline_ms).result(timeout)
+
+    # -- routing core -----------------------------------------------------
+    def _refresh(self, slot: _ReplicaSlot) -> None:
+        """Recompute a slot's rotation state from a fresh health read.
+        Never resurrects out-of-rotation states here — ejected/dead
+        replicas come back only through the supervisor's probe/restart
+        path, so rejoin/restart events are counted exactly once."""
+        if slot.state not in ("ready", "degraded"):
+            return
+        if getattr(slot.handle, "killed", False):
+            self._transition(slot, "dead", "replica killed")
+            return
+        snap = slot.handle.health()
+        ts = snap.get("time")
+        age = None if ts is None else time.time() - float(ts)
+        if age is None or age > self.health_max_age_s:
+            self._transition(
+                slot, "ejected",
+                "stale health snapshot"
+                + ("" if age is None else f" ({age:.1f}s old)"))
+        elif snap.get("state") == "ready":
+            if slot.state != "ready":
+                self._transition(slot, "ready", "health ready")
+        elif snap.get("state") == "degraded":
+            if slot.state != "degraded":
+                self._transition(slot, "degraded",
+                                 "; ".join(snap.get("reasons") or []))
+        else:
+            self._transition(slot, "ejected",
+                             "health unhealthy: "
+                             + "; ".join(snap.get("reasons") or []))
+
+    def _transition(self, slot: _ReplicaSlot, state: str,
+                    reason: str) -> None:
+        with self._tlock:
+            prev = slot.state
+            if prev == state:
+                return
+            slot.state = state
+            slot.reason = reason
+            was_in = prev in ("ready", "degraded")
+            now_in = state in ("ready", "degraded")
+            if was_in and not now_in and state != "draining":
+                _STATS.ejections += 1
+                slot.probe_attempt = 0
+                slot.next_probe_t = (time.perf_counter()
+                                     + self.probe_backoff_s)
+            self.events.append((round(time.time(), 3), state,
+                                slot.name, reason))
+        self._log_metrics("transition", replica=slot.name,
+                          to_state=state, reason=reason)
+
+    def _pick(self, exclude) -> Optional[_ReplicaSlot]:
+        """Least-depth among fresh `ready` replicas; `degraded` only
+        when nothing is ready. None when rotation is empty.
+
+        Every pick re-reads each candidate's health() — the routing
+        contract is FRESH reads, so a replica that died microseconds
+        ago never gets one more request on the supervisor's 20 ms
+        stale view. That costs O(replicas) cheap dict builds per
+        submit (file writes happen only on transitions); a fleet big
+        enough to feel it should raise `supervise_interval_s`-paced
+        caching here rather than routing on stale state by default."""
+        with self._lock:
+            slots = list(self._slots.values())
+        for slot in slots:
+            if slot.name not in exclude:
+                self._refresh(slot)
+        ready = [s for s in slots if s.state == "ready"
+                 and s.name not in exclude]
+        pool = ready or [s for s in slots if s.state == "degraded"
+                         and s.name not in exclude]
+        if not pool:
+            return None
+        return min(pool, key=lambda s: (s.handle.depth(), s.routed,
+                                        s.name))
+
+    def _route_submit(self, arrays, deadline_ms, exclude,
+                      failover: bool):
+        """Pick + submit with shed-aware retry. `exclude` holds
+        already-TRIED replicas (failover); replicas that refuse in
+        this call are excluded for the current round only. Returns
+        (inner ServeReply, replica name); raises the decisive error
+        when nothing accepts."""
+        from . import resilience
+
+        shed_round = 0
+        while True:
+            refused_now: set = set()
+            shed_hints: Dict[str, float] = {}
+            last_shed: Optional[ServeOverloadError] = None
+            while True:
+                st = self._pick(exclude | refused_now)
+                if st is None and exclude:
+                    # every UNtried replica refused or left rotation:
+                    # a previously-tried one may have restarted — but
+                    # never one that just refused this round
+                    st = self._pick(refused_now)
+                if st is None:
+                    break
+                try:
+                    with trace_mod.span("route", replica=st.name,
+                                        failover=failover):
+                        r = st.handle.submit(*arrays,
+                                             deadline_ms=deadline_ms)
+                except ServeOverloadError as e:
+                    _STATS.refused += 1
+                    st.refusals += 1
+                    shed_hints[st.name] = e.retry_after_ms
+                    last_shed = e
+                    refused_now.add(st.name)
+                    continue
+                except ServeQueueFullError:
+                    _STATS.refused += 1
+                    st.refusals += 1
+                    refused_now.add(st.name)
+                    continue
+                except export_cache.BucketOverflowError:
+                    # the ladder is fleet-wide (shared policy): no
+                    # other replica could serve it either
+                    _STATS.refused += 1
+                    raise
+                except ServeClosedError as e:
+                    # replica died between pick and submit; the racy
+                    # post-admission refusal was engine-counted
+                    # (err.counted) and must stay on the books
+                    if getattr(e, "counted", False):
+                        _STATS.refused += 1
+                    self._refresh(st)
+                    if st.state in ("ready", "degraded"):
+                        self._transition(st, "dead",
+                                         "submit refused: closed")
+                    refused_now.add(st.name)
+                    continue
+                st.routed += 1
+                if failover:
+                    _STATS.failovers += 1
+                else:
+                    _STATS.routed += 1
+                return r, st.name
+            if shed_hints and shed_round < self.max_shed_retries:
+                # the WHOLE rotation shed: honor the smallest hint
+                # with seed-keyed jitter so fleets of callers
+                # decorrelate, then try again
+                shed_round += 1
+                _STATS.shed_retries += 1
+                delay = resilience.backoff_delay_s(
+                    shed_round, max(min(shed_hints.values()), 1.0)
+                    / 1e3, jitter=0.5, seed=self._seed,
+                    salt="fleet-shed")
+                time.sleep(min(delay, self.max_shed_sleep_s))
+                continue
+            if last_shed is not None:
+                raise last_shed
+            raise FleetUnavailableError(
+                "no replica in rotation can accept the request "
+                f"(states: { {s.name: s.state for s in self._slots.values()} })")
+
+    # -- chaos (fleet-level FaultInjector kinds) --------------------------
+    def _chaos_route(self, idx: int, slot: _ReplicaSlot) -> None:
+        inj = self.fault_injector
+        if inj is None:
+            return
+        if inj.should("stale_health", idx):
+            slot.handle.freeze_health(self.health_max_age_s * 4.0)
+            _STATS.stale_injected += 1
+        if inj.should("replica_hang", idx):
+            slot.handle.hang_once(inj.hang_s)
+            _STATS.hangs_injected += 1
+        if inj.should("replica_kill", idx):
+            _STATS.kills_injected += 1
+            self.kill(slot.name)
+
+    # -- fleet operations -------------------------------------------------
+    def kill(self, name: str) -> None:
+        """Hard-kill a replica (chaos, or an operator pulling a bad
+        node). Queued futures on it reroute via failover; the
+        supervisor restarts it within `max_restarts`."""
+        slot = self._slots[name]
+        slot.handle.kill()
+        if slot.state not in ("dead", "failed"):
+            self._transition(slot, "dead", "killed")
+        slot.next_probe_t = time.perf_counter()
+
+    def drain(self, name: str) -> None:
+        """Rolling-restart primitive: take `name` out of rotation
+        (nothing new routes to it), let its in-flight dispatch
+        finish, and reroute its queued requests through failover.
+        The replica ends `stopped` — restart it explicitly with
+        `rejoin(name)` when it should serve again."""
+        slot = self._slots[name]
+        self._transition(slot, "draining", "drain requested")
+        _STATS.drains += 1
+        slot.handle.drain_stop()
+        self._transition(slot, "stopped", "drained")
+
+    def rejoin(self, name: str) -> None:
+        """Bring a stopped/drained/failed replica back: restart its
+        engine and put it in rotation (counted `rejoins`)."""
+        slot = self._slots[name]
+        slot.handle.restart()
+        slot.restarts += 1
+        slot.probe_attempt = 0
+        _STATS.rejoins += 1
+        self._transition(slot, "ready", "manual rejoin")
+
+    # -- supervisor -------------------------------------------------------
+    def _supervise(self) -> None:
+        while self._running:
+            now = time.perf_counter()
+            for slot in list(self._slots.values()):
+                try:
+                    if slot.state == "dead":
+                        self._supervise_dead(slot, now)
+                    elif slot.state == "ejected":
+                        self._supervise_ejected(slot, now)
+                    elif slot.state in ("ready", "degraded"):
+                        self._refresh(slot)
+                except Exception as e:  # a replica bug must not kill
+                    # the supervisor: log the event and keep sweeping
+                    self.events.append((round(time.time(), 3),
+                                        "supervisor_error", slot.name,
+                                        repr(e)))
+            self._stop_ev.wait(self.supervise_interval_s)
+
+    def _supervise_dead(self, slot: _ReplicaSlot, now: float) -> None:
+        if slot.restarts >= self.max_restarts:
+            self._transition(
+                slot, "failed",
+                f"restart budget exhausted ({self.max_restarts})")
+            return
+        if now < slot.next_probe_t:
+            return
+        from . import resilience
+
+        try:
+            slot.handle.restart()
+        except Exception as e:
+            slot.probe_attempt += 1
+            slot.next_probe_t = now + resilience.backoff_delay_s(
+                slot.probe_attempt, self.probe_backoff_s, jitter=0.5,
+                seed=self._seed, salt=f"restart/{slot.name}")
+            self.events.append((round(time.time(), 3),
+                                "restart_failed", slot.name, repr(e)))
+            return
+        slot.restarts += 1
+        slot.probe_attempt = 0
+        _STATS.restarts += 1
+        self._transition(slot, "ready",
+                         f"restarted ({slot.restarts}/"
+                         f"{self.max_restarts})")
+
+    def _supervise_ejected(self, slot: _ReplicaSlot,
+                           now: float) -> None:
+        if now < slot.next_probe_t:
+            return
+        from . import resilience
+
+        slot.probe_attempt += 1
+        _STATS.probes += 1
+        if getattr(slot.handle, "killed", False):
+            self._transition(slot, "dead", "probe found it dead")
+            slot.next_probe_t = now
+            return
+        snap = slot.handle.health()
+        ts = snap.get("time")
+        fresh = (ts is not None
+                 and time.time() - float(ts) <= self.health_max_age_s)
+        if fresh and snap.get("state") in ("ready", "degraded"):
+            slot.probe_attempt = 0
+            _STATS.rejoins += 1
+            self._transition(slot, snap["state"], "rejoined: health "
+                             + snap["state"])
+            return
+        slot.next_probe_t = now + resilience.backoff_delay_s(
+            slot.probe_attempt, self.probe_backoff_s, jitter=0.5,
+            seed=self._seed, salt=f"probe/{slot.name}")
+
+    # -- observability ----------------------------------------------------
+    def replica_snapshot(self) -> Dict[str, Dict]:
+        out = {}
+        for slot in self._slots.values():
+            out[slot.name] = {
+                "state": slot.state,
+                "reason": slot.reason,
+                "depth": slot.handle.depth(),
+                "routed": slot.routed,
+                "refusals": slot.refusals,
+                "restarts": slot.restarts,
+            }
+        return out
+
+    def _log_metrics(self, event: str, **extra) -> None:
+        m = self.metrics
+        if m is None:
+            return
+        try:
+            with self._lock:
+                self._event_idx += 1
+                idx = self._event_idx
+            states = {}
+            for slot in self._slots.values():
+                states[slot.state] = states.get(slot.state, 0) + 1
+            m.log_step(
+                idx, event=event, states=states,
+                fleet_requests=_STATS.requests,
+                routed=_STATS.routed, failovers=_STATS.failovers,
+                refused=_STATS.refused, rejected=_STATS.rejected,
+                ejections=_STATS.ejections, rejoins=_STATS.rejoins,
+                restarts=_STATS.restarts, **extra)
+        except Exception:
+            pass  # a closed metrics stream must not break routing

@@ -396,6 +396,16 @@ class FaultInjector:
     it rides in — the bisection target), "device_lost_serve"
     (`DeviceLostError` from the dispatch), "dispatcher_kill" (the
     dispatcher loop itself dies — the supervision target).
+
+    Fleet kinds (ISSUE 11; consumed by `fleet.FleetRouter`'s
+    `_chaos_route` hook, keyed by the ROUTER submit ordinal and
+    applied to the replica that request just routed to):
+    "replica_kill" (hard replica death — queued futures fail loudly
+    and reroute via failover; the fleet-supervision target),
+    "replica_hang" (the replica's next dispatch sleeps `hang_s`),
+    "stale_health" (the replica's health snapshot freezes and ages
+    into ejection — the wedged-writer scenario `health_max_age_s`
+    exists for).
     """
 
     def __init__(self, seed: int = 0, schedule: Optional[Dict] = None,

@@ -57,8 +57,10 @@ LOUD recovery path, proven by seed-keyed fault injection:
       + seed-keyed jitter (`resilience.backoff_delay_s`); when the
       retries are exhausted the group is BISECTED to isolate poison
       requests — only the requests that fail alone fail their
-      futures (counted `poisoned`), the rest re-dispatch and
-      succeed. One bad input cannot fail a coalesced batch of 64.
+      futures (`ServePoisonedError`, counted `poisoned`; a terminal
+      VERDICT the fleet router never re-submits elsewhere), the rest
+      re-dispatch and succeed. One bad input cannot fail a coalesced
+      batch of 64.
   load shedding    — beyond the hard `max_queue` drop: a
       `shed_watermark` sheds NEWEST requests with a structured
       `ServeOverloadError` carrying `retry_after_ms` (estimated from
@@ -121,11 +123,13 @@ __all__ = [
     "ServeDeadlineError",
     "ServeOverloadError",
     "ServeDispatchError",
+    "ServePoisonedError",
     "configure",
     "get_config",
     "configure_resilience",
     "get_resilience_config",
     "prewarm_forward",
+    "submit_with_backoff",
 ]
 
 
@@ -169,6 +173,17 @@ class ServeDispatchError(RuntimeError):
     (and, for the isolated requests of a bisected group, failed alone
     too). Wraps the final underlying error; the per-request future
     re-raises this."""
+
+
+class ServePoisonedError(ServeDispatchError):
+    """Terminal poison VERDICT: the request failed every retry AND
+    failed when dispatched alone after group bisection — the input
+    itself is bad, not the replica it rode on. Subclasses
+    `ServeDispatchError` so existing handlers keep working, but the
+    fleet router (`singa_tpu.fleet`) keys on the distinction: a
+    `ServeDispatchError` fails over to a different replica, a poison
+    verdict NEVER does — the same input would poison every replica in
+    turn, and the bisection work would repeat fleet-wide."""
 
 
 # ---------------------------------------------------------------------------
@@ -652,6 +667,12 @@ class ServingEngine:
         self.metrics = metrics
         self._latencies: deque = deque(maxlen=int(latency_window))
         self._queue: deque = deque()
+        # THIS engine's live queue depth. The module-global
+        # _STATS.queue_depth gauge is last-writer-wins across the N
+        # engines a fleet runs in one process — health verdicts and
+        # the adaptive-wait EMA must read their OWN engine's depth,
+        # or replica A gets judged by replica B's backlog.
+        self._depth = 0
         self._lock = threading.Lock()
         self._have_work = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -710,6 +731,7 @@ class ServingEngine:
             with self._lock:
                 victims = list(self._queue)
                 self._queue.clear()
+                self._depth = 0
                 _STATS.queue_depth = 0
             for req in victims:
                 self._fail_request(req, ServeClosedError(
@@ -740,6 +762,7 @@ class ServingEngine:
         with self._lock:
             victims = list(self._queue)
             self._queue.clear()
+            self._depth = 0
             _STATS.queue_depth = 0
         for req in victims:
             self._fail_request(req, ServeClosedError("engine stopped"))
@@ -876,8 +899,13 @@ class ServingEngine:
             if not self._running:
                 # the future was never enqueued: fail it too so the
                 # terminal-outcome reconciliation stays exact even
-                # for submits racing stop()
+                # for submits racing stop(). `counted=True` marks
+                # that THIS refusal bumped requests+failed (the
+                # pre-admission ServeClosedError above counted
+                # nothing) — the fleet router's attempt accounting
+                # needs the distinction to stay exact.
                 err = ServeClosedError("engine stopped")
+                err.counted = True
                 self._fail_request(req, err)
                 raise err
             depth = len(self._queue)
@@ -901,7 +929,8 @@ class ServingEngine:
                     "workers or raise max_queue "
                     "(device.set_serving)")
             self._queue.append(req)
-            _STATS.queue_depth = len(self._queue)
+            self._depth = len(self._queue)
+            _STATS.queue_depth = self._depth
             if _STATS.queue_depth > _STATS.max_queue_depth:
                 _STATS.max_queue_depth = _STATS.queue_depth
         self._have_work.set()
@@ -947,7 +976,8 @@ class ServingEngine:
                     self._have_work.clear()
                     return None
                 req = self._queue.popleft()
-                _STATS.queue_depth = len(self._queue)
+                self._depth = len(self._queue)
+                _STATS.queue_depth = self._depth
             if (req.deadline is not None
                     and time.perf_counter() >= req.deadline):
                 self._fail_request(req, ServeDeadlineError(
@@ -968,7 +998,7 @@ class ServingEngine:
             return self.max_wait_s
         wm = float(self.shed_watermark or self.max_queue)
         self._depth_ema = (0.8 * self._depth_ema
-                           + 0.2 * _STATS.queue_depth)
+                           + 0.2 * self._depth)
         wait = self.max_wait_s * max(0.0, 1.0 - self._depth_ema / wm)
         _STATS.effective_wait_ms = round(wait * 1e3, 4)
         return wait
@@ -999,6 +1029,7 @@ class ServingEngine:
                         self._running = False
                         victims = list(self._queue)
                         self._queue.clear()
+                        self._depth = 0
                         _STATS.queue_depth = 0
                     for req in victims:
                         self._fail_request(req, ServeClosedError(
@@ -1060,7 +1091,8 @@ class ServingEngine:
                 with self._lock:
                     for p in reversed(pending):
                         self._queue.appendleft(p)
-                    _STATS.queue_depth = len(self._queue)
+                    self._depth = len(self._queue)
+                    _STATS.queue_depth = self._depth
                 self._have_work.set()
             inj = self.fault_injector
             if inj is not None and inj.should("dispatcher_kill",
@@ -1154,9 +1186,9 @@ class ServingEngine:
             # `poisoned` tracks a subset of `failed`: bump it only
             # when this fail actually resolves the future (the stop()
             # drain-timeout path may have beaten us to it).
-            if self._fail_request(r, ServeDispatchError(
+            if self._fail_request(r, ServePoisonedError(
                     f"request failed dispatch alone after group "
-                    f"bisection (poison input?): {err!r}")):
+                    f"bisection (poison input): {err!r}")):
                 _STATS.poisoned += 1
             return
         mid = len(group) // 2
@@ -1246,7 +1278,7 @@ class ServingEngine:
                     requests=len(group), rows=rows, bucket=n_bucket,
                     occupancy=round(rows / n_bucket, 4),
                     pad_fraction=round((n_bucket - rows) / n_bucket, 4),
-                    queue_depth=_STATS.queue_depth,
+                    queue_depth=self._depth,
                     p50_ms=p["p50_ms"], p95_ms=p["p95_ms"],
                     p99_ms=p["p99_ms"],
                     expired=_STATS.expired, shed=_STATS.shed,
@@ -1361,15 +1393,15 @@ class ServingEngine:
                     f"{self._consec_failures} consecutive dispatch "
                     "failure(s)")
             wm = self.shed_watermark or self.max_queue
-            if _STATS.queue_depth >= int(wm):
+            if self._depth >= int(wm):
                 state = "degraded"
                 reasons.append(
-                    f"queue depth {_STATS.queue_depth} at the shed "
+                    f"queue depth {self._depth} at the shed "
                     f"watermark ({wm})")
         snap = {
             "state": state,
             "reasons": reasons,
-            "queue_depth": _STATS.queue_depth,
+            "queue_depth": self._depth,
             "consecutive_failures": self._consec_failures,
             "restarts": self._restarts,
             "expired": _STATS.expired,
@@ -1414,6 +1446,45 @@ class ServingEngine:
         return {"p50_ms": round(float(np.percentile(arr, 50)), 3),
                 "p95_ms": round(float(np.percentile(arr, 95)), 3),
                 "p99_ms": round(float(np.percentile(arr, 99)), 3)}
+
+
+# ---------------------------------------------------------------------------
+# Retry-after-aware client submit (the documented ServeOverloadError
+# contract, packaged): bench's serve/fleet load generators and any
+# in-process client use this instead of treating a shed as terminal.
+# ---------------------------------------------------------------------------
+def submit_with_backoff(submit, *arrays, deadline_ms: Optional[float]
+                        = None, max_attempts: int = 3, seed: int = 0,
+                        max_sleep_s: float = 1.0):
+    """Call `submit(*arrays, deadline_ms=...)` honoring the
+    `ServeOverloadError.retry_after_ms` back-off contract: a shed is a
+    structured "come back in N ms" hint, not a terminal failure, so
+    the client sleeps the hinted delay — scaled by the deterministic
+    seed-keyed jitter of `resilience.backoff_delay_s` (a fleet of
+    clients sleeping the exact same hint would re-arrive in lockstep
+    and shed again) and capped at `max_sleep_s` — then retries, up to
+    `max_attempts` total attempts. The final attempt's
+    `ServeOverloadError` propagates; every other error propagates
+    immediately (a queue-full drop or overflow carries no retry
+    hint). `submit` is any callable with the `ServingEngine.submit` /
+    `FleetRouter.submit` signature; returns whatever it returns."""
+    from . import resilience
+
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            return submit(*arrays, deadline_ms=deadline_ms)
+        except ServeOverloadError as e:
+            if attempt >= int(max_attempts):
+                raise
+            # backoff_delay_s doubles per attempt on top of the hint:
+            # a queue still at the watermark after the first hinted
+            # wait needs MORE room, not the same wait again.
+            delay = resilience.backoff_delay_s(
+                attempt, max(e.retry_after_ms, 1.0) / 1e3,
+                jitter=0.5, seed=int(seed), salt="client-shed")
+            time.sleep(min(delay, float(max_sleep_s)))
 
 
 # ---------------------------------------------------------------------------
@@ -1462,7 +1533,12 @@ def prewarm_forward(model, sample_spec, policy=None,
             s <<= 1
     was_training = model.training
     model.eval()
-    dev = get_default_device()
+    # Inputs go to the MODEL's device: on a multi-device host (or the
+    # 8-virtual-device CPU mesh) a model living off device 0 would
+    # otherwise get default-device inputs and fail the jit with an
+    # incompatible-devices error.
+    ps = model.param_tensors()
+    dev = ps[0].device if ps else get_default_device()
     rows: List[Dict] = []
     try:
         fwd = model._ensure_forward_exec()

@@ -585,3 +585,98 @@ def test_fold_onchip_renders_parallel_stage(tmp_path, capsys,
     assert "33966 tok/s" in out or "33967 tok/s" in out
     assert "dropped 0.021" in out
     assert "100.0 img/s" in out  # old log unchanged
+
+
+def test_fleet_stage_contract_and_acceptance():
+    """ISSUE 11: the fleet stage's JSON contract — router over N
+    replicas under Poisson load, bit-identical replies, exact
+    fleet-wide reconciliation; the --chaos arm fires hard replica
+    kills mid-load and still reconciles with bounded availability."""
+    proc, result = _run_stage(
+        ["--stage", "fleet", "--requests", "200", "--replicas", "2",
+         "--deadline", "180", "--chaos"], timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert result is not None, "no JSON result line on stdout"
+    assert result["ok"] is True
+    assert result["metric"] == "fleet_requests_per_sec"
+    for k in ("fleet_requests_per_sec", "replicas", "p50_ms",
+              "p99_ms", "delivered", "failed", "refused",
+              "replies_match", "routed", "failovers", "restarts",
+              "counters_reconcile", "speedup_vs_sequential",
+              "stage_seconds", "export_cache", "metrics_jsonl"):
+        assert k in result, f"fleet result missing {k}"
+    assert result["replicas"] == 2
+    assert result["fleet_requests_per_sec"] > 0
+    assert result["replies_match"] is True
+    assert result["counters_reconcile"] is True
+    assert result["metrics_jsonl"] == os.path.join(
+        "metrics", "bench_fleet.jsonl")
+    c = result["chaos"]
+    for k in ("availability_pct", "delivered", "failed", "p50_ms",
+              "p99_ms", "replies_match", "failovers", "restarts",
+              "ejections", "kills", "counters_reconcile"):
+        assert k in c, f"fleet chaos sub-dict missing {k}"
+    assert c["kills"] >= 1, "chaos arm fired no hard replica kill"
+    assert c["replies_match"] is True
+    assert c["counters_reconcile"] is True
+    assert 0.0 < c["availability_pct"] <= 100.0
+
+
+def test_fleet_row_rides_the_driver_ramp():
+    """The fleet metric reaches the driver result table
+    (`fleet_requests_per_sec` in result_extra), like serve/parallel."""
+    src = open(os.path.join(_ROOT, "bench.py")).read()
+    assert 'run_stage("fleet"' in src
+    assert 'result_extra["fleet_requests_per_sec"]' in src
+
+
+def test_serve_chaos_client_honors_retry_after():
+    """BUGFIX (ISSUE 11): the serve-stage chaos client used to treat
+    ServeOverloadError as terminal; it must route submits through the
+    retry-after-aware helper so measured availability reflects the
+    documented contract."""
+    src = open(os.path.join(_ROOT, "bench.py")).read()
+    assert "submit_with_backoff" in src
+    assert src.count("submit_with_backoff") >= 2, (
+        "both the serve chaos arm and the fleet stage must use the "
+        "retry-after-aware client helper")
+
+
+def test_fold_onchip_renders_fleet_stage(tmp_path, capsys,
+                                         monkeypatch):
+    """ISSUE 11: tools/fold_onchip.py renders fleet rows (req/s,
+    replica count, SLO percentiles, failovers/restarts, chaos
+    availability + kill evidence); old serve logs fold unchanged and
+    a reconciliation break is flagged loudly."""
+    fold = _load_module("fold_onchip_for_test", "tools/fold_onchip.py")
+    logs = tmp_path / "onchip_logs"
+    logs.mkdir()
+    row = {"ok": True, "metric": "fleet_requests_per_sec",
+           "fleet_requests_per_sec": 5271.8, "replicas": 3,
+           "p50_ms": 11.5, "p99_ms": 17.1, "failovers": 4,
+           "restarts": 1, "replies_match": True,
+           "counters_reconcile": True,
+           "chaos": {"availability_pct": 98.0, "p99_ms": 591.4,
+                     "kills": 2, "failovers": 56, "restarts": 2,
+                     "replies_match": True,
+                     "counters_reconcile": True}}
+    (logs / "fleet.out").write_text(json.dumps(row) + "\n")
+    # an old serve-format row in the same dir folds unchanged
+    (logs / "serve.out").write_text(json.dumps(
+        {"ok": True, "serve_requests_per_sec": 8123.4,
+         "p50_ms": 2.1, "p99_ms": 7.9}) + "\n")
+    monkeypatch.setattr(fold, "LOGS", str(logs))
+    assert fold.main() == 0
+    out = capsys.readouterr().out
+    assert "5271.8 req/s" in out
+    assert "3 replicas" in out
+    assert "4 failovers" in out and "1 restarts" in out
+    assert "chaos: 98.0% avail" in out
+    assert "2 kills/56 failovers/2 restarts" in out
+    assert "8123.4 req/s" in out  # old serve log unchanged
+    assert "MISMATCH" not in out
+    # a broken reconciliation flag is loud
+    row["chaos"]["counters_reconcile"] = False
+    (logs / "fleet.out").write_text(json.dumps(row) + "\n")
+    assert fold.main() == 0
+    assert "MISMATCH" in capsys.readouterr().out

@@ -164,6 +164,28 @@ def test_parallel_trainer_row_and_readme_section_present():
     assert "--stage parallel" in readme
 
 
+def test_fleet_row_and_readme_section_present():
+    """ISSUE 11 doc contract: the P21 fleet-serving row and the
+    README "Fleet serving" section exist (path rot in either is
+    caught by test_all_cited_paths_exist)."""
+    cov = open(os.path.join(_ROOT, "COVERAGE.md")).read()
+    assert "| P21 |" in cov
+    assert "singa_tpu/fleet.py" in cov
+    assert "tests/test_fleet.py" in cov
+    readme = open(os.path.join(_ROOT, "README.md")).read()
+    assert "## Fleet serving" in readme
+    assert "FleetRouter" in readme
+    assert "set_fleet" in readme
+    assert "max_failover_hops" in readme
+    assert "ServePoisonedError" in readme
+    assert "submit_with_backoff" in readme
+    assert "create_replica_device" in readme
+    assert "--verify-store" in readme
+    assert "serve_health.py --all" in readme
+    assert "--stage fleet" in readme
+    assert "reconcile" in readme
+
+
 def test_all_cited_paths_exist():
     text = open(os.path.join(_ROOT, "COVERAGE.md")).read()
     missing = []

@@ -1,0 +1,876 @@
+"""Fleet serving (ISSUE 11): health-aware router over N replicas.
+
+Acceptance pins:
+  - routing under mixed health: least-depth among fresh `ready`
+    replicas, `degraded` only when nothing is ready, nothing in
+    rotation => loud `FleetUnavailableError` counted `rejected`;
+  - failover bit-identity: a replica hard-killed (or its dispatcher
+    dying) mid-load fails its requests' inner futures, the router
+    re-submits to a different replica, and every reply stays
+    bit-identical to the unbatched forward; hops are bounded by
+    `max_failover_hops` and counted;
+  - poison verdicts NEVER fail over: `ServePoisonedError` is
+    terminal — the other replicas see zero re-submits;
+  - shed-aware retry: when every replica in rotation sheds, the
+    router honors the smallest `retry_after_ms` with the
+    deterministic seed-keyed jitter of `resilience.backoff_delay_s`;
+  - stale-snapshot ejection + rejoin: a frozen health snapshot ages
+    past `health_max_age_s` => ejected (fail closed), probed with
+    backoff, rejoined when fresh again;
+  - drain completeness: `drain(name)` finishes the in-flight
+    dispatch and reroutes the queued requests — zero losses;
+  - supervisor restarts are bounded by `max_restarts`, and a restart
+    with the shared export-cache store armed is DESERIALIZE-only
+    (store hits >= 1, traces == 0 on the restarted replica);
+  - the fleet chaos soak: under >=5% injected faults including hard
+    replica kills mid-load, every submitted future resolves (zero
+    silent losses), replies stay bit-identical, availability stays
+    bounded, and all three `fleet.reconcile` equations hold EXACTLY
+    at quiescence — one lost future anywhere fails the test.
+"""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from singa_tpu import device, export_cache, fleet, layer, model, \
+    resilience, serve, stats, tensor
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+@pytest.fixture(autouse=True)
+def _clean_fleet_config():
+    """Fleet/serving defaults are process knobs — leaving them armed
+    would reroute later tests."""
+    saved = fleet.get_config()
+    saved_serve = serve.get_config()
+    saved_res = serve.get_resilience_config()
+    yield
+    fleet._CONFIG.update(saved)
+    serve.configure(**saved_serve)
+    serve._RES_CONFIG.update(saved_res)
+    export_cache.configure(directory=None, buckets=None)
+    device.set_tracing(False)
+
+
+class TwoLayer(model.Model):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = layer.Linear(16)
+        self.r1 = layer.ReLU()
+        self.fc2 = layer.Linear(4)
+
+    def forward(self, x):
+        return self.fc2(self.r1(self.fc1(x)))
+
+
+def _make_factory(i, seed=0, feats=8):
+    """Deterministic model factory for replica `i`: its OWN device
+    (the EngineReplica contract — N dispatcher threads must not share
+    RNG-key state) and the same dyadic params every call, so replies
+    stay bit-identical across restarts."""
+    def factory():
+        import jax.numpy as jnp
+
+        dev = device.create_replica_device(i)
+        dev.SetRandSeed(seed)
+        m = TwoLayer()
+        m.compile([tensor.from_numpy(np.zeros((8, feats), np.float32),
+                                     device=dev)],
+                  is_train=False, use_graph=True)
+        m.eval()
+        for p in m.param_tensors():
+            p.data = jnp.round(p.data * 16.0) / 16.0
+        return m
+    return factory
+
+
+def _engine_replicas(n, engine_kwargs=None, prefix="r", seed=0,
+                     injectors=None):
+    kw = {"max_batch": 8, "max_wait_ms": 1.0}
+    kw.update(engine_kwargs or {})
+    out = []
+    for i in range(n):
+        k = dict(kw)
+        if injectors:
+            k["fault_injector"] = injectors[i]
+        out.append(fleet.EngineReplica(f"{prefix}{i}",
+                                       _make_factory(i, seed=seed), k))
+    return out
+
+
+def _refs(reqs, seed=0):
+    m = _make_factory(97, seed=seed)()
+    dev = m.param_tensors()[0].device
+    return [np.asarray(m.forward_graph(
+        tensor.from_numpy(x, device=dev)).data).copy() for x in reqs]
+
+
+def _dyadic(rs, n, feats=8, max_rows=2):
+    return [(rs.randint(-16, 16,
+                        (int(rs.randint(1, max_rows + 1)), feats))
+             / 8.0).astype(np.float32) for _ in range(n)]
+
+
+def _snaps():
+    s = stats.cache_stats()
+    return s["serve"], s["fleet"]
+
+
+def _assert_reconciles(s0, f0, s1, f1):
+    rec = fleet.reconcile(s0, s1, f0, f1)
+    assert rec["ok"], rec
+    return rec
+
+
+# ---------------------------------------------------------------------------
+# Stub replica: the Replica protocol without jax — pure routing tests
+# ---------------------------------------------------------------------------
+class StubReplica:
+    def __init__(self, name, state="ready", depth=0, age_s=0.0):
+        self.name = name
+        self.killed = False
+        self.state_ = state
+        self.depth_ = depth
+        self.age_s = age_s  # health snapshot age (staleness tests)
+        self.submits = 0
+        self.shed_first = 0
+        self.retry_after_ms = 25.0
+        self.restarts = 0
+        self.hangs = []
+        self.freezes = []
+
+    def start(self):
+        return self
+
+    def stop(self, drain=True):
+        pass
+
+    def kill(self):
+        self.killed = True
+
+    def drain_stop(self):
+        pass
+
+    def restart(self):
+        self.restarts += 1
+        self.killed = False
+        return self
+
+    def submit(self, *arrays, deadline_ms=None):
+        if self.shed_first > 0:
+            self.shed_first -= 1
+            raise serve.ServeOverloadError(
+                "stub shed", retry_after_ms=self.retry_after_ms)
+        self.submits += 1
+        r = serve.ServeReply(1)
+        r._deliver(np.zeros((1,), np.float32))
+        return r
+
+    def health(self):
+        return {"state": self.state_, "reasons": [],
+                "time": time.time() - self.age_s, "name": self.name}
+
+    def depth(self):
+        return self.depth_
+
+    def warmup(self, *arrays):
+        return 0
+
+    def hang_once(self, s):
+        self.hangs.append(s)
+
+    def freeze_health(self, s):
+        self.freezes.append(s)
+
+
+# ---------------------------------------------------------------------------
+# Knobs
+# ---------------------------------------------------------------------------
+def test_set_fleet_knob_feeds_router_defaults():
+    device.set_fleet(max_failover_hops=5, max_shed_retries=4,
+                     health_max_age_s=9.0, probe_backoff_ms=11.0,
+                     max_restarts=7, supervise_interval_s=0.5)
+    cfg = fleet.get_config()
+    assert cfg["max_failover_hops"] == 5
+    assert cfg["max_restarts"] == 7
+    router = fleet.FleetRouter([StubReplica("a")])
+    assert router.max_failover_hops == 5
+    assert router.max_shed_retries == 4
+    assert router.health_max_age_s == 9.0
+    assert router.probe_backoff_s == pytest.approx(0.011)
+    assert router.max_restarts == 7
+    # per-router override wins
+    router2 = fleet.FleetRouter([StubReplica("a")], max_restarts=1)
+    assert router2.max_restarts == 1
+
+
+def test_fleet_knob_validation():
+    with pytest.raises(KeyError, match="unknown fleet config key"):
+        fleet.configure(bogus=1)
+    with pytest.raises(ValueError):
+        fleet.configure(max_failover_hops=-1)
+    with pytest.raises(ValueError):
+        fleet.configure(health_max_age_s=0)
+    with pytest.raises(ValueError):
+        fleet.FleetRouter([])
+    with pytest.raises(ValueError, match="duplicate"):
+        fleet.FleetRouter([StubReplica("a"), StubReplica("a")])
+
+
+# ---------------------------------------------------------------------------
+# Routing under mixed health
+# ---------------------------------------------------------------------------
+def test_routing_prefers_least_depth_among_ready():
+    a = StubReplica("a", depth=5)
+    b = StubReplica("b", depth=1)
+    c = StubReplica("c", depth=3)
+    with fleet.FleetRouter([a, b, c],
+                           supervise_interval_s=5.0) as router:
+        for _ in range(3):
+            router.submit(np.zeros((1, 4), np.float32)).result(5)
+    assert b.submits == 3 and a.submits == 0 and c.submits == 0
+
+
+def test_degraded_serves_only_when_nothing_ready():
+    a = StubReplica("a", state="degraded", depth=0)
+    b = StubReplica("b", state="ready", depth=9)
+    with fleet.FleetRouter([a, b],
+                           supervise_interval_s=5.0) as router:
+        router.submit(np.zeros((1, 4), np.float32)).result(5)
+        assert b.submits == 1 and a.submits == 0  # ready wins on depth loss
+        b.state_ = "unhealthy"
+        router.submit(np.zeros((1, 4), np.float32)).result(5)
+        assert a.submits == 1  # degraded only when no ready remains
+
+
+def test_nothing_in_rotation_is_loud_and_counted():
+    a = StubReplica("a", state="unhealthy")
+    f0 = stats.cache_stats()["fleet"]
+    with fleet.FleetRouter([a], supervise_interval_s=5.0) as router:
+        with pytest.raises(fleet.FleetUnavailableError):
+            router.submit(np.zeros((1, 4), np.float32))
+    f1 = stats.cache_stats()["fleet"]
+    assert f1["rejected"] - f0["rejected"] == 1
+    assert f1["requests"] - f0["requests"] == 1
+
+
+def test_stale_snapshot_ejected_and_rejoins_with_backoff():
+    """Fail closed on a wedged health writer: a READY snapshot older
+    than health_max_age_s must not route; the supervisor probes with
+    backoff and rejoins once the snapshot is fresh again."""
+    a = StubReplica("a", age_s=10.0)  # stale from the start
+    b = StubReplica("b")
+    f0 = stats.cache_stats()["fleet"]
+    with fleet.FleetRouter([a, b], health_max_age_s=0.5,
+                           probe_backoff_ms=10.0,
+                           supervise_interval_s=0.01) as router:
+        router.submit(np.zeros((1, 4), np.float32)).result(5)
+        assert b.submits == 1 and a.submits == 0
+        assert router._slots["a"].state == "ejected"
+        a.age_s = 0.0  # writer recovers
+        deadline = time.time() + 10
+        while (router._slots["a"].state != "ready"
+               and time.time() < deadline):
+            time.sleep(0.01)
+        assert router._slots["a"].state == "ready"
+    f1 = stats.cache_stats()["fleet"]
+    assert f1["ejections"] - f0["ejections"] >= 1
+    assert f1["rejoins"] - f0["rejoins"] >= 1
+    assert f1["probes"] - f0["probes"] >= 1
+
+
+def test_shed_aware_retry_honors_retry_after_with_jitter():
+    """Both replicas shed once; the router must wait the seed-keyed
+    jittered hint (resilience.backoff_delay_s on the smallest
+    retry_after_ms) before the retry round that succeeds."""
+    a = StubReplica("a")
+    b = StubReplica("b")
+    a.shed_first = b.shed_first = 1
+    a.retry_after_ms = 40.0
+    b.retry_after_ms = 30.0
+    f0 = stats.cache_stats()["fleet"]
+    with fleet.FleetRouter([a, b], seed=5,
+                           supervise_interval_s=5.0) as router:
+        t0 = time.perf_counter()
+        router.submit(np.zeros((1, 4), np.float32)).result(5)
+        elapsed = time.perf_counter() - t0
+    expected = resilience.backoff_delay_s(1, 0.030, jitter=0.5,
+                                          seed=5, salt="fleet-shed")
+    assert elapsed >= expected * 0.95, (elapsed, expected)
+    f1 = stats.cache_stats()["fleet"]
+    assert f1["shed_retries"] - f0["shed_retries"] == 1
+    assert f1["refused"] - f0["refused"] == 2
+    assert a.submits + b.submits == 1
+
+
+def test_shed_budget_exhaustion_propagates_overload():
+    a = StubReplica("a")
+    a.shed_first = 99
+    a.retry_after_ms = 1.0
+    f0 = stats.cache_stats()["fleet"]
+    with fleet.FleetRouter([a], max_shed_retries=1,
+                           max_shed_sleep_s=0.01, seed=5,
+                           supervise_interval_s=5.0) as router:
+        with pytest.raises(serve.ServeOverloadError):
+            router.submit(np.zeros((1, 4), np.float32))
+    f1 = stats.cache_stats()["fleet"]
+    assert f1["rejected"] - f0["rejected"] == 1
+    assert f1["shed_retries"] - f0["shed_retries"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Failover
+# ---------------------------------------------------------------------------
+def test_replica_kill_fails_over_bit_identically():
+    """The acceptance pin: requests queued on a hard-killed replica
+    reroute to a different replica and every reply stays
+    bit-identical to the unbatched forward; hops/failovers are
+    counted and the fleet-wide reconciliation holds exactly."""
+    rs = np.random.RandomState(3)
+    reqs = _dyadic(rs, 24)
+    refs = _refs(reqs)
+    s0, f0 = _snaps()
+    router = fleet.FleetRouter(
+        _engine_replicas(2, {"max_batch": 4}),
+        supervise_interval_s=0.01, max_restarts=0).start()
+    try:
+        futs = [router.submit(x) for x in reqs]
+        router.kill("r0")
+        for i, f in enumerate(futs):
+            out = f.result(60)
+            assert out.tobytes() == refs[i].tobytes(), f"request {i}"
+        assert all(f.done() for f in futs)
+        assert all(f.hops <= router.max_failover_hops for f in futs)
+    finally:
+        router.stop()
+    s1, f1 = _snaps()
+    rec = _assert_reconciles(s0, f0, s1, f1)
+    assert rec["fleet_delta"]["failovers"] > 0
+    assert rec["fleet_delta"]["replies"] == len(reqs)
+    assert rec["fleet_delta"]["failed"] == 0
+
+
+def test_dispatcher_death_fails_over_to_another_replica():
+    """A ServeDispatchError terminal on one replica (its dispatcher
+    died mid-dispatch) is retryable fleet-wide: the router re-submits
+    to a healthy replica."""
+    inj = resilience.FaultInjector(seed=0,
+                                   schedule={"dispatcher_kill": {1}})
+    reps = _engine_replicas(2)
+    reps[0] = fleet.EngineReplica(
+        "r0", _make_factory(0),
+        {"max_batch": 8, "max_wait_ms": 1.0, "max_restarts": 0,
+         "fault_injector": inj})
+    x = np.ones((1, 8), np.float32)
+    refs = _refs([x])
+    s0, f0 = _snaps()
+    with fleet.FleetRouter(reps, supervise_interval_s=0.01,
+                           max_restarts=0) as router:
+        # depth-0 tie-break routes to r0 first (least routed, then
+        # name); its first coalesce cycle dies => failover to r1
+        out = router.submit(x).result(60)
+        assert out.tobytes() == refs[0].tobytes()
+    s1, f1 = _snaps()
+    rec = _assert_reconciles(s0, f0, s1, f1)
+    assert rec["fleet_delta"]["failovers"] >= 1
+
+
+def test_poison_verdict_never_fails_over():
+    """A ServePoisonedError is a terminal verdict about the INPUT:
+    the router must not re-submit it (the same input would poison
+    every replica in turn)."""
+    inj = resilience.FaultInjector(seed=0,
+                                   schedule={"poison_request": {1}})
+    reps = [
+        fleet.EngineReplica(
+            "p0", _make_factory(0),
+            {"max_batch": 8, "max_wait_ms": 1.0, "max_retries": 0,
+             "backoff_ms": 0.1, "fault_injector": inj}),
+        fleet.EngineReplica("p1", _make_factory(1),
+                            {"max_batch": 8, "max_wait_ms": 1.0}),
+    ]
+    s0, f0 = _snaps()
+    with fleet.FleetRouter(reps, supervise_interval_s=5.0) as router:
+        r = router.submit(np.ones((1, 8), np.float32))
+        with pytest.raises(serve.ServePoisonedError):
+            r.result(60)
+        assert r.hops == 0
+    s1, f1 = _snaps()
+    rec = _assert_reconciles(s0, f0, s1, f1)
+    assert rec["fleet_delta"]["failovers"] == 0
+    assert rec["fleet_delta"]["failed"] == 1
+    assert s1["poisoned"] - s0["poisoned"] == 1
+    # the healthy replica never saw a re-submit
+    assert router._slots["p1"].routed == 0
+
+
+def test_failover_hops_bounded_and_counted():
+    """With every replica's dispatcher dying on EVERY cycle (engine
+    restarts off — deterministic, unlike racing a kill against the
+    dispatch loop), a request fails its first replica, fails over at
+    most max_failover_hops times, and then fails LOUDLY — never an
+    unbounded ping-pong."""
+    s0, f0 = _snaps()
+    injs = [resilience.FaultInjector(
+        seed=i, schedule={"dispatcher_kill": 1.0}) for i in range(2)]
+    router = fleet.FleetRouter(
+        _engine_replicas(2, {"max_batch": 4, "max_restarts": 0},
+                         prefix="h", injectors=injs),
+        supervise_interval_s=0.01, max_restarts=0,
+        max_failover_hops=1).start()
+    try:
+        futs, rejected = [], 0
+        for _ in range(4):
+            try:
+                futs.append(router.submit(np.ones((1, 8),
+                                                  np.float32)))
+            except (fleet.FleetUnavailableError,
+                    serve.ServeClosedError):
+                rejected += 1  # both replicas already ejected
+        for f in futs:
+            with pytest.raises((serve.ServeClosedError,
+                                serve.ServeDispatchError,
+                                fleet.FleetUnavailableError)):
+                f.result(60)
+            assert f.hops <= 1
+        assert all(f.done() for f in futs)
+    finally:
+        router.stop()
+    s1, f1 = _snaps()
+    rec = _assert_reconciles(s0, f0, s1, f1)
+    assert rec["fleet_delta"]["failed"] == len(futs)
+    assert rec["fleet_delta"]["rejected"] == rejected
+    assert rec["fleet_delta"]["replies"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Drain + restart
+# ---------------------------------------------------------------------------
+def test_drain_reroutes_queue_completely():
+    """drain(name): in-flight finishes, queued requests reroute —
+    every future resolves bit-identically, nothing new routes to the
+    drained replica."""
+    rs = np.random.RandomState(5)
+    reqs = _dyadic(rs, 30, max_rows=1)
+    refs = _refs(reqs)
+    s0, f0 = _snaps()
+    router = fleet.FleetRouter(
+        _engine_replicas(2, {"max_batch": 2, "max_wait_ms": 0.5},
+                         prefix="d"),
+        supervise_interval_s=0.01).start()
+    try:
+        router._slots["d1"].handle.hang_once(0.2)  # build a backlog
+        futs = [router.submit(x) for x in reqs]
+        router.drain("d0")
+        for i, f in enumerate(futs):
+            out = f.result(60)
+            assert out.tobytes() == refs[i].tobytes(), f"request {i}"
+        assert router._slots["d0"].state == "stopped"
+        routed_d0 = router._slots["d0"].routed
+        # nothing new routes to a drained replica
+        router.submit(reqs[0]).result(60)
+        assert router._slots["d0"].routed == routed_d0
+    finally:
+        router.stop()
+    s1, f1 = _snaps()
+    rec = _assert_reconciles(s0, f0, s1, f1)
+    assert rec["fleet_delta"]["replies"] == len(reqs) + 1
+    assert rec["fleet_delta"]["failed"] == 0
+    assert f1["drains"] - f0["drains"] == 1
+
+
+def test_restart_bound_then_permanent_failure():
+    """The supervisor restarts a killed replica at most max_restarts
+    times; past the budget the replica is abandoned ('failed') and a
+    single-replica fleet refuses loudly."""
+    f0 = stats.cache_stats()["fleet"]
+    router = fleet.FleetRouter(
+        _engine_replicas(1, prefix="b"),
+        supervise_interval_s=0.01, probe_backoff_ms=5.0,
+        max_restarts=1).start()
+    try:
+        router.kill("b0")
+        deadline = time.time() + 15
+        while (router._slots["b0"].state != "ready"
+               and time.time() < deadline):
+            time.sleep(0.01)
+        assert router._slots["b0"].state == "ready", "first restart"
+        router.kill("b0")
+        deadline = time.time() + 15
+        while (router._slots["b0"].state != "failed"
+               and time.time() < deadline):
+            time.sleep(0.01)
+        assert router._slots["b0"].state == "failed", \
+            "restart budget must exhaust"
+        with pytest.raises(fleet.FleetUnavailableError):
+            router.submit(np.ones((1, 8), np.float32))
+    finally:
+        router.stop()
+    f1 = stats.cache_stats()["fleet"]
+    assert f1["restarts"] - f0["restarts"] == 1
+
+
+def test_restart_is_deserialize_only_from_shared_store(tmp_path):
+    """The acceptance pin: with the shared export-cache store armed
+    and prewarmed, a killed replica's supervisor restart rebuilds the
+    MODEL from scratch yet serves its first request from the store —
+    hits >= 1, traces == 0 on the restarted replica."""
+    device.set_export_cache(str(tmp_path / "store"))
+    router = fleet.FleetRouter(
+        _engine_replicas(1, {"max_batch": 4}, prefix="w"),
+        supervise_interval_s=0.01, probe_backoff_ms=5.0,
+        max_restarts=3).start()
+    try:
+        router.warmup(np.ones((1, 8), np.float32))  # populate once
+        es0 = stats.cache_stats()["export"]
+        router.kill("w0")
+        deadline = time.time() + 20
+        while (router._slots["w0"].state != "ready"
+               and time.time() < deadline):
+            time.sleep(0.01)
+        assert router._slots["w0"].state == "ready"
+        out = router.submit(np.ones((1, 8), np.float32)).result(30)
+        assert out is not None
+        es1 = stats.cache_stats()["export"]
+        assert es1["hits"] - es0["hits"] >= 1, "restart must load warm"
+        assert es1["traces"] - es0["traces"] == 0, \
+            "restart must not trace (deserialize-only)"
+    finally:
+        router.stop()
+
+
+# ---------------------------------------------------------------------------
+# Chaos: fleet injector kinds + the soak
+# ---------------------------------------------------------------------------
+def test_fleet_injector_kinds_fire_deterministically():
+    """replica_kill / replica_hang / stale_health key on the router
+    submit ordinal and hit the replica the request routed to."""
+    inj = resilience.FaultInjector(seed=0, schedule={
+        "replica_hang": {1}, "stale_health": {2}, "replica_kill": {3},
+    }, hang_s=0.01)
+    a = StubReplica("a")
+    f0 = stats.cache_stats()["fleet"]
+    with fleet.FleetRouter([a], fault_injector=inj,
+                           supervise_interval_s=5.0) as router:
+        router.submit(np.zeros((1, 4), np.float32)).result(5)
+        assert a.hangs == [0.01] and not a.freezes and not a.killed
+        router.submit(np.zeros((1, 4), np.float32)).result(5)
+        assert len(a.freezes) == 1 and not a.killed
+        router.submit(np.zeros((1, 4), np.float32)).result(5)
+        assert a.killed
+    f1 = stats.cache_stats()["fleet"]
+    assert f1["hangs_injected"] - f0["hangs_injected"] == 1
+    assert f1["stale_injected"] - f0["stale_injected"] == 1
+    assert f1["kills_injected"] - f0["kills_injected"] == 1
+    # determinism: the same (seed, schedule) draws the same answers
+    inj2 = resilience.FaultInjector(seed=0, schedule={
+        "replica_kill": 0.3, "stale_health": 0.3})
+    draws = [(inj2.should("replica_kill", i),
+              inj2.should("stale_health", i)) for i in range(50)]
+    inj3 = resilience.FaultInjector(seed=0, schedule={
+        "replica_kill": 0.3, "stale_health": 0.3})
+    assert draws == [(inj3.should("replica_kill", i),
+                      inj3.should("stale_health", i))
+                     for i in range(50)]
+
+
+def _fleet_chaos_soak(n_requests, seed=11, kill_steps=(),
+                      n_replicas=3, rate=600.0):
+    """Poisson load over N replicas under >=5% injected faults
+    including hard replica kills mid-load. Asserts zero silent
+    losses, bit-identical replies, and exact fleet-wide
+    reconciliation; returns (availability, fleet delta snapshot)."""
+    rs = np.random.RandomState(seed)
+    reqs = _dyadic(rs, n_requests)
+    refs = _refs(reqs, seed=0)
+    injectors = [resilience.FaultInjector(seed=seed + i, schedule={
+        "dispatch_fail": 0.04,
+        "dispatch_hang": 0.02,
+        "poison_request": 0.01,
+        "device_lost_serve": 0.02,
+    }, hang_s=0.004) for i in range(n_replicas)]
+    finj = resilience.FaultInjector(seed=seed, schedule={
+        "replica_kill": set(kill_steps),
+        "replica_hang": 0.01,
+        "stale_health": 0.01,
+    }, hang_s=0.02)
+    reps = _engine_replicas(
+        n_replicas,
+        {"max_batch": 8, "max_retries": 1, "backoff_ms": 0.2,
+         "shed_watermark": 256, "max_restarts": 1000},
+        prefix="c", injectors=injectors)
+    s0, f0 = _snaps()
+    router = fleet.FleetRouter(
+        reps, fault_injector=finj, supervise_interval_s=0.01,
+        health_max_age_s=0.5, probe_backoff_ms=20.0,
+        max_restarts=100, max_failover_hops=3, seed=seed).start()
+    gaps = rs.exponential(1.0 / rate, n_requests)
+    futures = []
+    refused = 0
+    t0 = time.perf_counter()
+    due = 0.0
+    for i, x in enumerate(reqs):
+        due += gaps[i]
+        now = time.perf_counter() - t0
+        if now < due:
+            time.sleep(due - now)
+        try:
+            futures.append((i, serve.submit_with_backoff(
+                router.submit, x, seed=seed, max_attempts=3,
+                max_sleep_s=0.05)))
+        except (serve.ServeOverloadError, serve.ServeQueueFullError,
+                fleet.FleetUnavailableError):
+            refused += 1
+    delivered = failed = 0
+    for i, r in futures:
+        try:
+            out = r.result(120)
+        except (serve.ServeDispatchError, serve.ServeDeadlineError,
+                serve.ServeClosedError, serve.ServeOverloadError,
+                fleet.FleetUnavailableError):
+            failed += 1
+            continue
+        # bit-identity survives retries, bisection, failover hops,
+        # replica kills, AND supervisor restarts
+        assert out.tobytes() == refs[i].tobytes(), f"request {i}"
+        delivered += 1
+    router.stop()
+    # zero silent losses: every submitted future resolved
+    assert all(r.done() for _, r in futures)
+    assert delivered + failed == len(futures)
+    s1, f1 = _snaps()
+    rec = _assert_reconciles(s0, f0, s1, f1)
+    fd = rec["fleet_delta"]
+    assert fd["requests"] == len(futures)
+    assert fd["replies"] == delivered
+    availability = delivered / max(len(futures), 1)
+    return availability, {k: f1[k] - f0[k] for k in f1
+                          if k != "per_replica"}
+
+
+def test_fleet_chaos_soak_smoke():
+    """Tier-1 smoke variant of the fleet soak (short Poisson run with
+    one hard kill; the full soak is the `slow`-marked test below)."""
+    availability, fd = _fleet_chaos_soak(80, seed=11,
+                                         kill_steps={25})
+    assert fd["kills_injected"] >= 1, "no hard kill fired"
+    assert fd["failovers"] > 0
+    assert availability > 0.8
+
+
+@pytest.mark.slow
+def test_fleet_chaos_soak_full():
+    """The acceptance soak: sustained Poisson load, >=5% injected
+    faults with hard replica kills mid-load — availability >= 95%,
+    zero silent losses, bit-identical replies, exact fleet-wide
+    reconciliation, restarts observed."""
+    availability, fd = _fleet_chaos_soak(400, seed=13,
+                                         kill_steps={60, 200})
+    assert fd["kills_injected"] >= 2
+    assert fd["restarts"] >= 1, "supervisor never restarted a kill"
+    assert availability >= 0.95, f"availability {availability:.3f}"
+
+
+# ---------------------------------------------------------------------------
+# Observability
+# ---------------------------------------------------------------------------
+def test_fleet_counters_in_cache_stats():
+    snap = stats.cache_stats()["fleet"]
+    for k in ("requests", "replies", "failed", "rejected", "routed",
+              "failovers", "refused", "shed_retries", "ejections",
+              "rejoins", "restarts", "probes", "drains",
+              "kills_injected", "per_replica"):
+        assert k in snap, k
+    stats.reset_cache_stats()
+    s = stats.cache_stats()["fleet"]
+    assert s["requests"] == 0 and s["failovers"] == 0
+
+
+def test_router_spans_thread_the_tracer():
+    from singa_tpu import trace
+
+    device.set_tracing(True)
+    trace.clear()
+    try:
+        router = fleet.FleetRouter(
+            _engine_replicas(2, {"max_batch": 4}, prefix="t"),
+            supervise_interval_s=0.01, max_restarts=0).start()
+        try:
+            r = router.submit(np.ones((1, 8), np.float32))
+            router.kill("t0")
+            router.kill("t1") if r.replica == "t1" else None
+            try:
+                r.result(30)
+            except Exception:
+                pass
+            names = [rec["name"] for rec in trace.records()]
+            assert "route" in names
+            assert "failover" in names or r.hops == 0
+        finally:
+            router.stop()
+    finally:
+        device.set_tracing(False)
+
+
+def test_fleet_metrics_jsonl_records_routes_and_transitions(tmp_path):
+    from singa_tpu import trace
+
+    mpath = str(tmp_path / "fleet.jsonl")
+    mlog = trace.MetricsLogger(mpath)
+    router = fleet.FleetRouter(
+        _engine_replicas(2, {"max_batch": 4}, prefix="m"),
+        supervise_interval_s=0.01, metrics=mlog, metrics_every=1,
+        max_restarts=0).start()
+    try:
+        router.submit(np.ones((1, 8), np.float32)).result(30)
+        router.kill("m0")
+        time.sleep(0.1)
+    finally:
+        router.stop()
+        mlog.close()
+    recs = trace.read_metrics(mpath)
+    assert recs
+    events = [r["extra"].get("event") for r in recs]
+    assert "route" in events
+    assert "transition" in events
+    route = next(r["extra"] for r in recs
+                 if r["extra"].get("event") == "route")
+    for k in ("states", "routed", "failovers", "refused"):
+        assert k in route, k
+
+
+def test_per_replica_health_files_feed_serve_health_all(tmp_path):
+    """The fleet liveness-probe pipeline end to end: per-replica
+    health_file snapshots -> tools/serve_health.py --all aggregates
+    them with the worst-state exit code."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "serve_health_for_fleet_test",
+        os.path.join(_ROOT, "tools", "serve_health.py"))
+    sh = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sh)
+
+    reps = []
+    for i in range(2):
+        reps.append(fleet.EngineReplica(
+            f"hp{i}", _make_factory(i),
+            {"max_batch": 4, "max_wait_ms": 1.0,
+             "health_file": str(tmp_path / f"hp{i}.health.json")}))
+    router = fleet.FleetRouter(reps, supervise_interval_s=0.01,
+                               max_restarts=0).start()
+    try:
+        router.submit(np.ones((1, 8), np.float32)).result(30)
+        code, lines = sh.probe_all(str(tmp_path))
+        assert code == 0, lines
+        assert any("2 replica(s)" in ln for ln in lines)
+        # a killed replica's snapshot flips the worst state (fail
+        # closed on whatever it last wrote is covered by --max-age)
+        router.kill("hp0")
+        time.sleep(0.2)
+        code, lines = sh.probe_all(str(tmp_path))
+        assert code == 2, lines
+    finally:
+        router.stop()
+    # garbage snapshot fails closed
+    (tmp_path / "bad.health.json").write_text("not json")
+    code, lines = sh.probe_all(str(tmp_path))
+    assert code == 2
+    # empty dir fails closed
+    code, _ = sh.probe_all(str(tmp_path / "nothing"))
+    assert code == 2
+
+
+def test_replica_health_reads_its_own_queue_depth():
+    """A fleet runs N engines in one process and the
+    cache_stats()["serve"] queue_depth gauge is last-writer-wins —
+    one replica's backlog must not leak into ANOTHER replica's
+    health verdict (or its adaptive-wait signal)."""
+    ra, rb = _engine_replicas(2, {"max_batch": 2, "max_wait_ms": 0.5},
+                              prefix="q")
+    ra.start()
+    rb.start()
+    try:
+        ra.hang_once(0.4)  # park ra's dispatcher so its queue builds
+        futs = [ra.submit(np.ones((1, 8), np.float32))
+                for _ in range(4)]
+        deadline = time.time() + 5
+        while ra.depth() < 1 and time.time() < deadline:
+            time.sleep(0.01)
+        assert ra.depth() >= 1
+        hb = rb.engine.health()
+        assert hb["state"] == "ready", hb
+        assert hb["queue_depth"] == 0, (
+            "idle replica reported another replica's backlog")
+        assert ra.engine.health()["queue_depth"] >= 1
+        for f in futs:
+            f.result(30)
+    finally:
+        ra.stop()
+        rb.stop()
+
+
+# ---------------------------------------------------------------------------
+# Client helper + prewarm verify (satellites)
+# ---------------------------------------------------------------------------
+def test_submit_with_backoff_honors_retry_after():
+    calls = []
+
+    def shed_twice(*arrays, deadline_ms=None):
+        calls.append(time.perf_counter())
+        if len(calls) <= 2:
+            raise serve.ServeOverloadError("busy", retry_after_ms=20.0)
+        return "ok"
+
+    t0 = time.perf_counter()
+    out = serve.submit_with_backoff(shed_twice, np.zeros(1), seed=3,
+                                    max_attempts=3)
+    assert out == "ok" and len(calls) == 3
+    expected = (resilience.backoff_delay_s(1, 0.020, jitter=0.5,
+                                           seed=3, salt="client-shed")
+                + resilience.backoff_delay_s(2, 0.020, jitter=0.5,
+                                             seed=3,
+                                             salt="client-shed"))
+    assert time.perf_counter() - t0 >= expected * 0.95
+
+    def always_shed(*arrays, deadline_ms=None):
+        raise serve.ServeOverloadError("busy", retry_after_ms=1.0)
+
+    with pytest.raises(serve.ServeOverloadError):
+        serve.submit_with_backoff(always_shed, np.zeros(1),
+                                  max_attempts=2, seed=3)
+
+    def queue_full(*arrays, deadline_ms=None):
+        raise serve.ServeQueueFullError("full")
+
+    # only overloads retry: a hard drop propagates immediately
+    with pytest.raises(serve.ServeQueueFullError):
+        serve.submit_with_backoff(queue_full, np.zeros(1),
+                                  max_attempts=5, seed=3)
+
+
+def test_prewarm_verify_store_gate(tmp_path):
+    """tools/prewarm.py --verify-store: exit 1 listing every missing
+    (model, bucket) key on an unprovisioned store; exit 0 after the
+    populate-once pass (the start-N gate)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "prewarm_for_fleet_test",
+        os.path.join(_ROOT, "tools", "prewarm.py"))
+    pw = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pw)
+    store = str(tmp_path / "store")
+    args = ["--factory", "examples.mlp.model:create_model",
+            "--input-shape", "784", "--max-batch", "2",
+            "--dir", store]
+    try:
+        assert pw.main(args + ["--verify-store"]) == 1
+        assert pw.main(args) == 0  # populate once
+        assert pw.main(args + ["--verify-store"]) == 0  # start N
+    finally:
+        export_cache.configure(directory=None, buckets=None)

@@ -365,18 +365,16 @@ def test_adaptive_wait_shrinks_toward_zero_under_sustained_depth():
     m = _serving_model()
     eng = serve.ServingEngine(m, max_batch=8, max_wait_ms=10.0,
                               shed_watermark=10, adaptive_wait=True)
-    st = serve.serve_stats()
-    saved_depth = st.queue_depth
-    try:
-        st.queue_depth = 0
-        assert eng._effective_wait_s() == pytest.approx(0.010, rel=0.3)
-        st.queue_depth = 10  # sustained at the watermark
-        waits = [eng._effective_wait_s() for _ in range(40)]
-        assert waits[0] > waits[-1]
-        assert waits[-1] < 0.001  # shrunk toward 0
-        assert _snap()["effective_wait_ms"] is not None
-    finally:
-        st.queue_depth = saved_depth
+    # the adaptive signal reads the ENGINE's own live depth (a fleet
+    # runs N engines in one process; the shared cache_stats gauge is
+    # last-writer-wins and must not steer another engine's window)
+    eng._depth = 0
+    assert eng._effective_wait_s() == pytest.approx(0.010, rel=0.3)
+    eng._depth = 10  # sustained at the watermark
+    waits = [eng._effective_wait_s() for _ in range(40)]
+    assert waits[0] > waits[-1]
+    assert waits[-1] < 0.001  # shrunk toward 0
+    assert _snap()["effective_wait_ms"] is not None
 
 
 def test_overload_sheds_instead_of_queue_collapsing():
@@ -552,16 +550,16 @@ def test_health_states_and_reasons():
         eng._consec_failures = eng.unhealthy_failures
         assert eng.health()["state"] == "unhealthy"
         eng._consec_failures = 0
-        # queue at the watermark degrades
-        st = serve.serve_stats()
-        saved = st.queue_depth
+        # THIS engine's queue at the watermark degrades (health reads
+        # the per-engine depth, not the shared last-writer-wins gauge
+        # — one fleet replica's backlog must not degrade another)
         try:
-            st.queue_depth = 2
+            eng._depth = 2
             h = eng.health()
             assert h["state"] == "degraded"
             assert any("watermark" in r for r in h["reasons"])
         finally:
-            st.queue_depth = saved
+            eng._depth = 0
     assert eng.health()["state"] == "unhealthy"  # stopped
 
 

@@ -145,6 +145,35 @@ def main():
                          f"ms/step, bs{r['batch']}, {r.get('precision')}"
                          f"{', remat' if r.get('remat') else ''}"
                          f"{diet})" + mark))
+        elif "fleet_requests_per_sec" in r:
+            # fleet serving (ISSUE 11): router throughput over N
+            # replicas + SLO percentiles + failover/restart evidence;
+            # the --chaos arm adds availability under replica kills.
+            # Loud MISMATCH on a bit-identity or reconciliation break.
+            bad = ("" if r.get("replies_match", True)
+                   and r.get("counters_reconcile", True)
+                   else " MISMATCH")
+            fo = (f", {r['failovers']} failovers"
+                  if r.get("failovers") else "")
+            rst = (f", {r['restarts']} restarts"
+                   if r.get("restarts") else "")
+            ch = ""
+            if isinstance(r.get("chaos"), dict):
+                c = r["chaos"]
+                cbad = ("" if c.get("replies_match", True)
+                        and c.get("counters_reconcile", True)
+                        else " MISMATCH")
+                ch = (f", chaos: {c.get('availability_pct')}% avail, "
+                      f"p99 {c.get('p99_ms')} ms, "
+                      f"{c.get('kills', 0)} kills/"
+                      f"{c.get('failovers', 0)} failovers/"
+                      f"{c.get('restarts', 0)} restarts{cbad}")
+            rows.append((stage,
+                         f"{r['fleet_requests_per_sec']:.1f} req/s  "
+                         f"({r.get('replicas')} replicas, p50 "
+                         f"{r.get('p50_ms')} ms/p99 {r.get('p99_ms')} "
+                         f"ms{fo}{rst}{bad}{ch}"
+                         + _stage_breakdown(r) + ")" + mark))
         elif "serve_requests_per_sec" in r:
             # serving tier (ISSUE 7): throughput + SLO percentiles +
             # coalescing evidence, with the shared stage breakdown

@@ -15,11 +15,26 @@ DESERIALIZE-only — the request path never traces (ISSUE 7 satellite;
     # what WOULD be built (nothing traces, nothing is written)
     python tools/prewarm.py --onnx model.onnx --max-batch 64 --dry-run
 
+    # fleet provisioning gate (ISSUE 11): is the SHARED store ready
+    # for N replicas? Verifies every (model, bucket) artifact key
+    # resolves (via _JitForward.export_key — the same key the
+    # dispatch path loads), exits 1 listing each miss in full
+    python tools/prewarm.py --onnx model.onnx --max-batch 64 \
+        --verify-store
+
 `--dir` points at the artifact store (default `.export_cache/`, the
 same default `bench.py` and `SINGA_TPU_EXPORT_CACHE` use). Exit code:
-0 when every bucket is present/built, 1 when `--dry-run` found
-missing artifacts (CI-able: "is this store provisioned for this
-config?").
+0 when every bucket is present/built, 1 when `--dry-run` /
+`--verify-store` found missing artifacts (CI-able: "is this store
+provisioned for this config?").
+
+The fleet flow is populate-once-start-N: run this tool ONCE against
+the shared store, point every replica at it
+(`device.set_export_cache` / `SINGA_TPU_EXPORT_CACHE`), and each
+replica's cold start — including a fleet-supervisor RESTART after a
+replica kill — is deserialize-only (store hits, zero traces). Gate
+deploys on `--verify-store` so a fleet never boots against a store
+with holes.
 """
 import argparse
 import os
@@ -100,6 +115,11 @@ def main(argv=None):
     ap.add_argument("--dry-run", action="store_true",
                     help="list present/missing artifacts; trace "
                     "nothing, write nothing")
+    ap.add_argument("--verify-store", action="store_true",
+                    help="fleet provisioning gate: cross-check that "
+                    "every (model, bucket) artifact key resolves in "
+                    "the store; exit 1 listing each miss in full "
+                    "(traces nothing, writes nothing)")
     ap.add_argument("--cpu", action="store_true",
                     help="force the XLA CPU backend")
     a = ap.parse_args(argv)
@@ -116,8 +136,27 @@ def main(argv=None):
 
     device.set_export_cache(os.path.abspath(a.dir))
     m, spec = _build_model(a)
-    rows = serve.prewarm_forward(m, spec, max_batch=a.max_batch,
-                                 dry_run=a.dry_run)
+    rows = serve.prewarm_forward(
+        m, spec, max_batch=a.max_batch,
+        dry_run=a.dry_run or a.verify_store)
+    if a.verify_store:
+        # Fleet gate output: every miss in full (a deploy log must
+        # name the exact keys to re-populate), then the verdict.
+        misses = [r for r in rows if r["status"] == "missing"]
+        for r in misses:
+            seq = f" seq={r['seq']}" if r["seq"] is not None else ""
+            print(f"  MISSING bucket={r['bucket']}{seq} key={r['key']}")
+        if misses:
+            print(f"  store NOT provisioned: {len(misses)} of "
+                  f"{len(rows)} bucket artifact(s) missing from "
+                  f"{os.path.abspath(a.dir)} — run tools/prewarm.py "
+                  "(no --verify-store) once, then start the fleet")
+            return 1
+        print(f"  store provisioned: all {len(rows)} bucket "
+              f"artifact(s) resolve in {os.path.abspath(a.dir)} — "
+              "populate-once-start-N ready (replica cold start and "
+              "restart are deserialize-only)")
+        return 0
     missing = 0
     for r in rows:
         seq = f" seq={r['seq']}" if r["seq"] is not None else ""

@@ -1,5 +1,5 @@
 #!/usr/bin/env python3
-"""Fleet health probe for the serving tier (ISSUE 8).
+"""Fleet health probe for the serving tier (ISSUE 8; --all ISSUE 11).
 
 A `ServingEngine` configured with a `health_file` (engine kwarg or
 `device.set_serving_resilience(health_file=...)`) atomically rewrites
@@ -19,10 +19,22 @@ systemd watchdogs, load-balancer health checks) speak:
                        than --max-age (a wedged process stops writing
                        transitions, so a stale READY must not pass)
 
+Fleet mode (ISSUE 11): `--all DIR` aggregates every `*.health.json`
+snapshot under DIR — one replica per file, the layout a fleet of
+`EngineReplica`s with per-replica `health_file`s writes — into one
+table, exiting with the WORST state seen. Missing directory, no
+snapshots at all, or any unparseable/stale snapshot fail CLOSED as
+unhealthy (exit 2): a fleet probe that cannot see a replica must not
+report the fleet healthy.
+
+    python tools/serve_health.py --all /var/run/singa_tpu/fleet \\
+        --max-age 10
+
 The one-line summary (state + reasons + counters) prints to stdout;
 `--quiet` suppresses it for probe loops that only read the code.
 """
 import argparse
+import glob
 import json
 import os
 import sys
@@ -59,6 +71,37 @@ def probe(path: str, max_age_s: float = 0.0):
     return _EXIT[state], line
 
 
+def probe_all(dirpath: str, max_age_s: float = 0.0):
+    """(worst_exit_code, table_lines) over every `*.health.json`
+    under `dirpath`. Fail closed: unreadable directory or zero
+    snapshots is exit 2 — an empty fleet view must never pass a
+    liveness gate."""
+    if not os.path.isdir(dirpath):
+        return 2, [f"unhealthy: {dirpath} is not a directory — no "
+                   "fleet snapshots to probe"]
+    files = sorted(glob.glob(os.path.join(dirpath, "*.health.json")))
+    if not files:
+        return 2, [f"unhealthy: no *.health.json snapshots under "
+                   f"{dirpath} — replicas not started, or the fleet "
+                   "writes elsewhere"]
+    worst, lines = 0, []
+    width = max(len(os.path.basename(f)[:-len(".health.json")])
+                for f in files)
+    counts = {"ready": 0, "degraded": 0, "unhealthy": 0}
+    for f in files:
+        name = os.path.basename(f)[:-len(".health.json")]
+        code, line = probe(f, max_age_s)
+        worst = max(worst, code)
+        state = {0: "ready", 1: "degraded", 2: "unhealthy"}[code]
+        counts[state] += 1
+        lines.append(f"  {name:<{width}}  {line}")
+    lines.append(
+        f"fleet: {len(files)} replica(s) — {counts['ready']} ready, "
+        f"{counts['degraded']} degraded, {counts['unhealthy']} "
+        f"unhealthy => worst exit {worst}")
+    return worst, lines
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="serving-tier health probe (exit 0/1/2 = "
@@ -67,13 +110,26 @@ def main(argv=None) -> int:
                     default=os.path.join("metrics", "serve_health.json"),
                     help="health snapshot written by a ServingEngine "
                          "with health_file set (default: "
-                         "metrics/serve_health.json)")
+                         "metrics/serve_health.json); with --all, a "
+                         "DIRECTORY of per-replica *.health.json "
+                         "snapshots")
+    ap.add_argument("--all", action="store_true",
+                    help="fleet mode: aggregate every *.health.json "
+                         "under PATH into one table; exit with the "
+                         "WORST state (missing/stale/garbage "
+                         "snapshots fail closed as unhealthy)")
     ap.add_argument("--max-age", type=float, default=0.0,
                     help="seconds beyond which the snapshot counts as "
                          "stale => unhealthy (0 = no staleness check)")
     ap.add_argument("--quiet", action="store_true",
                     help="exit code only, no summary line")
     a = ap.parse_args(argv)
+    if a.all:
+        code, lines = probe_all(a.path, a.max_age)
+        if not a.quiet:
+            for line in lines:
+                print(line)
+        return code
     code, line = probe(a.path, a.max_age)
     if not a.quiet:
         print(line)

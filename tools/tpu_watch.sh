@@ -22,6 +22,15 @@
 #                                      ServingEngine's MetricsLogger
 #                                      stream carries.
 
+#   tools/tpu_watch.sh fleet [DIR]     tail the NEWEST *fleet*.jsonl under
+#                                      DIR and render the FleetRouter's
+#                                      records: route events (replica
+#                                      picked, state census) and
+#                                      transition events (ejections,
+#                                      rejoins, restarts) with the
+#                                      routed/failover/refused counters —
+#                                      the fleet's live control-plane log.
+
 #   tools/tpu_watch.sh tune [DIR]      tail the NEWEST autotune search
 #                                      JSONL under DIR (default:
 #                                      ./metrics, where tools/autotune.py
@@ -77,6 +86,52 @@ for line in sys.stdin:
     if not r.get("feasible", True):
         bits.append("INFEASIBLE")
     bits.append(nd or "default")
+    print("  ".join(bits))
+'
+  exit $?
+fi
+
+if [ "$1" = "fleet" ]; then
+  dir=${2:-metrics}
+  # fleet control-plane streams are tagged *fleet* (ISSUE 11:
+  # FleetRouter's MetricsLogger + bench.py --stage fleet write there)
+  f=$(ls -t "$dir"/*fleet*.jsonl 2>/dev/null | head -1)
+  if [ -z "$f" ]; then
+    echo "tpu_watch: no fleet metrics JSONL under $dir/ yet" >&2
+    exit 1
+  fi
+  echo "tpu_watch: tailing $f" >&2
+  tail -n +1 -F "$f" | python3 -u -c '
+import json, sys
+
+for line in sys.stdin:
+    line = line.strip()
+    if not line:
+        continue
+    try:
+        r = json.loads(line)
+    except ValueError:
+        continue  # partial trailing line from a killed writer
+    if not isinstance(r, dict):
+        continue
+    x = r.get("extra") or {}
+    if "event" not in x:
+        continue  # not a fleet control-plane record
+    states = x.get("states") or {}
+    census = " ".join(f"{k}={v}" for k, v in sorted(states.items()))
+    bits = ["ev " + str(r.get("step", "?")).rjust(5),
+            str(x.get("event", "?")).ljust(10)]
+    if x.get("replica") is not None:
+        bits.append("rep " + str(x["replica"]))
+    if x.get("to_state") is not None:
+        bits.append("-> " + str(x["to_state"])
+                    + (" (" + str(x.get("reason", "")) + ")"
+                       if x.get("reason") else ""))
+    bits.append("[" + census + "]")
+    for k in ("routed", "failovers", "refused", "rejected",
+              "ejections", "rejoins", "restarts"):
+        if x.get(k):
+            bits.append(k + " " + str(x[k]))
     print("  ".join(bits))
 '
   exit $?
