@@ -1440,67 +1440,59 @@ def stage_serve(requests, deadline_s, rate=0.0, max_batch=64,
 
 
 def stage_fleet(requests, deadline_s, rate=0.0, replicas=3,
-                max_batch=32, max_wait_ms=1.0, chaos=False):
-    """Fleet serving (ISSUE 11): drive `singa_tpu.fleet.FleetRouter`
-    over N in-process `EngineReplica`s with a seeded Poisson
-    OPEN-LOOP generator (retry-after-aware client:
+                max_batch=32, max_wait_ms=1.0, chaos=False,
+                transport="engine"):
+    """Fleet serving (ISSUE 11; proc transport ISSUE 13): drive
+    `singa_tpu.fleet.FleetRouter` over N replicas with a seeded
+    Poisson OPEN-LOOP generator (retry-after-aware client:
     `serve.submit_with_backoff`) and report `fleet_requests_per_sec`
     + p50/p99 vs the batch=1 sequential baseline, plus the fleet-wide
     zero-silent-loss reconciliation flag (`fleet.reconcile` — all
     three equations exact).
 
+    `--transport proc` runs each replica as a REAL worker subprocess
+    (`fleet_proc.ProcReplica` over `fleet_worker`): framed IPC,
+    heartbeats, and the transport ledger (`transport_reconcile`)
+    join the result; the chaos arm's pinned kills become REAL
+    SIGKILLs of worker processes mid-load.
+
     `--chaos` adds a second fleet over the SAME arrival schedule with
     per-replica engine injectors (transient dispatch fails/hangs,
     poison, device loss) AND a router-level injector firing hard
-    `replica_kill`s mid-load plus `replica_hang`/`stale_health` —
-    reporting availability %, failover/restart/ejection counters, and
-    the reconciliation flag under fire. CPU-runnable by design, like
-    the serve stage: dyadic params make replies bit-identical to the
-    unbatched forward by arithmetic, across failovers and restarts.
+    kills mid-load plus hangs/stale snapshots (proc adds pipe stalls
+    + torn frames) — reporting availability %, failover/restart/
+    ejection counters, and the reconciliation flag under fire.
+    CPU-runnable by design, like the serve stage: dyadic params make
+    replies bit-identical to the unbatched forward by arithmetic,
+    across failovers, restarts, and process boundaries.
     """
     import numpy as np
 
     t_stage0 = time.time()
     _setup_jax()
-    import jax.numpy as jnp
 
-    from singa_tpu import device, export_cache, fleet, layer, model, \
-        resilience, serve, stats, tensor
+    from singa_tpu import device, export_cache, fleet, resilience, \
+        serve, stats, tensor
     from singa_tpu import trace as trace_mod
+    from benchmarks import fleet_factory
 
     hard_stop = time.time() + deadline_s
     FEATS, HIDDEN, CLASSES = 32, 32, 8
-
-    class ServeMLP(model.Model):
-        def __init__(self):
-            super().__init__()
-            self.fc1 = layer.Linear(HIDDEN)
-            self.r1 = layer.ReLU()
-            self.fc2 = layer.Linear(CLASSES)
-
-        def forward(self, x):
-            return self.fc2(self.r1(self.fc1(x)))
-
-    def make_factory(i):
-        # Each replica owns its device (fleet.EngineReplica contract:
-        # N dispatcher threads must not share RNG-key state) and
-        # rebuilds the SAME dyadic params from the fixed seed, so a
-        # restarted replica's replies stay bit-identical.
-        def factory():
-            dev = device.create_replica_device(i)
-            dev.SetRandSeed(0)
-            m = ServeMLP()
-            m.compile([tensor.from_numpy(
-                np.zeros((max_batch, FEATS), np.float32), device=dev)],
-                is_train=False, use_graph=True)
-            m.eval()
-            for p in m.param_tensors():
-                p.data = jnp.round(p.data * 16.0) / 16.0
-            return m
-        return factory
+    base_spec = {
+        "factory": "benchmarks.fleet_factory:create",
+        "factory_kwargs": {"feats": FEATS, "hidden": HIDDEN,
+                           "classes": CLASSES,
+                           "compile_batch": max_batch, "seed": 0},
+        "sys_path": [HERE],
+        "buckets": {"max_batch": max_batch},
+        "engine": {"max_batch": max_batch, "max_wait_ms": max_wait_ms},
+    }
 
     device.set_shape_buckets(max_batch=max_batch)
-    ref = make_factory(replicas)()  # off-fleet reference model
+    # off-fleet reference model (device_index past every replica's)
+    ref = fleet_factory.create(
+        feats=FEATS, hidden=HIDDEN, classes=CLASSES,
+        compile_batch=max_batch, device_index=replicas)
     ref_dev = ref.param_tensors()[0].device
     setup_s = time.time() - t_stage0
 
@@ -1528,10 +1520,20 @@ def stage_fleet(requests, deadline_s, rate=0.0, replicas=3,
     for i in range(n_cal, requests):
         refs[i] = np.asarray(ref.forward_graph(
             tensor.from_numpy(reqs[i], device=ref_dev)).data).copy()
-    rate = float(rate) or 4.0 * seq_est_rps * replicas
+    if not float(rate):
+        rate = 4.0 * seq_est_rps * replicas
+        if transport == "proc":
+            # The proc transport's request path is IPC-round-trip
+            # bound, not forward bound, and the chaos arm's SIGKILL
+            # recovery is a ~1 s respawn: an open-loop schedule that
+            # finishes in milliseconds would land both kills in one
+            # no-replica window and measure the schedule, not the
+            # fleet. Spread auto-rate arrivals over >= ~4 s.
+            rate = min(rate, max(50.0, requests / 4.0))
+    rate = float(rate)
     compile_s = time.time() - t0
     log(f"calibrated sequential ~{seq_est_rps:.0f} req/s; poisson "
-        f"rate {rate:.0f} req/s over {replicas} replicas")
+        f"rate {rate:.0f} req/s over {replicas} {transport} replicas")
     rs_arr = np.random.RandomState(1)
     arrivals = np.cumsum(rs_arr.exponential(1.0 / rate, requests))
 
@@ -1584,15 +1586,13 @@ def stage_fleet(requests, deadline_s, rate=0.0, replicas=3,
     mpath = os.path.join(HERE, "metrics", "bench_fleet.jsonl")
     mlog = trace_mod.MetricsLogger(mpath)
     s0 = stats.cache_stats()
-    reps = [fleet.EngineReplica(
-        f"r{i}", make_factory(i),
-        {"max_batch": max_batch, "max_wait_ms": max_wait_ms})
-        for i in range(replicas)]
+    reps = fleet.make_replicas(replicas, base_spec,
+                               transport=transport)
     router = fleet.FleetRouter(reps, metrics=mlog,
                                supervise_interval_s=0.01).start()
     warmed = router.warmup(reqs[0])
     log(f"fleet warmup: {warmed} bucket programs over {replicas} "
-        "replicas")
+        f"{transport} replicas")
     futures, refused, t0 = run_fleet(router, seed=0)
     res = resolve(futures)
     if res is None:
@@ -1611,7 +1611,9 @@ def stage_fleet(requests, deadline_s, rate=0.0, replicas=3,
     router.stop()
     s1 = stats.cache_stats()
     rec = fleet.reconcile(s0["serve"], s1["serve"],
-                          s0["fleet"], s1["fleet"])
+                          s0["fleet"], s1["fleet"],
+                          replicas=reps if transport == "proc"
+                          else None)
     steady_s = time.time() - t_steady0
     lat = np.asarray(lats) * 1e3
     fsnap = s1["fleet"]
@@ -1621,31 +1623,57 @@ def stage_fleet(requests, deadline_s, rate=0.0, replicas=3,
     if chaos:
         t_chaos0 = time.time()
         c0 = stats.cache_stats()
+        engine_inj = {"dispatch_fail": 0.04,
+                      "dispatch_hang": 0.02,
+                      "poison_request": 0.01,
+                      "device_lost_serve": 0.02}
+        chaos_engine = {"max_batch": max_batch,
+                        "max_wait_ms": max_wait_ms,
+                        "max_retries": 1, "backoff_ms": 0.2,
+                        "shed_watermark": 512, "max_restarts": 1000}
         creps = []
         for i in range(replicas):
-            inj = resilience.FaultInjector(seed=3 + i, schedule={
-                "dispatch_fail": 0.04,
-                "dispatch_hang": 0.02,
-                "poison_request": 0.01,
-                "device_lost_serve": 0.02,
-            }, hang_s=0.002)
-            creps.append(fleet.EngineReplica(
-                f"c{i}", make_factory(i),
-                {"max_batch": max_batch, "max_wait_ms": max_wait_ms,
-                 "max_retries": 1, "backoff_ms": 0.2,
-                 "shed_watermark": 512, "max_restarts": 1000,
-                 "fault_injector": inj}))
-        finj = resilience.FaultInjector(seed=7, schedule={
-            # hard kills pinned mid-load (the acceptance scenario),
-            # plus probabilistic hangs/stale snapshots
-            "replica_kill": {max(2, requests // 3),
-                             max(3, (2 * requests) // 3)},
+            if transport == "proc":
+                s = dict(base_spec)
+                s["factory_kwargs"] = dict(s["factory_kwargs"],
+                                           device_index=i)
+                s["engine"] = chaos_engine
+                s["injector"] = {"seed": 3 + i,
+                                 "schedule": engine_inj,
+                                 "hang_s": 0.002}
+                from singa_tpu.fleet_proc import ProcReplica
+
+                creps.append(ProcReplica(f"c{i}", s))
+            else:
+                inj = resilience.FaultInjector(
+                    seed=3 + i, schedule=engine_inj, hang_s=0.002)
+                fk = dict(base_spec["factory_kwargs"],
+                          device_index=i)
+                creps.append(fleet.EngineReplica(
+                    f"c{i}",
+                    lambda fk=fk: fleet_factory.create(**fk),
+                    dict(chaos_engine, fault_injector=inj)))
+        # hard kills pinned mid-load (the acceptance scenario), plus
+        # probabilistic hangs/stale snapshots; the proc transport's
+        # pinned kills are REAL SIGKILLs of worker processes, and it
+        # adds pipe stalls + torn frames (the CRC/fail-closed path)
+        kill_kind = ("proc_sigkill" if transport == "proc"
+                     else "replica_kill")
+        sched = {
+            kill_kind: {max(2, requests // 3),
+                        max(3, (2 * requests) // 3)},
             "replica_hang": 0.01,
             "stale_health": 0.01,
-        }, hang_s=0.02)
+        }
+        if transport == "proc":
+            sched["pipe_stall"] = 0.01
+            sched["torn_frame"] = 0.005
+        finj = resilience.FaultInjector(seed=7, schedule=sched,
+                                        hang_s=0.02)
         crouter = fleet.FleetRouter(
             creps, fault_injector=finj, supervise_interval_s=0.01,
-            health_max_age_s=0.5, probe_backoff_ms=20.0,
+            health_max_age_s=0.5 if transport == "engine" else 1.5,
+            probe_backoff_ms=20.0,
             max_restarts=100, max_failover_hops=3, seed=7).start()
         crouter.warmup(reqs[0])
         cfutures, crefused, _ = run_fleet(crouter, seed=7)
@@ -1661,7 +1689,9 @@ def stage_fleet(requests, deadline_s, rate=0.0, replicas=3,
         crouter.stop()
         c1 = stats.cache_stats()
         crec = fleet.reconcile(c0["serve"], c1["serve"],
-                               c0["fleet"], c1["fleet"])
+                               c0["fleet"], c1["fleet"],
+                               replicas=creps if transport == "proc"
+                               else None)
         cd = {k: c1["fleet"][k] - c0["fleet"][k] for k in
               ("failovers", "restarts", "ejections", "rejoins",
                "kills_injected", "refused", "shed_retries")}
@@ -1685,6 +1715,15 @@ def stage_fleet(requests, deadline_s, rate=0.0, replicas=3,
             "counters_reconcile": bool(crec["ok"]),
             "seconds": round(time.time() - t_chaos0, 2),
         }
+        if transport == "proc":
+            chaos_out["transport_reconcile"] = bool(
+                crec.get("transport", True))
+            chaos_out["pipe_stalls"] = (
+                c1["fleet"]["pipe_stalls_injected"]
+                - c0["fleet"]["pipe_stalls_injected"])
+            chaos_out["torn_frames"] = (
+                c1["fleet"]["torn_frames_injected"]
+                - c0["fleet"]["torn_frames_injected"])
         log(f"fleet chaos arm: availability "
             f"{chaos_out['availability_pct']}% p99 "
             f"{chaos_out['p99_ms']} ms ({cd['kills_injected']} kills, "
@@ -1698,6 +1737,7 @@ def stage_fleet(requests, deadline_s, rate=0.0, replicas=3,
         "ok": True, "metric": "fleet_requests_per_sec",
         "requests": requests,
         "replicas": replicas,
+        "transport": transport,
         "rate_rps": round(rate, 1),
         "fleet_requests_per_sec": round(fleet_rps, 1),
         "sequential_requests_per_sec": round(seq_est_rps, 1),
@@ -1714,6 +1754,8 @@ def stage_fleet(requests, deadline_s, rate=0.0, replicas=3,
         "failovers": fsnap["failovers"] - s0["fleet"]["failovers"],
         "restarts": fsnap["restarts"] - s0["fleet"]["restarts"],
         "counters_reconcile": bool(rec["ok"]),
+        **({"transport_reconcile": bool(rec.get("transport", True))}
+           if transport == "proc" else {}),
         "max_batch": max_batch,
         "max_wait_ms": max_wait_ms,
         "stage_seconds": stage_secs,
@@ -1823,6 +1865,13 @@ def main():
     p.add_argument("--replicas", type=int, default=3,
                    help="fleet stage: in-process serving replicas "
                    "behind the router")
+    p.add_argument("--transport", choices=["engine", "proc"],
+                   default="engine",
+                   help="fleet stage replica transport: 'engine' = "
+                   "in-process replicas (PR 11), 'proc' = one REAL "
+                   "worker subprocess per replica over the framed "
+                   "IPC protocol (heartbeats, IPC deadlines; chaos "
+                   "kills become real SIGKILLs)")
     p.add_argument("--pipe", type=int, default=4,
                    help="parallel stage: pipeline depth (stages = "
                    "pipe; mesh is data=8/pipe x pipe)")
@@ -1864,7 +1913,8 @@ def main():
         return stage_fleet(a.requests, a.deadline, rate=a.rate,
                            replicas=a.replicas,
                            max_batch=min(a.serve_max_batch, 32),
-                           max_wait_ms=a.max_wait_ms, chaos=a.chaos)
+                           max_wait_ms=a.max_wait_ms, chaos=a.chaos,
+                           transport=a.transport)
     if a.stage == "parallel":
         return stage_parallel(a.steps, a.deadline, pipe=a.pipe,
                               microbatches=a.microbatches,

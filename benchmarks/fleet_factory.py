@@ -1,0 +1,45 @@
+"""Deterministic serving-model factory for multi-process fleets
+(ISSUE 13). A `fleet_proc.ProcReplica` worker rebuilds its model from
+a spec-named "module:callable" — it cannot close over a parent-process
+object — so the factory lives in an importable module shared by the
+parent (the bit-identity reference model), the worker subprocesses,
+and the tests.
+
+Deterministic by construction: replica `i` builds on its OWN device
+(`device.create_replica_device(device_index)`), seeds it, and rounds
+every parameter to dyadic rationals (multiples of 1/16) so the fused
+bucketed serving dispatch is BIT-identical to the unbatched forward by
+exact float arithmetic — across processes, SIGKILLs, and respawns.
+"""
+import numpy as np
+
+
+def create(feats=32, hidden=32, classes=8, compile_batch=32,
+           seed=0, device_index=0):
+    """A compiled eval-mode MLP (Linear-ReLU-Linear) with dyadic
+    params. The `fleet_proc.ProcReplica` spec factory contract: same
+    kwargs => bit-identical params, every call, every process."""
+    import jax.numpy as jnp
+
+    from singa_tpu import device, layer, model, tensor
+
+    class ServeMLP(model.Model):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = layer.Linear(hidden)
+            self.r1 = layer.ReLU()
+            self.fc2 = layer.Linear(classes)
+
+        def forward(self, x):
+            return self.fc2(self.r1(self.fc1(x)))
+
+    dev = device.create_replica_device(device_index)
+    dev.SetRandSeed(seed)
+    m = ServeMLP()
+    m.compile([tensor.from_numpy(
+        np.zeros((compile_batch, feats), np.float32), device=dev)],
+        is_train=False, use_graph=True)
+    m.eval()
+    for p in m.param_tensors():
+        p.data = jnp.round(p.data * 16.0) / 16.0
+    return m

@@ -20,6 +20,12 @@ Layer map (mirrors SURVEY.md §1, re-designed TPU-first):
     fleet                health-aware router over N serving replicas
                          (failover, shed-aware retry, drain,
                          supervised restarts, replica-kill chaos)
+    fleet_proc           multi-process replica transport: worker
+                         subprocesses behind the same Replica
+                         protocol (framed checksummed IPC, heartbeat
+                         liveness, IPC deadlines, SIGKILL respawn,
+                         exact cross-process reconciliation);
+                         fleet_worker is the spawned entrypoint
     converter            Caffe prototxt importer
     io/ + native/        record IO, snapshot, C++ runtime pieces
 """
@@ -32,6 +38,7 @@ from . import data  # noqa: F401
 from . import device  # noqa: F401
 from . import export_cache  # noqa: F401
 from . import fleet  # noqa: F401
+from . import fleet_proc  # noqa: F401
 from . import initializer  # noqa: F401
 from . import io  # noqa: F401
 from . import layer  # noqa: F401

@@ -765,8 +765,32 @@ def set_fleet(**kw) -> None:
       metrics_every         fleet metrics JSONL record every N routed
                             requests (transitions always log).
 
+    Multi-process transport keys (ISSUE 13; `singa_tpu.fleet_proc`):
+
+      transport             "engine" (in-process replicas) or "proc"
+                            (worker subprocesses behind the same
+                            Replica protocol) — what
+                            `fleet.make_replicas` builds.
+      ipc_deadline_ms       per-message IPC bound: a missing admission
+                            ACK (or a reply this far past the
+                            request's own deadline) fails the caller
+                            with a structured `ProcTransportError`
+                            (`ServeDispatchError` subclass ⇒ the
+                            router fails over unchanged).
+      heartbeat_interval_s  worker heartbeat period; a missed
+                            heartbeat ages the health snapshot into
+                            the router's stale ejection (fail
+                            closed). Keep `health_max_age_s` a few
+                            multiples above it.
+      spawn_timeout_s       bound on worker spawn → HELLO (shared by
+                            the supervisor respawn path).
+      max_inflight          in-flight requests per worker before the
+                            parent sheds with `retry_after_ms`
+                            instead of ballooning the pipe.
+
     Counters: `cache_stats()["fleet"]` (routed/failovers/refused/
-    rejected, ejections/rejoins/restarts, per-replica state)."""
+    rejected, ejections/rejoins/restarts, per-replica state incl.
+    transport ledgers)."""
     from . import fleet
 
     if kw:

@@ -190,6 +190,13 @@ def active() -> bool:
     return _CONFIG["directory"] is not None
 
 
+def directory() -> Optional[str]:
+    """The armed store directory (None when the cache is off) — what
+    a fleet parent hands its worker subprocesses so every replica
+    deserializes from the SAME store (populate-once-start-N)."""
+    return _CONFIG["directory"]
+
+
 def bucket_policy() -> Optional[BucketPolicy]:
     return _CONFIG["buckets"]
 

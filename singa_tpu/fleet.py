@@ -103,7 +103,9 @@ __all__ = [
     "FleetUnavailableError",
     "configure",
     "get_config",
+    "make_replicas",
     "reconcile",
+    "reconcile_transport",
 ]
 
 
@@ -141,6 +143,24 @@ _CONFIG: Dict = {
     # Emit a fleet metrics record every N routed requests (state
     # transitions always log). 0 = transitions only.
     "metrics_every": 32,
+    # --- multi-process transport (ISSUE 13; singa_tpu.fleet_proc) ---
+    # Replica transport `make_replicas` builds: "engine" (in-process
+    # EngineReplica, PR 11) or "proc" (worker subprocess behind the
+    # same Replica protocol).
+    "transport": "engine",
+    # Per-message IPC bound: an admission ACK (or, past the request's
+    # own deadline, a reply frame) later than this fails the caller
+    # with a structured ProcTransportError => failover.
+    "ipc_deadline_ms": 10000.0,
+    # Worker heartbeat period. A missed heartbeat ages the health
+    # snapshot into the router's stale ejection (fail closed), so
+    # keep health_max_age_s a few multiples above this.
+    "heartbeat_interval_s": 0.25,
+    # Bound on worker spawn -> HELLO (the respawn path shares it).
+    "spawn_timeout_s": 120.0,
+    # Max in-flight requests per worker before the parent sheds with
+    # retry_after_ms instead of ballooning the pipe.
+    "max_inflight": 256,
 }
 
 
@@ -151,11 +171,20 @@ def configure(**kw) -> Dict:
         if k not in _CONFIG:
             raise KeyError(f"unknown fleet config key {k!r}; known: "
                            f"{sorted(_CONFIG)}")
-        if k in ("max_failover_hops", "max_shed_retries",
-                 "max_restarts", "metrics_every"):
+        if k == "transport":
+            v = str(v)
+            if v not in ("engine", "proc"):
+                raise ValueError(
+                    f"transport must be 'engine' or 'proc', got {v!r}")
+        elif k in ("max_failover_hops", "max_shed_retries",
+                   "max_restarts", "metrics_every"):
             v = int(v)
             if v < 0:
                 raise ValueError(f"{k} must be >= 0")
+        elif k == "max_inflight":
+            v = int(v)
+            if v < 1:
+                raise ValueError("max_inflight must be >= 1")
         else:
             v = float(v)
             if v <= 0:
@@ -201,10 +230,13 @@ class _FleetStats:
         self.restarts = 0
         self.probes = 0
         self.drains = 0
-        # chaos injections (fleet-level kinds that actually fired)
+        # chaos injections (fleet-level kinds that actually fired;
+        # proc_sigkill counts into kills_injected — a kill is a kill)
         self.kills_injected = 0
         self.hangs_injected = 0
         self.stale_injected = 0
+        self.pipe_stalls_injected = 0
+        self.torn_frames_injected = 0
 
     def snapshot(self) -> Dict:
         per: Dict[str, Dict] = {}
@@ -227,6 +259,8 @@ class _FleetStats:
             "kills_injected": self.kills_injected,
             "hangs_injected": self.hangs_injected,
             "stale_injected": self.stale_injected,
+            "pipe_stalls_injected": self.pipe_stalls_injected,
+            "torn_frames_injected": self.torn_frames_injected,
             "per_replica": per,
         }
 
@@ -240,13 +274,24 @@ def fleet_stats() -> _FleetStats:
 
 
 def reconcile(serve0: Dict, serve1: Dict, fleet0: Dict,
-              fleet1: Dict) -> Dict:
+              fleet1: Dict, replicas: Optional[Sequence] = None
+              ) -> Dict:
     """Check the three zero-silent-loss equations over a
     (before, after) window of `cache_stats()["serve"]` /
     `cache_stats()["fleet"]` snapshots. Exact integer equality — one
     lost future anywhere breaks one of them. Returns the per-equation
     booleans, the combined `ok`, and the deltas for the failure
-    message."""
+    message.
+
+    For a multi-process fleet the parent MIRRORS every IPC request
+    into its own serve counters (`serve.note_remote_request` /
+    `note_remote_terminal`), so the same three equations hold across
+    the process boundary unchanged. Pass `replicas` (the fleet's
+    `ProcReplica` handles) to ALSO check the transport ledger —
+    `reconcile_transport` — and fold its verdict into `ok`: every
+    admitted request either produced a frame that arrived or was
+    swept into `failed` when its worker generation died (a
+    killed-in-flight request can land in failover, never vanish)."""
     sd = {k: serve1[k] - serve0[k] for k in
           ("requests", "replies", "expired", "shed", "dropped",
            "overflowed", "failed")}
@@ -260,7 +305,7 @@ def reconcile(serve0: Dict, serve1: Dict, fleet0: Dict,
                                     + fd["refused"])
     router_ok = fd["requests"] == (fd["replies"] + fd["failed"]
                                    + fd["rejected"])
-    return {
+    out = {
         "ok": bool(engine_ok and routing_ok and router_ok),
         "engine_terminals": bool(engine_ok),
         "routing": bool(routing_ok),
@@ -268,6 +313,145 @@ def reconcile(serve0: Dict, serve1: Dict, fleet0: Dict,
         "serve_delta": sd,
         "fleet_delta": fd,
     }
+    if replicas is not None:
+        tr = reconcile_transport(replicas)
+        out["transport"] = tr["ok"]
+        out["transport_detail"] = tr
+        out["ok"] = bool(out["ok"] and tr["ok"])
+    return out
+
+
+def reconcile_transport(replicas: Sequence) -> Dict:
+    """The process-boundary ledger (ISSUE 13), exact at quiescence,
+    per replica and per worker GENERATION:
+
+      parent terminals   sent == delivered + err_replies +
+                         transport_failed  (every admitted IPC
+                         request resolved into exactly one parent-side
+                         outcome; pending must be 0)
+      generation ledger  admitted == frames + swept  (every admitted
+                         request either produced a reply/error frame
+                         that arrived, or was swept into `failed`
+                         when its generation died — the kill-time
+                         accounting)
+      worker handshake   for generations that drained CLEANLY (the
+                         BYE frame carries the worker's final
+                         counters): the worker's own engine-terminal
+                         equation holds on the shipped snapshot — the
+                         cross-process proof that the worker lost
+                         nothing internally either.
+
+    Replicas without a `transport_snapshot` (in-process
+    `EngineReplica`s) are skipped — their accounting is already the
+    shared-process serve counters."""
+    per: Dict[str, Dict] = {}
+    ok = True
+    for r in replicas:
+        snap_fn = getattr(r, "transport_snapshot", None)
+        if snap_fn is None:
+            continue
+        t = snap_fn()
+        parent_ok = (t["pending"] == 0
+                     and t["sent"] == (t["delivered"] + t["err_replies"]
+                                       + t["transport_failed"]))
+        gens_ok = True
+        hands_ok = True
+        for g, gen in t["generations"].items():
+            if gen["admitted"] != gen["frames"] + gen["swept"]:
+                gens_ok = False
+            h = gen["handshake"]
+            if gen["clean"] and h:
+                wt = h["terminal"]
+                if wt["requests"] != (wt["replies"] + wt["expired"]
+                                      + wt["shed"] + wt["dropped"]
+                                      + wt["overflowed"]
+                                      + wt["failed"]):
+                    hands_ok = False
+        r_ok = bool(parent_ok and gens_ok and hands_ok)
+        per[r.name] = {"ok": r_ok, "parent_terminals": bool(parent_ok),
+                       "generations": bool(gens_ok),
+                       "handshakes": bool(hands_ok), "snapshot": t}
+        ok = ok and r_ok
+    return {"ok": bool(ok), "per_replica": per}
+
+
+def make_replicas(n: int, spec: Dict, transport: Optional[str] = None,
+                  engine_kwargs: Optional[Dict] = None,
+                  name_prefix: str = "r", **proc_kwargs) -> List:
+    """Spec-based replica factory: build N replicas of the configured
+    `transport` ("engine" = in-process `EngineReplica`, "proc" = one
+    worker subprocess each via `fleet_proc.ProcReplica`; default: the
+    `device.set_fleet(transport=...)` knob) from ONE deterministic
+    spec — {"factory": "module:callable", "factory_kwargs": {...},
+    "sys_path": [...], ...} (the `fleet_proc.ProcReplica` spec shape).
+    Replica `i` gets `device_index=i` merged into its factory kwargs,
+    so an N-chip host spreads the fleet one-per-chip and the shared-
+    device warning fires when two replicas collide on one."""
+    transport = transport or get_config()["transport"]
+    out: List = []
+    for i in range(int(n)):
+        s = dict(spec)
+        fk = dict(s.get("factory_kwargs") or {})
+        fk.setdefault("device_index", i)
+        s["factory_kwargs"] = fk
+        name = f"{name_prefix}{i}"
+        if s.get("metrics_dir"):
+            # one JSONL per WORKER process: N processes appending to
+            # one file would interleave mid-record
+            import os as _os
+
+            s["metrics_path"] = _os.path.join(
+                s.pop("metrics_dir"), f"{name}.worker.jsonl")
+        if s.get("health_dir"):
+            # per-replica health snapshots in one directory — the
+            # `tools/serve_health.py --all` fleet-probe layout
+            import os as _os
+
+            ekw = dict(s.get("engine") or {})
+            ekw["health_file"] = _os.path.join(
+                s.pop("health_dir"), f"{name}.health.json")
+            s["engine"] = ekw
+        if transport == "proc":
+            from .fleet_proc import ProcReplica
+
+            if engine_kwargs:
+                ekw = dict(s.get("engine") or {})
+                ekw.update(engine_kwargs)
+                s["engine"] = ekw
+            out.append(ProcReplica(name, s, **proc_kwargs))
+            continue
+        if transport != "engine":
+            raise ValueError(
+                f"unknown fleet transport {transport!r} (engine|proc)")
+        from .fleet_proc import resolve_factory
+
+        fn = resolve_factory(s)
+
+        def factory(fn=fn, fk=fk):
+            return fn(**fk)
+
+        ekw = dict(s.get("engine") or {})
+        if engine_kwargs:
+            ekw.update(engine_kwargs)
+        # One spec, either transport: the worker-side extras the proc
+        # spec names must not silently vanish in-process — a "chaos"
+        # fleet whose injector was dropped would exercise nothing.
+        if s.get("injector"):
+            from .resilience import FaultInjector
+
+            ij = s["injector"]
+            ekw.setdefault("fault_injector", FaultInjector(
+                seed=int(ij.get("seed", 0)),
+                schedule=ij.get("schedule") or {},
+                hang_s=float(ij.get("hang_s", 0.05))))
+        if s.get("metrics_path"):
+            ekw.setdefault(
+                "metrics", trace_mod.MetricsLogger(s["metrics_path"]))
+        # export_cache/buckets are PROCESS-level state in-process:
+        # the engine transport reads the knobs already armed via
+        # device.set_export_cache / set_shape_buckets.
+        out.append(EngineReplica(name, factory, ekw))
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -957,6 +1141,30 @@ class FleetRouter:
         if inj.should("replica_kill", idx):
             _STATS.kills_injected += 1
             self.kill(slot.name)
+        # Process-transport kinds (ISSUE 13): only meaningful on a
+        # handle that exposes the hook — an in-process fleet ignores
+        # them rather than mis-simulating.
+        if inj.should("proc_hang", idx):
+            slot.handle.hang_once(inj.hang_s)
+            _STATS.hangs_injected += 1
+        if inj.should("pipe_stall", idx):
+            fn = getattr(slot.handle, "stall_pipe", None)
+            if fn is not None:
+                fn(inj.hang_s)
+                _STATS.pipe_stalls_injected += 1
+        if inj.should("torn_frame", idx):
+            fn = getattr(slot.handle, "tear_next_frame", None)
+            if fn is not None:
+                fn()
+                _STATS.torn_frames_injected += 1
+        if inj.should("proc_sigkill", idx):
+            fn = getattr(slot.handle, "sigkill", None)
+            if fn is not None:
+                # a REAL os.kill(pid, SIGKILL), and nothing else: the
+                # router must DISCOVER the death (reader EOF, child
+                # exit code), not be told about it
+                fn()
+                _STATS.kills_injected += 1
 
     # -- fleet operations -------------------------------------------------
     def kill(self, name: str) -> None:
@@ -1075,6 +1283,14 @@ class FleetRouter:
                 "refusals": slot.refusals,
                 "restarts": slot.restarts,
             }
+            snap_fn = getattr(slot.handle, "transport_snapshot", None)
+            if snap_fn is not None:
+                t = snap_fn()
+                out[slot.name]["transport"] = {
+                    k: t[k] for k in
+                    ("sent", "delivered", "err_replies",
+                     "transport_failed", "ipc_timeouts",
+                     "torn_frames_detected", "pending", "heartbeats")}
         return out
 
     def _log_metrics(self, event: str, **extra) -> None:
@@ -1094,6 +1310,10 @@ class FleetRouter:
                 routed=_STATS.routed, failovers=_STATS.failovers,
                 refused=_STATS.refused, rejected=_STATS.rejected,
                 ejections=_STATS.ejections, rejoins=_STATS.rejoins,
-                restarts=_STATS.restarts, **extra)
+                restarts=_STATS.restarts,
+                kills_injected=_STATS.kills_injected,
+                pipe_stalls_injected=_STATS.pipe_stalls_injected,
+                torn_frames_injected=_STATS.torn_frames_injected,
+                **extra)
         except Exception:
             pass  # a closed metrics stream must not break routing

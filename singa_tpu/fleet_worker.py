@@ -1,0 +1,296 @@
+"""Fleet worker entrypoint (ISSUE 13): one serving replica in its own
+process. `singa_tpu.fleet_proc.ProcReplica` spawns this module
+(`python -m singa_tpu.fleet_worker`) with the replica spec in
+`SINGA_TPU_FLEET_SPEC`; the worker
+
+  1. forces the jax platform the parent named (`JAX_PLATFORMS` —
+     tier-1 hermeticity: a CPU-pinned test suite must never have a
+     worker wander onto an accelerator),
+  2. arms the SHARED export-cache store (populate-once-start-N: with
+     `tools/prewarm.py` run once, this boot — and every respawn after
+     a SIGKILL — is deserialize-only, export hits >= 1, traces == 0),
+  3. builds the model from the spec's deterministic factory
+     ("module:callable", the `tools/prewarm.py --factory` idiom) and
+     runs a `ServingEngine` over it,
+  4. serves the framed request/reply protocol of
+     `singa_tpu.fleet_proc` over a loopback socket: REQ -> sync ACK
+     (admission verdicts keep their exact single-engine error types)
+     -> REP/ERR per request; HB heartbeats carry the engine `health()`
+     snapshot plus the terminal/export counters the parent's
+     reconciliation and deserialize-only pins read; a DRAIN control
+     ships the final counters (BYE) — the end-of-run reconciliation
+     handshake — before a clean exit 0.
+
+The worker exits when the parent does (socket EOF): no orphans. It
+never writes to stdout (the parent may be a bench stage whose stdout
+is a JSON contract); logs go to stderr."""
+from __future__ import annotations
+
+import json
+import os
+import struct
+import sys
+import threading
+import time
+
+
+def _log(msg: str) -> None:
+    print(f"[fleet-worker {os.getpid()}] {msg}", file=sys.stderr,
+          flush=True)
+
+
+def main() -> int:
+    raw = os.environ.get("SINGA_TPU_FLEET_SPEC")
+    if not raw:
+        raise SystemExit(
+            "fleet_worker: SINGA_TPU_FLEET_SPEC is not set — this "
+            "module is spawned by singa_tpu.fleet_proc.ProcReplica, "
+            "not run by hand")
+    spec = json.loads(raw)
+    name = spec.get("name", "worker")
+
+    # Platform pinning BEFORE any singa_tpu/jax import builds a
+    # backend: the parent names the platform (tier-1 pins cpu); an
+    # environment sitecustomize may have pointed jax elsewhere.
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+        from jax.extend.backend import clear_backends
+
+        clear_backends()
+
+    from singa_tpu import device, resilience, serve, stats
+    from singa_tpu import fleet_proc as wire
+    from singa_tpu import trace as trace_mod
+
+    if spec.get("export_cache"):
+        device.set_export_cache(spec["export_cache"])
+    if spec.get("buckets"):
+        device.set_shape_buckets(**spec["buckets"])
+
+    factory = wire.resolve_factory(spec)
+    t0 = time.perf_counter()
+    model = factory(**(spec.get("factory_kwargs") or {}))
+    _log(f"{name}: model built in {time.perf_counter() - t0:.2f}s "
+         f"(platform {plat or 'default'})")
+
+    injector = None
+    if spec.get("injector"):
+        ij = spec["injector"]
+        injector = resilience.FaultInjector(
+            seed=int(ij.get("seed", 0)),
+            schedule=ij.get("schedule") or {},
+            hang_s=float(ij.get("hang_s", 0.05)))
+    metrics = None
+    if spec.get("metrics_path"):
+        metrics = trace_mod.MetricsLogger(spec["metrics_path"])
+    engine = serve.ServingEngine(model, fault_injector=injector,
+                                 metrics=metrics,
+                                 **(spec.get("engine") or {}))
+    engine.start()
+
+    import socket
+
+    sock = socket.create_connection(
+        ("127.0.0.1", int(spec["port"])), timeout=30.0)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    wlock = threading.Lock()
+    tear_next = threading.Event()  # torn_frame chaos: corrupt next REP
+    stop_ev = threading.Event()
+    outbox_lock = threading.Lock()
+    flush_lock = threading.Lock()  # serializes waiter vs drain flush
+    outbox = []  # [(rid, ServeReply)] admitted, awaiting resolution
+
+    def send(ftype, rid, payload, rep_frame=False):
+        corrupt = rep_frame and tear_next.is_set()
+        if corrupt:
+            tear_next.clear()
+        with wlock:
+            sock.sendall(wire.encode_frame(ftype, rid, payload,
+                                           corrupt=corrupt))
+
+    def counters_payload():
+        s = stats.cache_stats()
+        return {
+            "terminal": serve.terminal_counters(),
+            "poisoned": s["serve"]["poisoned"],
+            "late": s["serve"]["late"],
+            "export": {"hits": s["export"]["hits"],
+                       "traces": s["export"]["traces"],
+                       "misses": s["export"]["misses"]},
+            "pid": os.getpid(),
+        }
+
+    def send_hb():
+        snap = engine.health()
+        snap["time"] = round(time.time(), 3)
+        snap["name"] = name
+        hb = counters_payload()
+        hb["health"] = snap
+        hb["retry_after_ms"] = engine._estimate_retry_after_ms(
+            engine._depth)
+        send(wire.HB, 0, json.dumps(hb).encode("utf-8"))
+
+    def heartbeat_loop():
+        interval = float(spec.get("heartbeat_interval_s", 0.25))
+        while not stop_ev.wait(interval):
+            try:
+                send_hb()
+            except OSError:
+                return
+
+    def flush_done(block_all: bool = False) -> None:
+        """Send REP/ERR for every resolved future in the outbox;
+        `block_all` waits every future out (the drain path — the
+        reconciliation handshake must account for them all).
+        `flush_lock` keeps the waiter thread and the drain path from
+        double-sending one request's frame."""
+        with flush_lock:
+            while True:
+                with outbox_lock:
+                    items = list(outbox)
+                if not items:
+                    return
+                progressed = False
+                for rid, reply in items:
+                    if not reply.done():
+                        if block_all:
+                            try:
+                                reply.result(30.0)
+                            except BaseException:
+                                pass
+                        else:
+                            continue
+                    try:
+                        val = reply.result(0.0)
+                        payload = bytes([1 if reply.deadline_exceeded
+                                         else 0])
+                        payload += wire.encode_tree(val)
+                        send(wire.REP, rid, payload, rep_frame=True)
+                    except BaseException as e:  # noqa: BLE001 — wire
+                        send(wire.ERR, rid, json.dumps(
+                            wire.encode_error(e)).encode("utf-8"))
+                    with outbox_lock:
+                        outbox.remove((rid, reply))
+                    progressed = True
+                if not block_all:
+                    return
+                if not progressed:
+                    time.sleep(0.005)
+
+    def waiter_loop():
+        while not stop_ev.is_set():
+            flush_done()
+            time.sleep(0.001)
+
+    def handle_ctrl(rid, msg):
+        op = msg.get("op")
+        if op == "drain":
+            return "drain", bool(msg.get("drain", True))
+        if op == "counters":
+            send(wire.CTRL_OK, rid,
+                 json.dumps(counters_payload()).encode("utf-8"))
+        elif op == "hang_once":
+            hang_s = float(msg.get("s", 0.05))
+            orig = engine._chaos_attempt
+            fired = []
+
+            def hooked(group):
+                if not fired:
+                    fired.append(1)
+                    engine._chaos_attempt = orig
+                    time.sleep(hang_s)
+                return orig(group)
+
+            engine._chaos_attempt = hooked
+        elif op == "torn_frame":
+            tear_next.set()
+        return None, None
+
+    send(wire.HELLO, 0, json.dumps(
+        {"token": spec.get("token"), "pid": os.getpid(),
+         "name": name}).encode("utf-8"))
+    # First heartbeat IMMEDIATELY: the router must never see a
+    # just-started (or just-respawned) worker as stale for a whole
+    # heartbeat interval — that window would eject every fresh boot.
+    send_hb()
+    threading.Thread(target=heartbeat_loop, daemon=True).start()
+    threading.Thread(target=waiter_loop, daemon=True).start()
+
+    reader = wire.FrameReader()
+    sock.settimeout(0.2)
+    drain_mode = None
+    try:
+        while drain_mode is None:
+            try:
+                chunk = sock.recv(1 << 16)
+            except socket.timeout:
+                continue
+            except OSError:
+                _log(f"{name}: socket error; exiting")
+                return 1
+            if not chunk:
+                _log(f"{name}: parent closed the pipe; exiting")
+                engine.stop(drain=False, drain_timeout_s=1.0)
+                return 0
+            for ftype, rid, payload in reader.feed(chunk):
+                if ftype == wire.REQ:
+                    (dl,) = struct.unpack_from(">d", payload, 0)
+                    arrays = wire.decode_tree(payload[8:])
+                    try:
+                        reply = engine.submit(
+                            *arrays,
+                            deadline_ms=None if dl < 0 else dl)
+                    except BaseException as e:  # noqa: BLE001
+                        send(wire.ERR, rid, json.dumps(
+                            wire.encode_error(e)).encode("utf-8"))
+                        continue
+                    # ACK strictly before the outbox registration:
+                    # the waiter can then never put a REP on the wire
+                    # ahead of its ACK
+                    send(wire.ACK, rid, b"")
+                    with outbox_lock:
+                        outbox.append((rid, reply))
+                elif ftype == wire.WARM:
+                    arrays = wire.decode_tree(payload)
+                    try:
+                        warmed = engine.warmup(*arrays)
+                        send(wire.CTRL_OK, rid, json.dumps(
+                            {"warmed": warmed}).encode("utf-8"))
+                    except BaseException as e:  # noqa: BLE001
+                        send(wire.ERR, rid, json.dumps(
+                            wire.encode_error(e)).encode("utf-8"))
+                elif ftype == wire.CTRL:
+                    op, arg = handle_ctrl(
+                        rid, json.loads(payload.decode("utf-8")))
+                    if op == "drain":
+                        drain_mode = ("drain" if arg else "fail")
+                        break
+    except wire.FrameCorruptError as e:
+        _log(f"{name}: inbound frame corrupt ({e}); exiting loudly")
+        engine.stop(drain=False, drain_timeout_s=1.0)
+        return 1
+
+    # Drain: stop the engine (failing or serving the queue per mode),
+    # flush EVERY outstanding future as a frame, then ship the final
+    # counters — the reconciliation handshake — and exit 0.
+    _log(f"{name}: draining ({drain_mode})")
+    engine.stop(drain=(drain_mode == "drain"))
+    flush_done(block_all=True)
+    stop_ev.set()
+    if metrics is not None:
+        metrics.close()
+    try:
+        send(wire.BYE, 0,
+             json.dumps(counters_payload()).encode("utf-8"))
+        sock.close()
+    except OSError:
+        pass
+    _log(f"{name}: clean exit")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -406,6 +406,17 @@ class FaultInjector:
     "stale_health" (the replica's health snapshot freezes and ages
     into ejection — the wedged-writer scenario `health_max_age_s`
     exists for).
+
+    Process-transport kinds (ISSUE 13; consumed by the same
+    `_chaos_route` hook, meaningful on `fleet_proc.ProcReplica`
+    handles): "proc_sigkill" (a REAL `os.kill(pid, SIGKILL)` of the
+    worker — detection via reader EOF/child exit code and supervisor
+    respawn must be observed, not arranged), "proc_hang" (the
+    worker's next dispatch sleeps `hang_s`, armed over the wire),
+    "pipe_stall" (the parent's next frame write stalls — the IPC
+    deadline/backpressure target), "torn_frame" (the worker corrupts
+    its next reply frame — the CRC check must refuse it; a truncated
+    reply can never be delivered as data).
     """
 
     def __init__(self, seed: int = 0, schedule: Optional[Dict] = None,

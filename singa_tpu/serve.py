@@ -130,6 +130,8 @@ __all__ = [
     "get_resilience_config",
     "prewarm_forward",
     "submit_with_backoff",
+    "terminal_counters",
+    "TERMINAL_KEYS",
 ]
 
 
@@ -415,6 +417,46 @@ stats_mod.register_cache("serve", _STATS)
 
 def serve_stats() -> _ServeStats:
     return _STATS
+
+
+# The seven counters of the terminal-outcome reconciliation invariant
+# (requests == replies + expired + shed + dropped + overflowed +
+# failed at quiescence) — the snapshot a multi-process worker ships in
+# its heartbeat/handshake frames (ISSUE 13).
+TERMINAL_KEYS = ("requests", "replies", "expired", "shed", "dropped",
+                 "overflowed", "failed")
+
+
+def terminal_counters() -> Dict[str, int]:
+    """Serializable snapshot of the terminal counters — what
+    `singa_tpu.fleet_worker` puts on the wire so the parent can
+    reconcile across the process boundary."""
+    return {k: int(getattr(_STATS, k)) for k in TERMINAL_KEYS}
+
+
+def note_remote_request() -> None:
+    """Parent-side mirror for a process-boundary transport
+    (`singa_tpu.fleet_proc`): one IPC submit = one request, exactly
+    like an in-process `ServingEngine.submit`."""
+    _STATS.requests += 1
+
+
+def note_remote_terminal(kind: str, late: bool = False) -> None:
+    """Parent-side mirror of ONE terminal outcome for an IPC request:
+    `kind` is a `TERMINAL_KEYS` bucket (or "poisoned", a subset of
+    `failed`). The transport guarantees exactly one call per
+    `note_remote_request`, which is what keeps the `fleet.reconcile`
+    engine-terminals equation exact across the process boundary."""
+    if kind == "poisoned":
+        _STATS.poisoned += 1
+        kind = "failed"
+    if kind not in TERMINAL_KEYS or kind == "requests":
+        raise ValueError(f"not a terminal bucket: {kind!r}")
+    setattr(_STATS, kind, getattr(_STATS, kind) + 1)
+    if kind in ("failed", "expired"):
+        _STATS.errors += 1  # legacy every-failed-future count
+    if late and kind == "replies":
+        _STATS.late += 1
 
 
 # ---------------------------------------------------------------------------
@@ -1425,6 +1467,10 @@ class ServingEngine:
 
         payload = dict(snap)
         payload["time"] = round(time.time(), 3)
+        # Which process wrote this? A fleet of per-replica snapshots
+        # from separate worker processes (ISSUE 13) is only debuggable
+        # when each file names its writer.
+        payload.setdefault("pid", os.getpid())
         tmp = f"{self.health_file}.tmp.{os.getpid()}"
         try:
             with open(tmp, "w", encoding="utf-8") as f:

@@ -680,3 +680,45 @@ def test_fold_onchip_renders_fleet_stage(tmp_path, capsys,
     (logs / "fleet.out").write_text(json.dumps(row) + "\n")
     assert fold.main() == 0
     assert "MISMATCH" in capsys.readouterr().out
+
+
+def test_fleet_stage_proc_transport_wiring(tmp_path, capsys,
+                                           monkeypatch):
+    """ISSUE 13: the fleet stage grows `--transport proc` (worker
+    subprocesses, real SIGKILLs in the chaos arm, transport ledger in
+    the result) and tools/fold_onchip.py renders the proc row —
+    naming the transport, labeling kills as SIGKILLs, and flagging a
+    broken transport ledger loudly. Engine rows and old logs render
+    unchanged (pinned above)."""
+    src = open(os.path.join(_ROOT, "bench.py")).read()
+    assert '"--transport"' in src
+    assert "transport=a.transport" in src
+    assert "proc_sigkill" in src, (
+        "the proc chaos arm must fire REAL SIGKILLs")
+    assert "reconcile_transport" in src or "replicas=reps" in src, (
+        "the proc arm must check the transport ledger")
+    fold = _load_module("fold_onchip_proc_test", "tools/fold_onchip.py")
+    logs = tmp_path / "onchip_logs"
+    logs.mkdir()
+    row = {"ok": True, "metric": "fleet_requests_per_sec",
+           "fleet_requests_per_sec": 48.8, "replicas": 2,
+           "transport": "proc", "p50_ms": 3.0, "p99_ms": 9.9,
+           "replies_match": True, "counters_reconcile": True,
+           "transport_reconcile": True,
+           "chaos": {"availability_pct": 98.2, "p99_ms": 1083.7,
+                     "kills": 2, "failovers": 2, "restarts": 2,
+                     "replies_match": True, "counters_reconcile": True,
+                     "transport_reconcile": True}}
+    (logs / "fleet.out").write_text(json.dumps(row) + "\n")
+    monkeypatch.setattr(fold, "LOGS", str(logs))
+    assert fold.main() == 0
+    out = capsys.readouterr().out
+    assert "transport=proc" in out
+    assert "2 SIGKILLs" in out
+    assert "MISMATCH" not in out
+    # a broken transport ledger is loud even when the serve-side
+    # counters reconcile
+    row["transport_reconcile"] = False
+    (logs / "fleet.out").write_text(json.dumps(row) + "\n")
+    assert fold.main() == 0
+    assert "MISMATCH" in capsys.readouterr().out

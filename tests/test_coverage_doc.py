@@ -183,6 +183,33 @@ def test_fleet_row_and_readme_section_present():
     assert "--verify-store" in readme
     assert "serve_health.py --all" in readme
     assert "--stage fleet" in readme
+
+
+def test_proc_fleet_row_and_readme_section_present():
+    """ISSUE 13 doc contract: the P22 multi-process-fleet row and the
+    README multi-process-transport topology exist (worker spawn,
+    framed protocol, heartbeats, populate-once-start-N with the
+    --verify-store boot gate)."""
+    cov = open(os.path.join(_ROOT, "COVERAGE.md")).read()
+    assert "| P22 |" in cov
+    assert "singa_tpu/fleet_proc.py" in cov
+    assert "singa_tpu/fleet_worker.py" in cov
+    assert "tests/test_fleet_proc.py" in cov
+    assert "tests/test_fleet_wire.py" in cov
+    assert "proc_sigkill" in cov
+    assert "reconcile_transport" in cov
+    readme = open(os.path.join(_ROOT, "README.md")).read()
+    assert "Multi-process transport" in readme
+    assert "fleet_worker" in readme
+    assert "ProcTransportError" in readme
+    assert "heartbeat_interval_s" in readme
+    assert "max_inflight" in readme
+    assert "make_replicas" in readme
+    assert "--transport proc" in readme
+    assert "proc_sigkill" in readme
+    assert "ipc_deadline_ms" in readme
+    # the boot gate stays documented next to the multi-process flow
+    assert "--verify-store" in readme
     assert "reconcile" in readme
 
 

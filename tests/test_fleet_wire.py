@@ -1,0 +1,388 @@
+"""Multi-process fleet transport, wire layer (ISSUE 13): the framed
+checksummed protocol, the numpy-tree codec, the structured-error
+mapping, and the parent-side serve-counter mirror — everything the
+process boundary rides on, tested WITHOUT spawning workers (the real
+subprocess integration lives in tests/test_fleet_proc.py).
+
+Acceptance pins here:
+  - a torn/corrupt frame can never decode as data: short reads wait,
+    but a CRC mismatch / bad magic / insane length raises
+    `FrameCorruptError` immediately;
+  - the error mapping round-trips every single-engine exception type
+    EXACTLY (a poison verdict stays terminal, an overload keeps its
+    retry_after_ms, a counted closed refusal keeps its flag) so the
+    PR 11 router policies fire unchanged across the boundary;
+  - the parent-side mirror books exactly one terminal bucket per
+    remote request, keeping the engine-terminals equation exact;
+  - satellite: `serve.submit_with_backoff`'s exponential-on-repeat
+    delay is CAPPED by max_sleep_s (a wild retry_after_ms hint must
+    not park the chaos client for minutes);
+  - satellite: a SIGKILLed writer's fleet/worker metrics JSONL stays
+    parseable — `trace.read_metrics` skips the partial trailing line.
+"""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from singa_tpu import device, export_cache, fleet, fleet_proc, \
+    resilience, serve, stats, trace
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+@pytest.fixture(autouse=True)
+def _clean_config():
+    saved = fleet.get_config()
+    yield
+    fleet._CONFIG.update(saved)
+
+
+# ---------------------------------------------------------------------------
+# Framing
+# ---------------------------------------------------------------------------
+def test_frame_round_trip_and_incremental_feed():
+    payload = b"x" * 1000
+    frame = fleet_proc.encode_frame(fleet_proc.REP, 42, payload)
+    r = fleet_proc.FrameReader()
+    # byte-at-a-time: torn-so-far frames WAIT, never error
+    out = []
+    for i in range(len(frame)):
+        out.extend(r.feed(frame[i:i + 1]))
+    assert out == [(fleet_proc.REP, 42, payload)]
+    assert r.pending_bytes() == 0
+    # several frames in one chunk
+    chunk = b"".join(fleet_proc.encode_frame(fleet_proc.HB, i, b"h%d" % i)
+                     for i in range(3))
+    out = fleet_proc.FrameReader().feed(chunk)
+    assert [rid for _, rid, _ in out] == [0, 1, 2]
+
+
+def test_corrupt_frame_is_refused_never_delivered():
+    payload = b"reply-bytes-that-must-not-arrive"
+    torn = fleet_proc.encode_frame(fleet_proc.REP, 7, payload,
+                                   corrupt=True)
+    with pytest.raises(fleet_proc.FrameCorruptError, match="CRC32"):
+        fleet_proc.FrameReader().feed(torn)
+    # bad magic
+    good = fleet_proc.encode_frame(fleet_proc.REP, 7, payload)
+    with pytest.raises(fleet_proc.FrameCorruptError, match="magic"):
+        fleet_proc.FrameReader().feed(b"XX" + good[2:])
+    # insane claimed length fails closed immediately (no 256 MB wait)
+    import struct
+
+    hdr = struct.pack(">2sBBIQI", b"SF", 1, fleet_proc.REP,
+                      2 ** 31, 7, 0)
+    with pytest.raises(fleet_proc.FrameCorruptError, match="cap"):
+        fleet_proc.FrameReader().feed(hdr)
+
+
+def test_flipped_payload_byte_caught_by_crc():
+    frame = bytearray(fleet_proc.encode_frame(fleet_proc.REP, 1,
+                                              b"A" * 64))
+    frame[-1] ^= 0x01  # last payload byte
+    with pytest.raises(fleet_proc.FrameCorruptError):
+        fleet_proc.FrameReader().feed(bytes(frame))
+
+
+# ---------------------------------------------------------------------------
+# Tree codec
+# ---------------------------------------------------------------------------
+def test_tree_codec_round_trip():
+    rs = np.random.RandomState(0)
+    trees = [
+        rs.randn(3, 4).astype(np.float32),
+        [rs.randn(2).astype(np.float64), None,
+         rs.randint(0, 9, (2, 2)).astype(np.int32)],
+        (rs.randn(1, 2, 3).astype(np.float16),),
+        {"logits": rs.randn(2, 5).astype(np.float32),
+         "aux": {"mask": np.asarray([True, False])}},
+        np.asarray(3.5, np.float32).reshape(()),  # 0-d
+    ]
+    for t in trees:
+        out = fleet_proc.decode_tree(fleet_proc.encode_tree(t))
+
+        def eq(a, b):
+            if isinstance(a, np.ndarray):
+                return (a.dtype == b.dtype and a.shape == b.shape
+                        and a.tobytes() == b.tobytes())
+            if isinstance(a, (list, tuple)):
+                return (type(a) is type(b) and len(a) == len(b)
+                        and all(eq(x, y) for x, y in zip(a, b)))
+            if isinstance(a, dict):
+                return (a.keys() == b.keys()
+                        and all(eq(a[k], b[k]) for k in a))
+            return a is None and b is None
+
+        assert eq(t, out), t
+
+
+def test_tree_codec_trailing_bytes_is_loud():
+    buf = fleet_proc.encode_tree(np.zeros((2,), np.float32)) + b"junk"
+    with pytest.raises(fleet_proc.FrameCorruptError, match="trailing"):
+        fleet_proc.decode_tree(buf)
+
+
+# ---------------------------------------------------------------------------
+# Structured error mapping
+# ---------------------------------------------------------------------------
+def test_error_mapping_round_trips_every_kind():
+    cases = [
+        (serve.ServeDeadlineError("late"), serve.ServeDeadlineError),
+        (serve.ServeQueueFullError("full"), serve.ServeQueueFullError),
+        (serve.ServePoisonedError("bad input"),
+         serve.ServePoisonedError),
+        (serve.ServeDispatchError("boom"), serve.ServeDispatchError),
+        (export_cache.BucketOverflowError("too big"),
+         export_cache.BucketOverflowError),
+        (RuntimeError("surprise"), serve.ServeDispatchError),
+    ]
+    for err, want in cases:
+        d = json.loads(json.dumps(fleet_proc.encode_error(err)))
+        back = fleet_proc.decode_error(d)
+        assert isinstance(back, want), (err, back)
+    # a poison verdict must stay terminal through the wire (the
+    # router keys failover on the subclass distinction)
+    back = fleet_proc.decode_error(
+        fleet_proc.encode_error(serve.ServePoisonedError("p")))
+    assert isinstance(back, serve.ServePoisonedError)
+    assert isinstance(back, serve.ServeDispatchError)
+    # overload keeps its structured hint
+    back = fleet_proc.decode_error(fleet_proc.encode_error(
+        serve.ServeOverloadError("busy", retry_after_ms=123.5)))
+    assert isinstance(back, serve.ServeOverloadError)
+    assert back.retry_after_ms == 123.5
+    # a counted closed refusal keeps its flag (the routing-equation
+    # bookkeeping crosses the boundary with it)
+    e = serve.ServeClosedError("stopping")
+    e.counted = True
+    back = fleet_proc.decode_error(fleet_proc.encode_error(e))
+    assert isinstance(back, serve.ServeClosedError)
+    assert back.counted is True
+    # transport errors are ServeDispatchError subclasses => PR 11
+    # failover fires unchanged
+    assert issubclass(fleet_proc.ProcTransportError,
+                      serve.ServeDispatchError)
+    back = fleet_proc.decode_error({"kind": "transport", "msg": "x"})
+    assert isinstance(back, fleet_proc.ProcTransportError)
+
+
+# ---------------------------------------------------------------------------
+# Parent-side serve-counter mirror
+# ---------------------------------------------------------------------------
+def test_remote_mirror_keeps_engine_equation_exact():
+    s0 = stats.cache_stats()["serve"]
+    outcomes = ["replies", "expired", "shed", "dropped", "overflowed",
+                "failed", "poisoned"]
+    for kind in outcomes:
+        serve.note_remote_request()
+        serve.note_remote_terminal(kind)
+    serve.note_remote_request()
+    serve.note_remote_terminal("replies", late=True)
+    s1 = stats.cache_stats()["serve"]
+    d = {k: s1[k] - s0[k] for k in serve.TERMINAL_KEYS
+         + ("poisoned", "late", "errors")}
+    assert d["requests"] == len(outcomes) + 1
+    assert d["requests"] == (d["replies"] + d["expired"] + d["shed"]
+                             + d["dropped"] + d["overflowed"]
+                             + d["failed"])
+    assert d["poisoned"] == 1  # subset of failed
+    assert d["late"] == 1
+    with pytest.raises(ValueError):
+        serve.note_remote_terminal("requests")
+    with pytest.raises(ValueError):
+        serve.note_remote_terminal("bogus")
+    # the worker-side handshake snapshot ships exactly these keys
+    snap = serve.terminal_counters()
+    assert set(snap) == set(serve.TERMINAL_KEYS)
+    assert all(isinstance(v, int) for v in snap.values())
+
+
+# ---------------------------------------------------------------------------
+# Knobs + spec plumbing
+# ---------------------------------------------------------------------------
+def test_transport_knobs_validate_and_reach_replicas():
+    device.set_fleet(transport="proc", ipc_deadline_ms=500.0,
+                     heartbeat_interval_s=0.05, spawn_timeout_s=30.0,
+                     max_inflight=7)
+    cfg = fleet.get_config()
+    assert cfg["transport"] == "proc"
+    assert cfg["max_inflight"] == 7
+    r = fleet_proc.ProcReplica(
+        "k0", {"factory": "benchmarks.fleet_factory:create"})
+    assert r.ipc_deadline_s == pytest.approx(0.5)
+    assert r.heartbeat_interval_s == pytest.approx(0.05)
+    assert r.max_inflight == 7
+    # per-replica override wins
+    r2 = fleet_proc.ProcReplica(
+        "k1", {"factory": "benchmarks.fleet_factory:create"},
+        max_inflight=3)
+    assert r2.max_inflight == 3
+    with pytest.raises(ValueError, match="transport"):
+        fleet.configure(transport="carrier-pigeon")
+    with pytest.raises(ValueError):
+        fleet.configure(max_inflight=0)
+    with pytest.raises(ValueError):
+        fleet.configure(ipc_deadline_ms=0)
+    with pytest.raises(ValueError, match="factory"):
+        fleet_proc.ProcReplica("k2", {})
+
+
+def test_spec_with_step_set_schedule_is_wire_safe():
+    """The documented FaultInjector schedule form (a SET of step
+    ordinals) must survive the spec's JSON trip to the worker — and
+    the same spec must build the same injector on either
+    transport."""
+    spec = {"factory": "benchmarks.fleet_factory:create",
+            "injector": {"seed": 1,
+                         "schedule": {"dispatch_fail": {2, 5},
+                                      "dispatch_hang": 0.1}}}
+    payload = json.loads(json.dumps(fleet_proc._jsonable_spec(spec)))
+    assert payload["injector"]["schedule"]["dispatch_fail"] == [2, 5]
+    inj = resilience.FaultInjector(**payload["injector"])
+    assert inj.should("dispatch_fail", 2)
+    assert inj.should("dispatch_fail", 5)
+    assert not inj.should("dispatch_fail", 3)
+    # the caller's spec is not mutated
+    assert spec["injector"]["schedule"]["dispatch_fail"] == {2, 5}
+    # and the shared factory resolver refuses a malformed spec loudly
+    with pytest.raises(ValueError, match="module:callable"):
+        fleet_proc.resolve_factory({"factory": "no-colon-here"})
+
+
+def test_make_replicas_spec_plumbing(tmp_path):
+    spec = {"factory": "benchmarks.fleet_factory:create",
+            "factory_kwargs": {"feats": 8},
+            "sys_path": [_ROOT],
+            "metrics_dir": str(tmp_path),
+            "health_dir": str(tmp_path),
+            "engine": {"max_batch": 4}}
+    reps = fleet.make_replicas(2, spec, transport="proc",
+                               name_prefix="p")
+    assert [r.name for r in reps] == ["p0", "p1"]
+    for i, r in enumerate(reps):
+        assert r.spec["factory_kwargs"]["device_index"] == i
+        assert r.spec["factory_kwargs"]["feats"] == 8
+        assert r.spec["metrics_path"].endswith(f"p{i}.worker.jsonl")
+        assert r.spec["engine"]["health_file"].endswith(
+            f"p{i}.health.json")
+        assert r.spec["engine"]["max_batch"] == 4
+    # engine transport from the same spec shape — the proc-spec
+    # extras (injector, metrics) must not silently vanish in-process
+    ereps = fleet.make_replicas(1, {
+        "factory": "benchmarks.fleet_factory:create",
+        "factory_kwargs": {"feats": 8, "hidden": 4, "classes": 2,
+                           "compile_batch": 2},
+        "sys_path": [_ROOT],
+        "metrics_dir": str(tmp_path),
+        "injector": {"seed": 5, "schedule": {"dispatch_fail": {2}},
+                     "hang_s": 0.01}},
+        transport="engine", name_prefix="e")
+    assert isinstance(ereps[0], fleet.EngineReplica)
+    inj = ereps[0]._kwargs["fault_injector"]
+    assert inj.seed == 5 and inj.should("dispatch_fail", 2)
+    assert not inj.should("dispatch_fail", 1)
+    mlog = ereps[0]._kwargs["metrics"]
+    assert mlog.path.endswith("e0.worker.jsonl")
+    mlog.close()
+    with pytest.raises(ValueError, match="transport"):
+        fleet.make_replicas(1, spec, transport="smoke-signals")
+
+
+def test_shared_device_warning_covers_proc_replicas(capsys):
+    """Drive-by satellite: two workers pinned to one device id warn
+    LOUDLY at fleet construction — contention for a chip must not
+    surface as mystery latency under load."""
+    a = fleet_proc.ProcReplica(
+        "w0", {"factory": "benchmarks.fleet_factory:create",
+               "factory_kwargs": {"device_index": 3}})
+    b = fleet_proc.ProcReplica(
+        "w1", {"factory": "benchmarks.fleet_factory:create",
+               "factory_kwargs": {"device_index": 3}})
+    assert a.device_token() == b.device_token() == ("proc-device", 3)
+    router = fleet.FleetRouter([a, b], supervise_interval_s=5.0)
+    # start without spawning: the warning check runs in start()
+    a.start = lambda: a  # type: ignore[method-assign]
+    b.start = lambda: b  # type: ignore[method-assign]
+    try:
+        router.start()
+    finally:
+        router.stop(drain=False)
+    err = capsys.readouterr().err
+    assert "share one device" in err
+    # distinct pins stay quiet
+    c = fleet_proc.ProcReplica(
+        "w2", {"factory": "benchmarks.fleet_factory:create",
+               "factory_kwargs": {"device_index": 4}})
+    assert c.device_token() != a.device_token()
+
+
+# ---------------------------------------------------------------------------
+# Satellites: backoff cap + crash-flushed JSONL
+# ---------------------------------------------------------------------------
+def test_submit_with_backoff_cap_bounds_wild_hints():
+    """A shedding engine quoting a wild retry_after_ms (seconds) must
+    not park the chaos client: every sleep — including the
+    exponential-on-repeat growth — is capped at max_sleep_s. The
+    jitter is seed-keyed, so the exact uncapped delays are
+    computable; this pins that BOTH retries would exceed the cap yet
+    the measured wall time stays at ~2 caps."""
+    calls = []
+
+    def shed_twice(*arrays, deadline_ms=None):
+        calls.append(time.perf_counter())
+        if len(calls) <= 2:
+            raise serve.ServeOverloadError("busy",
+                                           retry_after_ms=30000.0)
+        return "ok"
+
+    # both uncapped delays (30 s base, doubling) dwarf the cap
+    for attempt in (1, 2):
+        assert resilience.backoff_delay_s(
+            attempt, 30.0, jitter=0.5, seed=9,
+            salt="client-shed") > 1.0
+    t0 = time.perf_counter()
+    out = serve.submit_with_backoff(shed_twice, np.zeros(1), seed=9,
+                                    max_attempts=3, max_sleep_s=0.05)
+    elapsed = time.perf_counter() - t0
+    assert out == "ok" and len(calls) == 3
+    assert elapsed < 1.0, (
+        f"cap did not hold: {elapsed:.2f}s for two capped 50 ms "
+        "sleeps — a miscapped backoff stalls the bench chaos client "
+        "for minutes")
+    # and the two inter-call gaps each honored the cap
+    gaps = [calls[1] - calls[0], calls[2] - calls[1]]
+    assert all(g <= 0.5 for g in gaps), gaps
+    # determinism: same seed, same draw
+    d1 = resilience.backoff_delay_s(1, 30.0, jitter=0.5, seed=9,
+                                    salt="client-shed")
+    d2 = resilience.backoff_delay_s(1, 30.0, jitter=0.5, seed=9,
+                                    salt="client-shed")
+    assert d1 == d2
+
+
+def test_fleet_metrics_reader_skips_partial_trailing_line(tmp_path):
+    """Satellite: the fleet/worker metrics JSONL reader is
+    `trace.read_metrics` — a SIGKILLed router/worker leaves at most
+    one partial trailing line, and the reader must skip it (plus any
+    interleaved garbage) instead of raising."""
+    p = str(tmp_path / "fleet.jsonl")
+    with trace.MetricsLogger(p) as m:
+        m.log_step(1, event="route", routed=1)
+        m.log_step(2, event="transition", to_state="dead")
+    # a kill mid-write leaves a torn record: no newline, half a JSON
+    with open(p, "a", encoding="utf-8") as f:
+        f.write('{"schema": 1, "step": 3, "extra": {"event": "rou')
+    recs = trace.read_metrics(p)
+    assert len(recs) == 2
+    assert [r["step"] for r in recs] == [1, 2]
+    assert recs[0]["extra"]["event"] == "route"
+    # garbage interleaved mid-file is skipped too
+    with open(p, "a", encoding="utf-8") as f:
+        f.write("\nnot json at all\n")
+        f.write(json.dumps({"schema": 1, "step": 4, "loss": None,
+                            "extra": {"event": "route"}}) + "\n")
+    recs = trace.read_metrics(p)
+    assert [r["step"] for r in recs] == [1, 2, 4]

@@ -152,25 +152,35 @@ def main():
             # Loud MISMATCH on a bit-identity or reconciliation break.
             bad = ("" if r.get("replies_match", True)
                    and r.get("counters_reconcile", True)
+                   and r.get("transport_reconcile", True)
                    else " MISMATCH")
             fo = (f", {r['failovers']} failovers"
                   if r.get("failovers") else "")
             rst = (f", {r['restarts']} restarts"
                    if r.get("restarts") else "")
+            # proc transport (ISSUE 13): name it in the row — the
+            # same req/s means something different across a process
+            # boundary; engine rows (and old logs) render unchanged
+            tp = (f", transport={r['transport']}"
+                  if r.get("transport", "engine") != "engine" else "")
             ch = ""
             if isinstance(r.get("chaos"), dict):
                 c = r["chaos"]
                 cbad = ("" if c.get("replies_match", True)
                         and c.get("counters_reconcile", True)
+                        and c.get("transport_reconcile", True)
                         else " MISMATCH")
+                kills = (f"{c.get('kills', 0)} SIGKILLs"
+                         if r.get("transport") == "proc"
+                         else f"{c.get('kills', 0)} kills")
                 ch = (f", chaos: {c.get('availability_pct')}% avail, "
                       f"p99 {c.get('p99_ms')} ms, "
-                      f"{c.get('kills', 0)} kills/"
+                      f"{kills}/"
                       f"{c.get('failovers', 0)} failovers/"
                       f"{c.get('restarts', 0)} restarts{cbad}")
             rows.append((stage,
                          f"{r['fleet_requests_per_sec']:.1f} req/s  "
-                         f"({r.get('replicas')} replicas, p50 "
+                         f"({r.get('replicas')} replicas{tp}, p50 "
                          f"{r.get('p50_ms')} ms/p99 {r.get('p99_ms')} "
                          f"ms{fo}{rst}{bad}{ch}"
                          + _stage_breakdown(r) + ")" + mark))

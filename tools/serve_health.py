@@ -66,6 +66,11 @@ def probe(path: str, max_age_s: float = 0.0):
                                    "restarts", "expired", "shed",
                                    "retries", "failed") if k in snap)
     line = state + ("" if not reasons else ": " + "; ".join(reasons))
+    # Multi-process fleets (ISSUE 13) write one snapshot per WORKER
+    # process: name the writer pid so a stale/garbage row is
+    # attributable to a specific process, not just a file.
+    if snap.get("pid") is not None:
+        line += f"  pid={snap['pid']}"
     if counters:
         line += "  [" + counters + "]"
     return _EXIT[state], line

@@ -129,7 +129,8 @@ for line in sys.stdin:
                        if x.get("reason") else ""))
     bits.append("[" + census + "]")
     for k in ("routed", "failovers", "refused", "rejected",
-              "ejections", "rejoins", "restarts"):
+              "ejections", "rejoins", "restarts", "kills_injected",
+              "pipe_stalls_injected", "torn_frames_injected"):
         if x.get(k):
             bits.append(k + " " + str(x[k]))
     print("  ".join(bits))
