@@ -1582,12 +1582,34 @@ def stage_fleet(requests, deadline_s, rate=0.0, replicas=3,
         return delivered, failed, match, lats, t_last
 
     # -- clean fleet arm ---------------------------------------------------
+    # Distributed tracing ON (ISSUE 15): every request gets a trace
+    # context threaded through routing/failover/IPC/worker dispatch;
+    # the run ends with ONE merged Chrome timeline + the aggregated
+    # latency_breakdown/trace result blocks. Overhead is measured
+    # (< 2%) by benchmarks/eager_overhead.py's fleet A/B.
     t_steady0 = time.time()
+    device.set_tracing(True, ring_capacity=1 << 16)
+    trace_mod.clear()
+    import glob as glob_mod
+
     mpath = os.path.join(HERE, "metrics", "bench_fleet.jsonl")
+    # this stage OWNS the fleet telemetry files: start them fresh —
+    # aggregate_fleet takes max-over-file counters and per-dispatch
+    # sums, so a previous run's appended records would silently
+    # pollute this run's availability/worker blocks
+    for stale in [mpath] + glob_mod.glob(os.path.join(
+            HERE, "metrics", "bench_fleet_w*.worker.jsonl")):
+        try:
+            os.remove(stale)
+        except OSError:
+            pass
     mlog = trace_mod.MetricsLogger(mpath)
     s0 = stats.cache_stats()
-    reps = fleet.make_replicas(replicas, base_spec,
-                               transport=transport)
+    wspec = dict(base_spec,
+                 metrics_dir=os.path.join(HERE, "metrics"))
+    reps = fleet.make_replicas(replicas, wspec,
+                               transport=transport,
+                               name_prefix="bench_fleet_w")
     router = fleet.FleetRouter(reps, metrics=mlog,
                                supervise_interval_s=0.01).start()
     warmed = router.warmup(reqs[0])
@@ -1614,6 +1636,39 @@ def stage_fleet(requests, deadline_s, rate=0.0, replicas=3,
                           s0["fleet"], s1["fleet"],
                           replicas=reps if transport == "proc"
                           else None)
+    # ONE merged cross-process timeline + the fleet aggregate record
+    # (ISSUE 15): router spans + shipped worker spans under their
+    # estimated clock offsets; the aggregate (per-segment p50/p99,
+    # availability) is appended to the fleet JSONL so
+    # tools/tpu_watch.sh fleet and tools/fleet_top.py render it.
+    tpath = os.path.join(HERE, "metrics", "bench_fleet_trace.json")
+    router.export_trace(tpath)
+    wpaths = sorted(glob_mod.glob(os.path.join(
+        HERE, "metrics", "bench_fleet_w*.worker.jsonl")))
+    agg = trace_mod.aggregate_fleet(paths=[mpath] + wpaths,
+                                    chrome_trace=tpath)
+    mlog.log_step(0, event="aggregate", segments=agg["segments"],
+                  availability_pct=agg["availability_pct"],
+                  trace_ids=agg["trace_ids"],
+                  span_count=agg["span_count"])
+    spans_dropped = sum(
+        r.transport_snapshot().get("spans_dropped", 0) +
+        sum((g.get("handshake") or {}).get("trace", {}).get(
+            "ship_dropped", 0)
+            for g in r.transport_snapshot()["generations"].values())
+        for r in reps if hasattr(r, "transport_snapshot"))
+    trace_block = {
+        "chrome_trace": os.path.relpath(tpath, HERE),
+        "span_count": agg["span_count"],
+        "trace_ids": agg["trace_ids"],
+        "pids": len({e.get("pid") for e in json.load(
+            open(tpath))["traceEvents"]}),
+        "spans_dropped": spans_dropped,
+    }
+    latency_breakdown = {
+        k: v for k, v in agg["segments"].items()
+        if k in ("queue_wait", "ipc", "dispatch", "reply", "route")}
+    device.set_tracing(False)
     steady_s = time.time() - t_steady0
     lat = np.asarray(lats) * 1e3
     fsnap = s1["fleet"]
@@ -1756,6 +1811,8 @@ def stage_fleet(requests, deadline_s, rate=0.0, replicas=3,
         "counters_reconcile": bool(rec["ok"]),
         **({"transport_reconcile": bool(rec.get("transport", True))}
            if transport == "proc" else {}),
+        "latency_breakdown": latency_breakdown,
+        "trace": trace_block,
         "max_batch": max_batch,
         "max_wait_ms": max_wait_ms,
         "stage_seconds": stage_secs,

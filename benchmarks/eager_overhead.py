@@ -237,6 +237,100 @@ def _measure_trace(steps):
     }
 
 
+def _measure_fleet_trace(quick):
+    """Proc-fleet tracer on/off A/B (ISSUE 15 acceptance: the fleet
+    observability layer — trace contexts on REQ frames, span ship-back
+    on reply/heartbeat frames, clock-offset estimation — stays small
+    against request latency on a REAL 2-worker `transport="proc"`
+    fleet, and records literally NOTHING with tracing off: zero
+    spans, zero added frame bytes). One fleet serves every block;
+    arms are INTERLEAVED (off, on, off, on, ...) with per-arm medians
+    so machine drift cancels instead of masquerading as overhead.
+    Honest accounting: once a worker sees a traced REQ its tracer
+    stays armed (nothing disarms across the boundary), so the
+    interleaved `off` arm measures the production toggle — parent
+    tracing off, workers armed but idle — while `off_cold_req_ms`
+    (the pre-arming block) is the fully-unarmed baseline the
+    zero-span pin runs against."""
+    import tempfile
+
+    from singa_tpu import device, fleet, stats
+
+    root = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                        ".."))
+    # requests are cheap (the worker BOOT is this measurement's fixed
+    # cost) — blocks stay big even under --quick: small blocks put
+    # heartbeat/GC noise in the numerator of a ~3% effect
+    n = 120
+    blocks = 4 if quick else 6
+    spec = {"factory": "benchmarks.fleet_factory:create",
+            "factory_kwargs": {"feats": 16, "hidden": 16, "classes": 4,
+                               "compile_batch": 8},
+            "sys_path": [root],
+            "engine": {"max_batch": 8, "max_wait_ms": 0.5}}
+    reps = fleet.make_replicas(2, spec, transport="proc",
+                               name_prefix="ab",
+                               heartbeat_interval_s=0.2)
+    router = fleet.FleetRouter(reps, supervise_interval_s=0.02).start()
+    x = np.ones((1, 16), np.float32)
+    # warm EVERY bucket on both workers before any block: the burst
+    # coalesces into buckets sequential warm requests never touch,
+    # and no arm may eat their XLA compiles
+    router.warmup(x)
+
+    def spans():
+        return stats.cache_stats()["trace"]["spans"]
+
+    def block(tracing):
+        device.set_tracing(tracing)
+        try:
+            for _ in range(3):  # settle this arm's path
+                router.submit(x).result(60)
+            t0 = time.perf_counter()
+            futs = [router.submit(x) for _ in range(n)]
+            for f in futs:
+                f.result(60)
+            return (time.perf_counter() - t0) / n
+        finally:
+            device.set_tracing(False)
+
+    try:
+        # cold-off: workers not yet armed — the strict-no-op pin and
+        # the fully-unarmed latency baseline
+        s0 = spans()
+        off_cold = block(False)
+        off_spans = spans() - s0
+        block(True)  # arm the workers once (lazy, on the traced REQ)
+        offs, ons = [], []
+        s0 = spans()
+        for _ in range(blocks):
+            offs.append(block(False))
+            ons.append(block(True))
+        on_spans = spans() - s0  # parent spans from the on blocks
+        offs.sort()
+        ons.sort()
+        off = offs[len(offs) // 2]
+        on = ons[len(ons) // 2]
+        time.sleep(0.5)  # heartbeats ship the last buffered spans
+        tpath = tempfile.mktemp(suffix=".json")
+        router.export_trace(tpath)  # ring survives disable
+        with open(tpath) as f:
+            evs = json.load(f)["traceEvents"]
+        os.unlink(tpath)
+        pids = {e.get("pid") for e in evs}
+    finally:
+        router.stop()
+    return {
+        "off_req_ms": round(off * 1e3, 4),
+        "off_cold_req_ms": round(off_cold * 1e3, 4),
+        "on_req_ms": round(on * 1e3, 4),
+        "fleet_trace_overhead_pct": round((on - off) / off * 100.0, 2),
+        # the deterministic half: disabled records literally nothing
+        "spans": {"disabled": off_spans, "enabled": on_spans},
+        "pids_in_merged_trace": len(pids),
+    }
+
+
 def _measure_accum(steps, n=8):
     """Gradient-accumulation dispatch amortization on the eager path
     (ISSUE 4): process the SAME n microbatches either as n independent
@@ -618,6 +712,15 @@ def main():
           f"spans_per_step disabled={tr['spans_per_step']['disabled']} "
           f"enabled={tr['spans_per_step']['enabled']}")
 
+    # -- Part 1b2b: proc-fleet tracer on/off A/B (ISSUE 15) ---------------
+    ft = _measure_fleet_trace(a.quick)
+    print(f"fleet_trace off_req_ms={ft['off_req_ms']} "
+          f"on_req_ms={ft['on_req_ms']} "
+          f"fleet_trace_overhead_pct={ft['fleet_trace_overhead_pct']} "
+          f"spans disabled={ft['spans']['disabled']} "
+          f"enabled={ft['spans']['enabled']} "
+          f"pids_in_merged_trace={ft['pids_in_merged_trace']}")
+
     # -- Part 1b3: AOT export-cache cold-vs-warm A/B (ISSUE 6) ------------
     ws = _measure_warm_start(a.quick)
     print(f"warm_start cold_first_step_s={ws['cold_first_step_s']} "
@@ -686,6 +789,7 @@ def main():
         "eager_us_per_op": round(per_op_us, 1),
         "step_guard": guard,
         "trace": tr,
+        "fleet_trace": ft,
         "warm_start": ws,
         "accum": accum,
         "demo": demo,

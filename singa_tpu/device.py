@@ -599,7 +599,8 @@ def set_remat_policy(policy, *names) -> None:
 
 
 def set_tracing(flag: bool = True, ring_capacity: Optional[int] = None,
-                profile_dir: Optional[str] = None) -> None:
+                profile_dir: Optional[str] = None,
+                ship_capacity: Optional[int] = None) -> None:
     """Toggle the span-based host tracer (`singa_tpu.trace`).
 
     Disabled (the default) the tracer is a strict no-op — `span()`
@@ -611,16 +612,25 @@ def set_tracing(flag: bool = True, ring_capacity: Optional[int] = None,
     training loop wrapped in `trace.step_span(i)` decomposes each
     step for `trace.export_chrome_trace(path)` (Perfetto-loadable),
     `trace.format_summary()`, and the `MetricsLogger` per-step JSONL.
+    The serving/fleet request path is pre-wired too: every fleet
+    request gets a trace context (`trace_id`) threaded through
+    routing, failover, the IPC boundary, and the worker dispatch —
+    `trace.merge_chrome_traces` folds N processes' spans into one
+    aligned timeline (see README "Fleet observability").
     NOTE: enabling adds a device sync per graph-mode step (the
     device_sync span needs a fence to mean anything) — leave it off
     for peak-throughput runs. `ring_capacity` resizes the span ring
     (default 16384 spans); `profile_dir` is where
-    `trace.profile_steps(n)` writes `jax.profiler` device traces.
+    `trace.profile_steps(n)` writes `jax.profiler` device traces;
+    `ship_capacity` bounds the cross-process span ship-back buffer a
+    fleet WORKER drains into reply/heartbeat frames (0 = off, the
+    default — overflow drops oldest, counted `ship_dropped`).
     Counters: `cache_stats()["trace"]`."""
     from . import trace
 
     trace.configure(enabled=flag, ring_capacity=ring_capacity,
-                    profile_dir=profile_dir)
+                    profile_dir=profile_dir,
+                    ship_capacity=ship_capacity)
 
 
 def set_export_cache(directory) -> None:

@@ -670,16 +670,21 @@ class FleetReply:
 
     __slots__ = ("_router", "_arrays", "_deadline_abs", "_inner",
                  "replica", "hops", "_tried", "_lock", "_state_lock",
-                 "_terminal", "_error", "t_submit", "t_reply")
+                 "_terminal", "_error", "t_submit", "t_reply", "trace")
 
     def __init__(self, router: "FleetRouter", arrays,
-                 deadline_abs: Optional[float], inner, replica: str):
+                 deadline_abs: Optional[float], inner, replica: str,
+                 trace: Optional[str] = None):
         self._router = router
         self._arrays = arrays
         self._deadline_abs = deadline_abs
         self._inner = inner
         self.replica = replica
         self.hops = 0
+        # trace_id born at submit (ISSUE 15): failover hops re-submit
+        # under the SAME id, so one request's spans — across replicas,
+        # across processes — stay one timeline
+        self.trace = trace
         self._tried = {replica}
         self._lock = threading.RLock()  # serializes failover work
         self._state_lock = threading.Lock()  # guards terminal counting
@@ -781,15 +786,17 @@ class FleetReply:
                     f"deadline passed during failover from "
                     f"{self.replica}: {err!r}")
         t0 = time.perf_counter()
-        inner, name = self._router._route_submit(
-            self._arrays, deadline_ms, exclude=set(self._tried),
-            failover=True)
+        with trace_mod.context(self.trace):
+            inner, name = self._router._route_submit(
+                self._arrays, deadline_ms, exclude=set(self._tried),
+                failover=True)
         self.hops += 1
         self._tried.add(name)
         self.replica = name
         self._inner = inner
         trace_mod.record_span("failover", t0, time.perf_counter(),
-                              hop=self.hops, to=name, error=repr(err))
+                              trace=self.trace, hop=self.hops,
+                              to=name, error=repr(err))
 
 
 # ---------------------------------------------------------------------------
@@ -913,6 +920,10 @@ class FleetRouter:
             except Exception:
                 pass
             slot.state = "stopped"
+        # final control-plane record: the TERMINAL counters (replies/
+        # failed resolve after routing, so the periodic route records
+        # undercount them) — what aggregate_fleet's availability reads
+        self._log_metrics("stop")
 
     def __enter__(self) -> "FleetRouter":
         return self.start()
@@ -952,10 +963,21 @@ class FleetRouter:
         deadline_abs = (None if deadline_ms is None
                         else time.perf_counter() + float(deadline_ms)
                         / 1e3)
+        # Trace context (ISSUE 15): born HERE, one per fleet request —
+        # unless the caller (submit_with_backoff's retry loop) already
+        # opened one, in which case the retried attempts share it.
+        # Strictly None while tracing is disabled: no id is generated,
+        # no span opens, no wire bytes are added downstream.
+        ctx = trace_mod.current_trace()
+        tid = (ctx["trace_id"] if ctx
+               else (trace_mod.new_trace_id() if trace_mod.enabled()
+                     else None))
         try:
-            inner, name = self._route_submit(arrays, deadline_ms,
-                                             exclude=set(),
-                                             failover=False)
+            with trace_mod.context(tid):
+                with trace_mod.span("submit", request=idx):
+                    inner, name = self._route_submit(
+                        arrays, deadline_ms, exclude=set(),
+                        failover=False)
         except BaseException:
             _STATS.rejected += 1
             raise
@@ -963,7 +985,8 @@ class FleetRouter:
         if (self.metrics_every
                 and idx % self.metrics_every == 0):
             self._log_metrics("route", replica=name)
-        return FleetReply(self, arrays, deadline_abs, inner, name)
+        return FleetReply(self, arrays, deadline_abs, inner, name,
+                          trace=tid)
 
     def infer(self, *arrays, timeout: Optional[float] = None,
               deadline_ms: Optional[float] = None):
@@ -1272,6 +1295,25 @@ class FleetRouter:
             seed=self._seed, salt=f"probe/{slot.name}")
 
     # -- observability ----------------------------------------------------
+    def export_trace(self, path: str) -> str:
+        """Write ONE merged Chrome/Perfetto timeline for the whole
+        fleet (ISSUE 15): the router's own span ring plus every
+        replica's shipped worker spans, each worker source shifted by
+        its estimated monotonic-clock offset (`trace_source`, proc
+        transport) so a single `trace_id`'s submit/route/ipc/dispatch
+        /reply spans nest correctly ACROSS pids. In-process replicas
+        need no source of their own — their spans already live in
+        this process's ring."""
+        import os as _os
+
+        sources = [{"records": trace_mod.records(),
+                    "pid": _os.getpid()}]
+        for slot in self._slots.values():
+            fn = getattr(slot.handle, "trace_source", None)
+            if fn is not None:
+                sources.extend(fn() or [])
+        return trace_mod.merge_chrome_traces(path, sources)
+
     def replica_snapshot(self) -> Dict[str, Dict]:
         out = {}
         for slot in self._slots.values():
@@ -1307,6 +1349,8 @@ class FleetRouter:
             m.log_step(
                 idx, event=event, states=states,
                 fleet_requests=_STATS.requests,
+                fleet_replies=_STATS.replies,
+                fleet_failed=_STATS.failed,
                 routed=_STATS.routed, failovers=_STATS.failovers,
                 refused=_STATS.refused, rejected=_STATS.rejected,
                 ejections=_STATS.ejections, rejoins=_STATS.rejoins,

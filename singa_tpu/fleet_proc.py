@@ -85,6 +85,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from . import export_cache
+from . import trace as trace_mod
 from .serve import (
     ServeClosedError,
     ServeDeadlineError,
@@ -106,6 +107,11 @@ __all__ = [
     "FrameReader",
     "encode_tree",
     "decode_tree",
+    "decode_tree_prefix",
+    "encode_req_payload",
+    "decode_req_payload",
+    "encode_trace_suffix",
+    "decode_trace_suffix",
     "encode_error",
     "decode_error",
     "resolve_factory",
@@ -139,6 +145,16 @@ _MAGIC = b"SF"
 _VERSION = 1
 _HDR = struct.Struct(">2sBBIQI")
 _MAX_PAYLOAD = 256 * 1024 * 1024  # structural sanity bound, not a knob
+# Parent-side shipped-span buffer bound (per replica) + the per-frame
+# piggyback bounds the worker drains into REP/HB/BYE frames. REPLY
+# frames carry spans only under ship-buffer PRESSURE (>= half full):
+# span bytes on the request path cost latency, so the steady-state
+# carrier is the heartbeat and the reply piggyback is the relief
+# valve that keeps drops bounded under bursts.
+_MAX_SHIPPED = 8192
+SPANS_PER_REP = 64
+SPANS_PER_HB = 256
+SPANS_PER_BYE = 2048
 
 # Frame types.
 HELLO = 1    # worker -> parent: {token, pid, name} (connection auth)
@@ -268,6 +284,15 @@ def decode_tree(buf: bytes):
     return node
 
 
+def decode_tree_prefix(buf: bytes, off: int = 0):
+    """Decode one tree starting at `off`, returning (node, end_off) —
+    for payloads that carry a structured suffix AFTER the tree (the
+    optional trace block on REQ frames). Callers that expect nothing
+    after the tree must check end_off themselves (`decode_tree` does
+    exactly that)."""
+    return _dec(buf, off, 0)
+
+
 def _dec(buf: bytes, off: int, depth: int):
     if depth > _MAX_DEPTH:
         raise FrameCorruptError("wire tree deeper than the codec bound")
@@ -309,6 +334,73 @@ def _dec(buf: bytes, off: int, depth: int):
                           dtype=np.dtype(dt)).reshape(shape)
         return a.copy(), off + rl
     raise FrameCorruptError(f"unknown wire tree tag {tag!r}")
+
+
+# ---------------------------------------------------------------------------
+# Trace context on the wire (ISSUE 15): an OPTIONAL suffix after the
+# REQ frame's tree — tag "T", trace-id length+bytes, and the parent
+# span id under which the worker's spans causally nest. STRICTLY
+# absent when tracing is disabled: a disabled-mode REQ payload is
+# byte-for-byte the pre-trace format, and the worker's ACK stays
+# empty (an ACK for a TRACED request carries one f64 — the worker's
+# perf_counter stamp the parent's clock-offset estimate needs).
+# ---------------------------------------------------------------------------
+def encode_trace_suffix(trace_id: str, parent=None) -> bytes:
+    tb = str(trace_id).encode("ascii")
+    if not tb or len(tb) > 255:
+        raise ValueError(f"trace id length {len(tb)} not in [1, 255]")
+    out = b"T" + struct.pack(">B", len(tb)) + tb
+    if parent is None:
+        return out + b"\x00"
+    return out + b"\x01" + struct.pack(">Q", int(parent))
+
+
+def decode_trace_suffix(buf: bytes, off: int):
+    """(trace_id, parent) from the optional suffix at `off`; (None,
+    None) when the payload ends there (untraced request). Anything
+    else is structural damage."""
+    if off == len(buf):
+        return None, None
+    if buf[off:off + 1] != b"T":
+        raise FrameCorruptError(
+            f"{len(buf) - off} trailing bytes after the tree that are "
+            "not a trace suffix: codec desync")
+    off += 1
+    (n,) = struct.unpack_from(">B", buf, off)
+    off += 1
+    tid = buf[off:off + n].decode("ascii")
+    off += n
+    (has_parent,) = struct.unpack_from(">B", buf, off)
+    off += 1
+    parent = None
+    if has_parent:
+        (parent,) = struct.unpack_from(">Q", buf, off)
+        off += 8
+    if off != len(buf):
+        raise FrameCorruptError(
+            f"{len(buf) - off} trailing bytes after the trace suffix")
+    return tid, parent
+
+
+def encode_req_payload(deadline_ms, batch, trace=None) -> bytes:
+    """One REQ payload: f64 deadline + encoded arrays (+ the trace
+    suffix IFF `trace` is given — `(trace_id, parent_span_id)`). The
+    zero-extra-wire-bytes contract lives here: trace=None produces
+    exactly the pre-trace byte layout."""
+    dl = -1.0 if deadline_ms is None else float(deadline_ms)
+    payload = struct.pack(">d", dl) + encode_tree(list(batch))
+    if trace is not None:
+        payload += encode_trace_suffix(trace[0], trace[1])
+    return payload
+
+
+def decode_req_payload(payload: bytes):
+    """(deadline_ms_or_None, arrays, trace_id, parent) — the worker
+    side of `encode_req_payload`."""
+    (dl,) = struct.unpack_from(">d", payload, 0)
+    arrays, off = decode_tree_prefix(payload, 8)
+    tid, parent = decode_trace_suffix(payload, off)
+    return (None if dl < 0 else dl), arrays, tid, parent
 
 
 # ---------------------------------------------------------------------------
@@ -376,7 +468,8 @@ _ERR_TERMINAL = {
 # ---------------------------------------------------------------------------
 class _Pending:
     __slots__ = ("reply", "gen", "acked", "ack_err", "ack_ev",
-                 "ipc_abs", "sweep_failed", "claimed")
+                 "ipc_abs", "sweep_failed", "claimed", "trace",
+                 "t_send")
 
     def __init__(self, reply: ServeReply, gen: int):
         self.reply = reply
@@ -386,6 +479,8 @@ class _Pending:
         self.ack_ev = threading.Event()
         self.ipc_abs: Optional[float] = None
         self.sweep_failed = False  # future failed, frame still owed
+        self.trace = None  # (trace_id, parent) on a traced request
+        self.t_send: Optional[float] = None  # REQ send perf_counter
         # One-terminal arbiter for UN-ADMITTED requests: the
         # submit()-timeout path, the reader's ERR-refusal path, and
         # the death sweep can all race to mirror this request's
@@ -412,7 +507,8 @@ class _Gen:
     parent-side ledger is the authoritative one."""
 
     __slots__ = ("admitted", "frames", "swept", "ack_errs",
-                 "handshake", "clean", "exit_code", "pid")
+                 "handshake", "clean", "exit_code", "pid",
+                 "clock_offset_us", "clock_rtt_s", "clock_wall_us")
 
     def __init__(self, pid: int):
         self.admitted = 0
@@ -423,6 +519,22 @@ class _Gen:
         self.clean = False
         self.exit_code: Optional[int] = None
         self.pid = pid
+        # monotonic-clock alignment (ISSUE 15): worker perf_counter +
+        # offset = parent perf_counter. Primary estimate from the
+        # REQ->ACK handshake (midpoint minus the worker's ACK stamp;
+        # the smallest-RTT sample wins — classic NTP discipline);
+        # fallback from the heartbeat's (wall, mono) pair when no
+        # traced request has round-tripped this generation yet.
+        self.clock_offset_us: Optional[float] = None
+        self.clock_rtt_s: Optional[float] = None
+        self.clock_wall_us: Optional[float] = None
+
+    def offset_us(self) -> float:
+        if self.clock_offset_us is not None:
+            return self.clock_offset_us
+        if self.clock_wall_us is not None:
+            return self.clock_wall_us
+        return 0.0
 
 
 def _repo_root() -> str:
@@ -550,6 +662,18 @@ class ProcReplica:
         self.torn_frames_detected = 0
         self.ipc_timeouts = 0
         self.hb_received = 0
+        # shipped worker spans (ISSUE 15): raw worker-clock records
+        # piggybacked on REP/HB/BYE frames, kept per generation for
+        # `trace_source()` to hand `trace.merge_chrome_traces` with
+        # that generation's clock offset. Bounded deque; overflow
+        # drops the OLDEST and counts it (O(1) — a list.pop(0) here
+        # would memmove 8k entries under _plock on the reader's hot
+        # path once full).
+        from collections import deque
+
+        self._shipped: "deque" = deque()
+        self.spans_received = 0
+        self.spans_dropped = 0
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> "ProcReplica":
@@ -569,6 +693,16 @@ class ProcReplica:
             spec["port"] = port
             spec["token"] = token
             spec["heartbeat_interval_s"] = self.heartbeat_interval_s
+            if trace_mod.enabled():
+                # arm the worker's tracer + span ship-back at spawn —
+                # and at every supervisor RESPAWN, since restart()
+                # re-enters here: a new generation keeps propagating
+                # the same trace contexts. (An explicit spec "trace"
+                # wins — tests pin tiny ship buffers through it.)
+                spec.setdefault("trace", {
+                    "enabled": True, "ship_capacity": 2048,
+                    "ring_capacity":
+                        trace_mod.get_config()["ring_capacity"]})
             if "export_cache" not in spec:
                 # inherit the parent's armed store: the populate-
                 # once-start-N contract — a respawned worker
@@ -807,8 +941,18 @@ class ProcReplica:
             ent = _Pending(reply, self._gen)
             self._pending[rid] = ent
         note_remote_request()
-        dl = -1.0 if deadline_ms is None else float(deadline_ms)
-        payload = struct.pack(">d", dl) + encode_tree(list(batch))
+        # Trace context crosses the boundary as an OPTIONAL suffix:
+        # with tracing off there is no context and the payload is
+        # byte-for-byte the untraced format — zero extra wire bytes.
+        trace = None
+        if trace_mod.enabled():
+            ctx = trace_mod.current_trace()
+            if ctx is not None:
+                trace = (ctx["trace_id"],
+                         trace_mod.current_span_id() or ctx["parent"])
+        ent.trace = trace
+        payload = encode_req_payload(deadline_ms, batch, trace=trace)
+        ent.t_send = time.perf_counter()
         try:
             self._send(REQ, rid, payload)
         except ServeClosedError:
@@ -937,7 +1081,10 @@ class ProcReplica:
                 g: {"admitted": gen.admitted, "frames": gen.frames,
                     "swept": gen.swept, "ack_errs": gen.ack_errs,
                     "clean": gen.clean, "exit_code": gen.exit_code,
-                    "handshake": gen.handshake}
+                    "handshake": gen.handshake,
+                    "pid": gen.pid,
+                    "clock_offset_us": gen.clock_offset_us,
+                    "clock_rtt_s": gen.clock_rtt_s}
                 for g, gen in self._gens.items()}
             return {
                 "sent": self.sent,
@@ -948,6 +1095,8 @@ class ProcReplica:
                 "torn_frames_detected": self.torn_frames_detected,
                 "pending": len(self._pending),
                 "heartbeats": self.hb_received,
+                "spans_received": self.spans_received,
+                "spans_dropped": self.spans_dropped,
                 "generations": gens,
             }
 
@@ -1027,12 +1176,28 @@ class ProcReplica:
                       gen: int) -> None:
         g = self._gens[gen]
         if ftype == ACK:
+            t_recv = time.perf_counter()
             with self._plock:
                 ent = self._pending.get(rid)
                 if ent is None:
                     return
                 ent.acked = True
                 g.admitted += 1
+            if len(payload) == 8 and ent.t_send is not None:
+                # traced ACK: the worker stamped its perf_counter —
+                # midpoint-minus-stamp is the clock offset, and the
+                # smallest-RTT handshake gives the tightest estimate
+                (t_w,) = struct.unpack(">d", payload)
+                rtt = t_recv - ent.t_send
+                if g.clock_rtt_s is None or rtt < g.clock_rtt_s:
+                    g.clock_rtt_s = rtt
+                    g.clock_offset_us = (
+                        (ent.t_send + t_recv) / 2.0 - t_w) * 1e6
+                if ent.trace is not None:
+                    # the IPC transit leg of this request's timeline
+                    trace_mod.record_span(
+                        "ipc", ent.t_send, t_recv, trace=ent.trace,
+                        replica=self.name)
             ent.ack_ev.set()
         elif ftype == REP:
             with self._plock:
@@ -1042,8 +1207,20 @@ class ProcReplica:
             if ent is None:
                 return
             try:
-                late = bool(payload[0] & 1)
-                value = decode_tree(payload[1:])
+                flags = payload[0]
+                late = bool(flags & 1)
+                value, off = decode_tree_prefix(payload, 1)
+                if flags & 2:
+                    # piggybacked worker spans (bounded per frame)
+                    (sn,) = struct.unpack_from(">I", payload, off)
+                    off += 4
+                    self._note_shipped(gen, json.loads(
+                        payload[off:off + sn].decode("utf-8")))
+                    off += sn
+                if off != len(payload):
+                    raise FrameCorruptError(
+                        f"{len(payload) - off} trailing bytes after "
+                        "the reply tree: codec desync")
             except Exception as e:
                 # CRC passed but the payload does not decode (codec
                 # desync / version skew): the entry is already popped,
@@ -1098,8 +1275,20 @@ class ProcReplica:
                 note_remote_terminal(_ERR_TERMINAL.get(
                     d.get("kind", "dispatch"), "failed"))
         elif ftype == HB:
-            self._hb = json.loads(payload.decode("utf-8"))
-            self._hb_rx = time.perf_counter()
+            t_rx = time.perf_counter()
+            hb = json.loads(payload.decode("utf-8"))
+            spans = hb.pop("spans", None)
+            if spans:
+                self._note_shipped(gen, spans)
+            clock = hb.get("clock")
+            if clock and g.clock_wall_us is None:
+                # wall-clock fallback offset (same host, so the wall
+                # clocks agree): parent-mono-at-send ~= t_rx adjusted
+                # by the wall delta; only the ACK handshake refines it
+                g.clock_wall_us = ((clock["wall"] - time.time() + t_rx)
+                                   - clock["mono"]) * 1e6
+            self._hb = hb
+            self._hb_rx = t_rx
             self.hb_received += 1
         elif ftype == CTRL_OK:
             with self._plock:
@@ -1109,8 +1298,47 @@ class ProcReplica:
                     payload.decode("utf-8"))
                 waiter["ev"].set()
         elif ftype == BYE:
-            g.handshake = json.loads(payload.decode("utf-8"))
+            bye = json.loads(payload.decode("utf-8"))
+            spans = bye.pop("spans", None)
+            if spans:
+                self._note_shipped(gen, spans)
+            g.handshake = bye
             g.clean = True
+
+    def _note_shipped(self, gen: int, spans) -> None:
+        """Buffer shipped worker spans (bounded — overflow drops the
+        OLDEST, counted `spans_dropped`, never an unbounded list)."""
+        with self._plock:
+            for rec in spans:
+                if not isinstance(rec, dict) or "name" not in rec:
+                    continue
+                if len(self._shipped) >= _MAX_SHIPPED:
+                    self._shipped.popleft()
+                    self.spans_dropped += 1
+                self._shipped.append((gen, rec))
+                self.spans_received += 1
+
+    def trace_source(self):
+        """Span sources for `trace.merge_chrome_traces`: one per
+        worker GENERATION that shipped spans, each carrying that
+        generation's pid and estimated clock offset — a respawned
+        worker is a new process with a new `perf_counter` origin, so
+        its spans need their own shift."""
+        with self._plock:
+            by_gen: Dict[int, List[Dict]] = {}
+            for gnum, rec in self._shipped:
+                by_gen.setdefault(gnum, []).append(rec)
+        out = []
+        for gnum, recs in sorted(by_gen.items()):
+            g = self._gens.get(gnum)
+            out.append({
+                "records": recs,
+                "pid": None if g is None else g.pid,
+                "offset_us": 0.0 if g is None else g.offset_us(),
+                "replica": self.name,
+                "gen": gnum,
+            })
+        return out
 
     def _sweep_deadlines(self) -> None:
         now = time.perf_counter()

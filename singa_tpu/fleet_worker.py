@@ -70,6 +70,21 @@ def main() -> int:
     if spec.get("buckets"):
         device.set_shape_buckets(**spec["buckets"])
 
+    def arm_tracing(ship_capacity=2048, ring_capacity=None):
+        """Worker tracer + span ship-back: completed spans carrying a
+        trace context are drained (bounded per frame) onto REP/HB/BYE
+        frames for the parent's merged timeline. Overflow of the
+        bounded ship buffer drops oldest, counted — frames never grow
+        unboundedly."""
+        trace_mod.configure(enabled=True,
+                            ship_capacity=int(ship_capacity),
+                            ring_capacity=ring_capacity)
+
+    tr_spec = spec.get("trace") or {}
+    if tr_spec.get("enabled"):
+        arm_tracing(tr_spec.get("ship_capacity", 2048),
+                    tr_spec.get("ring_capacity"))
+
     factory = wire.resolve_factory(spec)
     t0 = time.perf_counter()
     model = factory(**(spec.get("factory_kwargs") or {}))
@@ -113,7 +128,7 @@ def main() -> int:
 
     def counters_payload():
         s = stats.cache_stats()
-        return {
+        out = {
             "terminal": serve.terminal_counters(),
             "poisoned": s["serve"]["poisoned"],
             "late": s["serve"]["late"],
@@ -122,6 +137,12 @@ def main() -> int:
                        "misses": s["export"]["misses"]},
             "pid": os.getpid(),
         }
+        if trace_mod.enabled():
+            t = s["trace"]
+            out["trace"] = {"spans": t["spans"],
+                            "shipped": t["shipped"],
+                            "ship_dropped": t["ship_dropped"]}
+        return out
 
     def send_hb():
         snap = engine.health()
@@ -131,7 +152,18 @@ def main() -> int:
         hb["health"] = snap
         hb["retry_after_ms"] = engine._estimate_retry_after_ms(
             engine._depth)
-        send(wire.HB, 0, json.dumps(hb).encode("utf-8"))
+        if trace_mod.enabled():
+            # (wall, mono) pair: the parent's fallback clock-offset
+            # estimate; completed trace-stamped spans piggyback here
+            # so even a request-quiet worker keeps shipping. Both
+            # keys exist ONLY while tracing is armed — a disabled
+            # fleet's heartbeats are byte-identical to pre-trace.
+            hb["clock"] = {"mono": time.perf_counter(),
+                           "wall": time.time()}
+            spans = trace_mod.drain_shipped(wire.SPANS_PER_HB)
+            if spans:
+                hb["spans"] = spans
+        send(wire.HB, 0, json.dumps(hb, default=str).encode("utf-8"))
 
     def heartbeat_loop():
         interval = float(spec.get("heartbeat_interval_s", 0.25))
@@ -165,9 +197,24 @@ def main() -> int:
                             continue
                     try:
                         val = reply.result(0.0)
-                        payload = bytes([1 if reply.deadline_exceeded
-                                         else 0])
+                        flags = 1 if reply.deadline_exceeded else 0
+                        # piggyback trace spans ONLY under ship-buffer
+                        # pressure (heartbeats are the steady-state
+                        # carrier — span bytes here are request-path
+                        # latency); an untraced run drains nothing and
+                        # the flag bit stays 0 — byte-identical to the
+                        # pre-trace REP layout
+                        pending, cap = trace_mod.ship_backlog()
+                        spans = (trace_mod.drain_shipped(
+                            wire.SPANS_PER_REP)
+                            if cap and pending >= cap // 2 else [])
+                        if spans:
+                            flags |= 2
+                        payload = bytes([flags])
                         payload += wire.encode_tree(val)
+                        if spans:
+                            sb = json.dumps(spans, default=str).encode("utf-8")
+                            payload += struct.pack(">I", len(sb)) + sb
                         send(wire.REP, rid, payload, rep_frame=True)
                     except BaseException as e:  # noqa: BLE001 — wire
                         send(wire.ERR, rid, json.dumps(
@@ -237,20 +284,29 @@ def main() -> int:
                 return 0
             for ftype, rid, payload in reader.feed(chunk):
                 if ftype == wire.REQ:
-                    (dl,) = struct.unpack_from(">d", payload, 0)
-                    arrays = wire.decode_tree(payload[8:])
+                    dl, arrays, tid, parent = \
+                        wire.decode_req_payload(payload)
+                    if tid is not None and not trace_mod.enabled():
+                        # parent enabled tracing after this worker
+                        # spawned: a traced REQ arms it lazily
+                        arm_tracing()
                     try:
-                        reply = engine.submit(
-                            *arrays,
-                            deadline_ms=None if dl < 0 else dl)
+                        with trace_mod.context(tid, parent):
+                            reply = engine.submit(*arrays,
+                                                  deadline_ms=dl)
                     except BaseException as e:  # noqa: BLE001
                         send(wire.ERR, rid, json.dumps(
                             wire.encode_error(e)).encode("utf-8"))
                         continue
                     # ACK strictly before the outbox registration:
                     # the waiter can then never put a REP on the wire
-                    # ahead of its ACK
-                    send(wire.ACK, rid, b"")
+                    # ahead of its ACK. A TRACED request's ACK carries
+                    # the worker perf_counter stamp (8 bytes) the
+                    # parent's clock-offset estimate reads; an
+                    # untraced ACK stays empty — zero added bytes.
+                    send(wire.ACK, rid,
+                         b"" if tid is None
+                         else struct.pack(">d", time.perf_counter()))
                     with outbox_lock:
                         outbox.append((rid, reply))
                 elif ftype == wire.WARM:
@@ -283,8 +339,13 @@ def main() -> int:
     if metrics is not None:
         metrics.close()
     try:
-        send(wire.BYE, 0,
-             json.dumps(counters_payload()).encode("utf-8"))
+        bye = counters_payload()
+        spans = trace_mod.drain_shipped(wire.SPANS_PER_BYE)
+        if spans:
+            # last chance for still-buffered spans to reach the
+            # parent's merged timeline before a clean exit
+            bye["spans"] = spans
+        send(wire.BYE, 0, json.dumps(bye, default=str).encode("utf-8"))
         sock.close()
     except OSError:
         pass

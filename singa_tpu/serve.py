@@ -539,16 +539,21 @@ class ServeReply:
 
 class _Request:
     __slots__ = ("arrays", "n", "sig", "reply", "t_enqueue",
-                 "deadline", "poison")
+                 "deadline", "poison", "trace")
 
     def __init__(self, arrays: List[np.ndarray], n: int, sig, reply,
-                 deadline: Optional[float] = None):
+                 deadline: Optional[float] = None, trace=None):
         self.arrays = arrays
         self.n = n
         self.sig = sig
         self.reply = reply
         self.deadline = deadline  # absolute perf_counter time, or None
         self.poison = False  # set by the chaos harness only
+        # (trace_id, parent_span_id) inherited from the submitter's
+        # trace context (ISSUE 15) — the dispatcher thread stamps this
+        # request's spans with it, since the context itself is
+        # thread-local to the submitter
+        self.trace = trace
         self.t_enqueue = time.perf_counter()
 
 
@@ -923,7 +928,16 @@ class ServingEngine:
         reply = ServeReply(n)
         deadline = (None if dl is None
                     else time.perf_counter() + float(dl) / 1e3)
-        req = _Request(batch, n, sig, reply, deadline=deadline)
+        # Inherit the submitter's trace context (strict None when
+        # tracing is off): the parent span is the innermost OPEN span
+        # on the submitting thread (the router's `route` span) so the
+        # dispatcher-side spans nest under it in the merged timeline.
+        ctx = trace_mod.current_trace()
+        req_trace = (None if ctx is None else
+                     (ctx["trace_id"],
+                      trace_mod.current_span_id() or ctx["parent"]))
+        req = _Request(batch, n, sig, reply, deadline=deadline,
+                       trace=req_trace)
         inj = self.fault_injector
         if inj is not None:
             # keyed by the per-ENGINE submit ordinal (1-based), so a
@@ -1168,7 +1182,7 @@ class ServingEngine:
                 continue
             live.append(r)
             trace_mod.record_span("queue_wait", r.t_enqueue, t_deq,
-                                  rows=r.n)
+                                  trace=r.trace, rows=r.n)
         if not live:
             return
         with self._lock:
@@ -1277,27 +1291,35 @@ class ServingEngine:
         self._attempt_idx += 1
         self._chaos_attempt(group)
         t_dispatch0 = time.perf_counter()
-        with trace_mod.span("batch_assemble", requests=len(group),
-                            rows=rows):
-            if len(group) == 1:
-                batch = list(group[0].arrays)
-            else:
-                batch = [np.concatenate([g.arrays[i]
-                                         for g in group])
-                         for i in range(len(group[0].arrays))]
-            padded, info = export_cache.pad_batch_to_bucket(
-                batch, self.policy)
-            n_bucket = info["n_bucket"]
-            dev = self._device()
-            tensors = [tensor_mod.from_numpy(np.ascontiguousarray(a),
-                                             device=dev)
-                       for a in padded]
-        t0 = time.perf_counter()
-        with trace_mod.span("dispatch", bucket=n_bucket):
-            out = self.model._ensure_forward_exec()(*tensors)
-        with trace_mod.span("reply", requests=len(group)):
-            host = self._to_host(out, info)
-            delivered = self._scatter(group, host, rows)
+        # The dispatch-level spans inherit the FIRST traced member's
+        # context (a coalesced group can carry many trace ids — the
+        # rest are listed on the batch_assemble span so no request's
+        # timeline loses the dispatch it rode in).
+        traced = [r.trace for r in group if r.trace]
+        tids = sorted({t[0] for t in traced})
+        targs = {"traces": tids} if len(tids) > 1 else {}
+        with trace_mod.context(*(traced[0] if traced else (None,))):
+            with trace_mod.span("batch_assemble", requests=len(group),
+                                rows=rows, **targs):
+                if len(group) == 1:
+                    batch = list(group[0].arrays)
+                else:
+                    batch = [np.concatenate([g.arrays[i]
+                                             for g in group])
+                             for i in range(len(group[0].arrays))]
+                padded, info = export_cache.pad_batch_to_bucket(
+                    batch, self.policy)
+                n_bucket = info["n_bucket"]
+                dev = self._device()
+                tensors = [tensor_mod.from_numpy(
+                    np.ascontiguousarray(a), device=dev)
+                    for a in padded]
+            t0 = time.perf_counter()
+            with trace_mod.span("dispatch", bucket=n_bucket):
+                out = self.model._ensure_forward_exec()(*tensors)
+            with trace_mod.span("reply", requests=len(group)):
+                host = self._to_host(out, info)
+                delivered = self._scatter(group, host, rows)
         dispatch_s = time.perf_counter() - t0
         self._dispatch_idx += 1
         # Rolling dispatch time (attempt start -> replies out) feeds
@@ -1513,14 +1535,25 @@ def submit_with_backoff(submit, *arrays, deadline_ms: Optional[float]
     `ServeOverloadError` propagates; every other error propagates
     immediately (a queue-full drop or overflow carries no retry
     hint). `submit` is any callable with the `ServingEngine.submit` /
-    `FleetRouter.submit` signature; returns whatever it returns."""
+    `FleetRouter.submit` signature; returns whatever it returns.
+
+    Tracing (ISSUE 15): with the tracer on, ONE trace context spans
+    every attempt — the request that finally lands carries the same
+    `trace_id` its shed-and-retried earlier attempts did, and each
+    hinted wait is a `shed_backoff` span on that timeline. Strict
+    no-op while tracing is disabled."""
     from . import resilience
 
+    ctx = trace_mod.current_trace()
+    tid = (ctx["trace_id"] if ctx
+           else (trace_mod.new_trace_id() if trace_mod.enabled()
+                 else None))
     attempt = 0
     while True:
         attempt += 1
         try:
-            return submit(*arrays, deadline_ms=deadline_ms)
+            with trace_mod.context(tid):
+                return submit(*arrays, deadline_ms=deadline_ms)
         except ServeOverloadError as e:
             if attempt >= int(max_attempts):
                 raise
@@ -1530,7 +1563,11 @@ def submit_with_backoff(submit, *arrays, deadline_ms: Optional[float]
             delay = resilience.backoff_delay_s(
                 attempt, max(e.retry_after_ms, 1.0) / 1e3,
                 jitter=0.5, seed=int(seed), salt="client-shed")
+            t0 = time.perf_counter()
             time.sleep(min(delay, float(max_sleep_s)))
+            trace_mod.record_span(
+                "shed_backoff", t0, time.perf_counter(), trace=tid,
+                attempt=attempt, retry_after_ms=e.retry_after_ms)
 
 
 # ---------------------------------------------------------------------------

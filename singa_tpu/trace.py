@@ -62,15 +62,26 @@ __all__ = [
     "clear",
     "last_step_timings",
     "export_chrome_trace",
+    "merge_chrome_traces",
+    "aggregate_fleet",
     "span_summary",
     "format_summary",
     "profile_steps",
+    "new_trace_id",
+    "context",
+    "current_trace",
+    "current_span_id",
+    "drain_shipped",
     "MetricsLogger",
     "read_metrics",
     "default_metrics_path",
 ]
 
-SCHEMA_VERSION = 1
+# v2 (ISSUE 15): records additionally carry the writer `pid` and a
+# `mono` perf_counter stamp paired with the wall-clock `time`, so
+# multi-process logs are time-alignable offline. Additive only —
+# `read_metrics` parses v1 and v2 records alike.
+SCHEMA_VERSION = 2
 
 _LOCK = threading.RLock()
 _ENABLED = False
@@ -80,11 +91,20 @@ _TLS = threading.local()
 _PROFILE: Optional[Dict] = None
 _PROFILE_DIR = "/tmp/singa_tpu_profile"
 _LAST_STEP: Optional[Dict] = None
+# Cross-process span ship-back (ISSUE 15): spans carrying a trace
+# context are ALSO buffered here when a capacity is armed
+# (`configure(ship_capacity=n)`), for a transport to drain and ship to
+# the parent process in bounded chunks. 0 = off (the default — only
+# fleet workers arm it).
+_SHIP: deque = deque()
+_SHIP_CAP = 0
 
 
 class _TraceStats:
     """cache_stats()["trace"]: spans recorded / dropped by the ring /
-    step spans closed / chrome exports written. reset() zeroes the
+    step spans closed / chrome exports written / ship-back buffer
+    accounting (buffered spans drained for cross-process shipping,
+    drops when the bounded buffer overflows). reset() zeroes the
     counters; the ring itself is cleared only by `trace.clear()`."""
 
     def __init__(self):
@@ -95,6 +115,8 @@ class _TraceStats:
         self.dropped = 0
         self.steps = 0
         self.exports = 0
+        self.shipped = 0
+        self.ship_dropped = 0
 
     def snapshot(self) -> Dict:
         return {
@@ -103,6 +125,9 @@ class _TraceStats:
             "dropped": self.dropped,
             "steps": self.steps,
             "exports": self.exports,
+            "shipped": self.shipped,
+            "ship_dropped": self.ship_dropped,
+            "ship_pending": len(_SHIP),
             "ring_size": len(_RING),
             "ring_capacity": _RING.maxlen,
         }
@@ -118,8 +143,9 @@ stats_mod.register_cache("trace", _STATS)
 # ---------------------------------------------------------------------------
 def configure(enabled: Optional[bool] = None,
               ring_capacity: Optional[int] = None,
-              profile_dir: Optional[str] = None) -> Dict:
-    global _ENABLED, _RING, _PROFILE_DIR
+              profile_dir: Optional[str] = None,
+              ship_capacity: Optional[int] = None) -> Dict:
+    global _ENABLED, _RING, _PROFILE_DIR, _SHIP_CAP
     with _LOCK:
         if ring_capacity is not None:
             cap = int(ring_capacity)
@@ -129,6 +155,13 @@ def configure(enabled: Optional[bool] = None,
                 _RING = deque(_RING, maxlen=cap)
         if profile_dir is not None:
             _PROFILE_DIR = str(profile_dir)
+        if ship_capacity is not None:
+            cap = int(ship_capacity)
+            if cap < 0:
+                raise ValueError("ship_capacity must be >= 0 (0=off)")
+            _SHIP_CAP = cap
+            if cap == 0:
+                _SHIP.clear()
         if enabled is not None:
             _ENABLED = bool(enabled)
     return get_config()
@@ -136,7 +169,7 @@ def configure(enabled: Optional[bool] = None,
 
 def get_config() -> Dict:
     return {"enabled": _ENABLED, "ring_capacity": _RING.maxlen,
-            "profile_dir": _PROFILE_DIR}
+            "profile_dir": _PROFILE_DIR, "ship_capacity": _SHIP_CAP}
 
 
 def enabled() -> bool:
@@ -151,6 +184,137 @@ def _stack() -> list:
     if st is None:
         st = _TLS.stack = []
     return st
+
+
+def _ctx_stack() -> list:
+    st = getattr(_TLS, "trace_stack", None)
+    if st is None:
+        st = _TLS.trace_stack = []
+    return st
+
+
+# ---------------------------------------------------------------------------
+# Trace context (ISSUE 15): one request = one trace_id, born at the
+# fleet router's submit and threaded through failover hops, client
+# retries, and the process boundary, so every span a request touches —
+# in any thread, in any PROCESS — carries the same id and the merged
+# timeline can answer "where did this p99 request spend its time".
+# ---------------------------------------------------------------------------
+def new_trace_id() -> str:
+    """A fresh 16-hex-char trace id, unique across processes."""
+    import binascii
+
+    return binascii.hexlify(os.urandom(8)).decode("ascii")
+
+
+class _TraceCtx:
+    """Thread-local trace-context frame: spans opened (or recorded via
+    `record_span`) while it is active carry `trace` = the trace id;
+    top-level spans additionally carry `remote_parent` — the span id
+    in the ORIGINATING process under which they causally nest."""
+
+    __slots__ = ("trace_id", "parent")
+
+    def __init__(self, trace_id: str, parent):
+        self.trace_id = trace_id
+        self.parent = parent
+
+    def __enter__(self):
+        _ctx_stack().append(self)
+        return self
+
+    def __exit__(self, *exc):
+        st = _ctx_stack()
+        if st and st[-1] is self:
+            st.pop()
+        else:  # mismatched teardown: best-effort
+            try:
+                st.remove(self)
+            except ValueError:
+                pass
+        return False
+
+
+def context(trace_id: Optional[str] = None, parent=None):
+    """Activate a trace context for the calling thread. With tracing
+    disabled (or no id) this is the shared null context — strict
+    no-op, nothing allocates, nothing propagates."""
+    if not _ENABLED or trace_id is None:
+        return _NULL
+    return _TraceCtx(str(trace_id),
+                     None if parent is None else int(parent))
+
+
+def current_trace() -> Optional[Dict]:
+    """The active trace context: {"trace_id", "parent"} or None."""
+    st = getattr(_TLS, "trace_stack", None)
+    if not st:
+        return None
+    c = st[-1]
+    return {"trace_id": c.trace_id, "parent": c.parent}
+
+
+def current_span_id() -> Optional[int]:
+    """Id of the innermost OPEN span on this thread (the natural
+    parent for work handed to another thread/process), or None."""
+    st = getattr(_TLS, "stack", None)
+    return st[-1].id if st else None
+
+
+def _normalize_trace(trace):
+    """(trace_id, parent) from a str / (id, parent) tuple / context
+    dict / None."""
+    if trace is None:
+        return None, None
+    if isinstance(trace, str):
+        return trace, None
+    if isinstance(trace, dict):
+        return trace.get("trace_id"), trace.get("parent")
+    tid = trace[0]
+    parent = trace[1] if len(trace) > 1 else None
+    return (None if tid is None else str(tid)), parent
+
+
+def _ship(rec: Dict) -> None:
+    """Buffer a trace-stamped span for cross-process ship-back.
+    Bounded: overflow drops the OLDEST span and counts it — frames
+    stay bounded, memory stays bounded, the loss is loud in
+    `cache_stats()["trace"]["ship_dropped"]`. Only the fields the
+    merged timeline needs are copied (wire bytes are request-path
+    cost). Caller holds _LOCK."""
+    if _SHIP_CAP <= 0:
+        return
+    if len(_SHIP) >= _SHIP_CAP:
+        _SHIP.popleft()
+        _STATS.ship_dropped += 1
+    slim = {"name": rec["name"], "ts": rec["ts"], "dur": rec["dur"],
+            "tid": rec["tid"], "trace": rec["trace"]}
+    if rec.get("remote_parent") is not None:
+        slim["remote_parent"] = rec["remote_parent"]
+    if rec.get("args"):
+        slim["args"] = rec["args"]
+    _SHIP.append(slim)
+
+
+def ship_backlog() -> tuple:
+    """(buffered, capacity) of the ship-back buffer — transports use
+    the pressure signal to decide whether to piggyback spans on a
+    REPLY frame (request-path bytes, spent only when heartbeats are
+    not keeping up) or leave them for the next heartbeat."""
+    return len(_SHIP), _SHIP_CAP
+
+
+def drain_shipped(max_n: int) -> List[Dict]:
+    """Pop up to `max_n` buffered spans for shipping (oldest first).
+    The per-call bound is the per-FRAME bound: a reply or heartbeat
+    frame carries at most this many piggybacked spans, never an
+    unbounded backlog."""
+    out: List[Dict] = []
+    with _LOCK:
+        while _SHIP and len(out) < int(max_n):
+            out.append(_SHIP.popleft())
+        _STATS.shipped += len(out)
+    return out
 
 
 class _NullSpan:
@@ -209,6 +373,12 @@ class _Span:
         }
         if self.args:
             rec["args"] = self.args
+        ctx = getattr(_TLS, "trace_stack", None)
+        if ctx:
+            c = ctx[-1]
+            rec["trace"] = c.trace_id
+            if self.parent is None and c.parent is not None:
+                rec["remote_parent"] = c.parent
         with _LOCK:
             if not _ENABLED:
                 return False  # disabled mid-span: drop silently
@@ -216,6 +386,8 @@ class _Span:
                 _STATS.dropped += 1
             _RING.append(rec)
             _STATS.spans += 1
+            if "trace" in rec:
+                _ship(rec)
             if frame is not None and self.name != "step":
                 acc = frame["acc"]
                 acc[self.name] = acc.get(self.name, 0.0) + (t1 - self.t0)
@@ -232,7 +404,8 @@ def span(name: str, **args):
     return _Span(name, args or None)
 
 
-def record_span(name: str, t0: float, t1: float, **args) -> None:
+def record_span(name: str, t0: float, t1: float, trace=None,
+                **args) -> None:
     """Record an already-measured span from explicit `perf_counter`
     endpoints. The context-manager `span()` times work on ONE thread;
     a latency that starts on one thread and ends on another — a
@@ -241,7 +414,10 @@ def record_span(name: str, t0: float, t1: float, **args) -> None:
     the fact. Same ring, same drop accounting, same strict no-op while
     tracing is disabled. Top-level by construction (no parent): the
     two endpoint threads have different span stacks, so nesting is
-    undefined."""
+    undefined. `trace` attaches a trace context explicitly — a str
+    trace id or a (trace_id, parent_span_id) pair — for spans whose
+    owning request lives on another thread; None falls back to the
+    calling thread's active context."""
     if not _ENABLED:
         return
     rec = {
@@ -254,6 +430,15 @@ def record_span(name: str, t0: float, t1: float, **args) -> None:
         "depth": 0,
         "step": None,
     }
+    tid, parent = _normalize_trace(trace)
+    if tid is None:
+        ctx = current_trace()
+        if ctx is not None:
+            tid, parent = ctx["trace_id"], ctx["parent"]
+    if tid is not None:
+        rec["trace"] = tid
+        if parent is not None:
+            rec["remote_parent"] = parent
     if args:
         rec["args"] = args
     with _LOCK:
@@ -263,6 +448,8 @@ def record_span(name: str, t0: float, t1: float, **args) -> None:
             _STATS.dropped += 1
         _RING.append(rec)
         _STATS.spans += 1
+        if "trace" in rec:
+            _ship(rec)
 
 
 class _StepCtx:
@@ -329,11 +516,13 @@ def records() -> List[Dict]:
 
 
 def clear() -> None:
-    """Drop all recorded spans and the last-step summary (counters
-    survive; use `reset_cache_stats()` for those)."""
+    """Drop all recorded spans, the ship-back buffer, and the
+    last-step summary (counters survive; use `reset_cache_stats()`
+    for those)."""
     global _LAST_STEP
     with _LOCK:
         _RING.clear()
+        _SHIP.clear()
         _LAST_STEP = None
 
 
@@ -356,26 +545,77 @@ def export_chrome_trace(path: str) -> str:
     pid = os.getpid()
     with _LOCK:
         recs = list(_RING)
-    events = []
-    for r in recs:
-        ev = {"name": r["name"], "ph": "X", "cat": "singa_tpu",
-              "ts": round(r["ts"], 3), "dur": round(r["dur"], 3),
-              "pid": pid, "tid": r["tid"]}
-        args = dict(r.get("args") or {})
-        if r.get("step") is not None:
-            args["step"] = r["step"]
-        if args:
-            ev["args"] = args
-        events.append(ev)
-    events.sort(key=lambda e: e["ts"])
+    events = [_chrome_event(r, pid, 0.0) for r in recs]
+    return _write_chrome(path, events)
+
+
+def _chrome_event(r: Dict, default_pid: int, offset_us: float) -> Dict:
+    """One ring record (or an already-chrome event) as a Chrome
+    trace-event, with `offset_us` added to its timestamp — the clock
+    alignment hook `merge_chrome_traces` applies per source."""
+    ev = {"name": r["name"], "ph": r.get("ph", "X"),
+          "cat": r.get("cat", "singa_tpu"),
+          "ts": round(float(r["ts"]) + offset_us, 3),
+          "dur": round(float(r.get("dur", 0.0)), 3),
+          "pid": r.get("pid", default_pid), "tid": r.get("tid", 0)}
+    args = dict(r.get("args") or {})
+    for k in ("step", "trace", "remote_parent"):
+        if r.get(k) is not None:
+            args[k] = r[k]
+    if args:
+        ev["args"] = args
+    return ev
+
+
+def _write_chrome(path: str, events: List[Dict]) -> str:
+    events.sort(key=lambda e: (e["ts"], -e.get("dur", 0.0)))
     payload = {"traceEvents": events, "displayTimeUnit": "ms"}
-    tmp = f"{path}.tmp.{pid}"
+    tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "w", encoding="utf-8") as f:
         json.dump(payload, f)
     os.replace(tmp, path)
     with _LOCK:
         _STATS.exports += 1
     return path
+
+
+def merge_chrome_traces(path: str, sources) -> str:
+    """Merge span records from MANY processes into ONE Chrome/Perfetto
+    timeline (ISSUE 15). Each source is a dict:
+
+      records    span records (ring records, shipped worker spans, or
+                 already-chrome events) — or
+      path       a Chrome trace JSON file to fold in;
+      pid        the pid to stamp on this source's events (default:
+                 the records' own, else this process);
+      offset_us  added to every timestamp — the per-worker
+                 monotonic-clock offset the proc transport estimates
+                 from the REQ→ACK handshake, so spans measured on N
+                 different `perf_counter` origins land on ONE aligned
+                 axis and a request's router/IPC/worker spans nest by
+                 time containment across pids.
+
+    Atomic write; returns `path`."""
+    default_pid = os.getpid()
+    events: List[Dict] = []
+    for src in sources:
+        recs = src.get("records")
+        if recs is None and src.get("path"):
+            try:
+                with open(src["path"], "r", encoding="utf-8") as f:
+                    data = json.load(f)
+            except (OSError, ValueError):
+                continue
+            recs = (data.get("traceEvents", [])
+                    if isinstance(data, dict) else data)
+        pid = src.get("pid")
+        off = float(src.get("offset_us") or 0.0)
+        for r in recs or []:
+            ev = _chrome_event(r, default_pid, off)
+            if pid is not None:
+                ev["pid"] = pid
+            events.append(ev)
+    return _write_chrome(path, events)
 
 
 def span_summary() -> Dict[str, Dict]:
@@ -510,7 +750,9 @@ class MetricsLogger:
     (`read_metrics` skips the at-most-one partial trailing line).
 
     Record fields (always present, None when unknown): schema, time,
-    step, loss, examples_per_sec, step_s, data_wait_s, dispatch_s,
+    pid, mono (wall/monotonic clock pair + writer pid — v2, ISSUE 15:
+    multi-process fleet logs align offline), step, loss,
+    examples_per_sec, step_s, data_wait_s, dispatch_s,
     device_sync_s (from the tracer's last closed step span when
     tracing is on), cache (per-cache COUNTER DELTAS since the previous
     record — retraces/step after warmup ≈ 0 is the healthy signal),
@@ -600,6 +842,14 @@ class MetricsLogger:
         rec = {
             "schema": SCHEMA_VERSION,
             "time": round(time.time(), 3),
+            # Writer pid + a monotonic stamp PAIRED with the wall
+            # clock above (ISSUE 15): N per-process logs are
+            # time-alignable offline — the (time, mono) pair in any
+            # record recovers each process's perf_counter->wall
+            # offset. Additive: read_metrics parses v1 records (no
+            # pid/mono) and v2 alike.
+            "pid": os.getpid(),
+            "mono": round(time.perf_counter(), 6),
             "step": int(step),
             "loss": loss,
             "step_s": None if step_s is None else round(float(step_s), 6),
@@ -664,3 +914,139 @@ def read_metrics(path: str) -> List[Dict]:
             if isinstance(rec, dict):
                 out.append(rec)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Fleet telemetry aggregator (ISSUE 15): N per-replica/worker metrics
+# JSONL streams + the merged span timeline -> ONE schema-stable fleet
+# record. Consumed by `bench.py --stage fleet` (`latency_breakdown` /
+# `trace` result blocks) and rendered by `tools/fleet_top.py`.
+# ---------------------------------------------------------------------------
+FLEET_AGGREGATE_SCHEMA = 1
+
+# The per-segment latency decomposition: where a fleet request's time
+# goes, one bucket per span name on the request path.
+FLEET_SEGMENTS = ("queue_wait", "ipc", "dispatch", "reply", "route",
+                  "failover", "submit", "batch_assemble")
+
+
+def _segment_stats(spans) -> Dict[str, Dict]:
+    by_name: Dict[str, List[float]] = {}
+    for r in spans or []:
+        name = r.get("name")
+        if name in FLEET_SEGMENTS and r.get("dur") is not None:
+            by_name.setdefault(name, []).append(float(r["dur"]) / 1e3)
+    out: Dict[str, Dict] = {}
+    for name, ms in by_name.items():
+        arr = np.asarray(ms)
+        out[name] = {
+            "count": len(ms),
+            "p50_ms": round(float(np.percentile(arr, 50)), 3),
+            "p99_ms": round(float(np.percentile(arr, 99)), 3),
+        }
+    return out
+
+
+def aggregate_fleet(paths=None, spans=None,
+                    chrome_trace: Optional[str] = None) -> Dict:
+    """Roll fleet telemetry into ONE schema-stable record:
+
+      paths         metrics JSONL files (or directories globbed for
+                    `*.jsonl`): the router's control-plane stream
+                    (records whose `extra.event` is set) and the
+                    per-replica/worker serving streams (per-dispatch
+                    records) — both the `read_metrics` format, v1 or
+                    v2 records alike.
+      spans         span records (ring records or chrome events) for
+                    the per-segment latency decomposition.
+      chrome_trace  a merged Chrome trace file whose events join
+                    `spans` (the `merge_chrome_traces` output).
+
+    Returns {schema, kind, requests/replies/failed/rejected + routing
+    counters, availability_pct, segments (queue/ipc/dispatch/reply/...
+    p50/p99), events (the ejection/restart/kill state-transition
+    timeline), workers (per-pid dispatch totals), trace_ids}. Every
+    field is always present (None/empty when the inputs don't carry
+    it) — the schema-stable contract every consumer pins on."""
+    import glob as glob_mod
+
+    files: List[str] = []
+    for p in (paths or []):
+        if os.path.isdir(p):
+            files.extend(sorted(glob_mod.glob(os.path.join(p,
+                                                           "*.jsonl"))))
+        else:
+            files.append(p)
+    counters: Dict[str, int] = {}
+    events: List[Dict] = []
+    workers: Dict[str, Dict] = {}
+    for f in files:
+        for rec in read_metrics(f):
+            x = rec.get("extra") or {}
+            if x.get("event"):
+                # router control-plane record: counters are monotone
+                # within a run — keep the max seen
+                for k in ("fleet_requests", "fleet_replies",
+                          "fleet_failed", "routed", "failovers",
+                          "refused", "rejected", "ejections",
+                          "rejoins", "restarts", "kills_injected"):
+                    v = x.get(k)
+                    if isinstance(v, (int, float)):
+                        counters[k] = max(counters.get(k, 0), int(v))
+                if x["event"] == "transition":
+                    events.append({
+                        "t": rec.get("time"),
+                        "replica": x.get("replica"),
+                        "to_state": x.get("to_state"),
+                        "reason": x.get("reason"),
+                    })
+            elif x.get("bucket") is not None:
+                # per-dispatch serving record (engine or worker side)
+                key = str(rec.get("pid") or os.path.basename(f))
+                w = workers.setdefault(key, {
+                    "dispatches": 0, "rows": 0, "expired": 0,
+                    "shed": 0, "retries": 0, "failed": 0})
+                w["dispatches"] += 1
+                w["rows"] += int(x.get("rows") or 0)
+                for k in ("expired", "shed", "retries", "failed"):
+                    v = x.get(k)
+                    if isinstance(v, (int, float)):
+                        w[k] = max(w[k], int(v))  # cumulative in-stream
+    all_spans = list(spans or [])
+    if chrome_trace:
+        try:
+            with open(chrome_trace, "r", encoding="utf-8") as f:
+                data = json.load(f)
+            all_spans.extend(data.get("traceEvents", [])
+                             if isinstance(data, dict) else data)
+        except (OSError, ValueError):
+            pass
+    trace_ids = set()
+    for r in all_spans:
+        t = r.get("trace") or (r.get("args") or {}).get("trace")
+        if t:
+            trace_ids.add(t)
+    req = counters.get("fleet_requests")
+    rep = counters.get("fleet_replies")
+    avail = (round(100.0 * rep / req, 2)
+             if req and rep is not None else None)
+    return {
+        "schema": FLEET_AGGREGATE_SCHEMA,
+        "kind": "fleet_aggregate",
+        "requests": req,
+        "replies": rep,
+        "failed": counters.get("fleet_failed"),
+        "rejected": counters.get("rejected"),
+        "routed": counters.get("routed"),
+        "failovers": counters.get("failovers"),
+        "refused": counters.get("refused"),
+        "ejections": counters.get("ejections"),
+        "restarts": counters.get("restarts"),
+        "kills": counters.get("kills_injected"),
+        "availability_pct": avail,
+        "segments": _segment_stats(all_spans),
+        "events": events,
+        "workers": workers,
+        "trace_ids": len(trace_ids),
+        "span_count": len(all_spans),
+    }

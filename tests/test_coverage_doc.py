@@ -224,3 +224,27 @@ def test_all_cited_paths_exist():
             if not os.path.exists(os.path.join(_ROOT, p)):
                 missing.append(p)
     assert not missing, f"COVERAGE.md cites missing paths: {sorted(missing)}"
+
+
+def test_fleet_tracing_row_and_readme_section_present():
+    """ISSUE 15 doc contract: the P23 fleet-wide distributed tracing
+    row and the README "Fleet observability" section exist (trace
+    context, zero-wire-bytes-disabled, clock alignment, merge +
+    aggregate tools, knobs)."""
+    cov = open(os.path.join(_ROOT, "COVERAGE.md")).read()
+    assert "| P23 |" in cov
+    assert "tests/test_fleet_trace.py" in cov
+    assert "merge_chrome_traces" in cov
+    assert "aggregate_fleet" in cov
+    assert "tools/fleet_top.py" in cov
+    assert "ship_dropped" in cov
+    readme = open(os.path.join(_ROOT, "README.md")).read()
+    assert "## Fleet observability" in readme
+    assert "trace_id" in readme
+    assert "zero wire bytes" in readme
+    assert "merge_chrome_traces" in readme
+    assert "aggregate_fleet" in readme
+    assert "fleet_top.py" in readme
+    assert "ship_capacity" in readme
+    assert "latency_breakdown" in readme
+    assert "fleet_trace_overhead_pct" in readme

@@ -513,3 +513,128 @@ def test_proc_chaos_soak_full(tmp_path):
             assert c["export"]["hits"] >= 1, c
     for r in reps:
         r.stop()
+
+
+# ---------------------------------------------------------------------------
+# Distributed tracing across the process boundary (ISSUE 15) — the
+# acceptance scenario: a real 2-worker proc fleet produces ONE merged
+# Chrome timeline where a single trace_id's spans from >= 2 distinct
+# pids nest in causal order under the estimated clock offsets; the
+# context survives failover (a real SIGKILL) and a supervisor respawn
+# (new generation, same trace propagation); tracing disabled adds
+# zero wire bytes and zero spans; tracing enabled keeps the three
+# reconciliation equations EXACT.
+# ---------------------------------------------------------------------------
+def test_proc_fleet_merged_trace_failover_respawn_reconcile(tmp_path):
+    device.set_tracing(False)
+    trace.clear()  # earlier tests leave spans in the shared ring
+    s0, f0 = _snaps()
+    reps = _proc_replicas(2)
+    router = fleet.FleetRouter(
+        reps, supervise_interval_s=0.01, health_max_age_s=1.0,
+        probe_backoff_ms=20.0, max_restarts=3, seed=11).start()
+    x = np.ones((1, FEATS), np.float32)
+    try:
+        router.warmup(x)
+        # -- disabled first (the workers arm their tracers lazily on
+        # the first TRACED request): zero spans anywhere, and no ACK
+        # clock stamps ever arrive — the untraced wire is the PR 13
+        # wire, byte for byte (payload equality pinned in
+        # test_fleet_trace; absence of stamps/spans pins it live)
+        for _ in range(3):
+            router.submit(x).result(60)
+        assert trace.records() == []
+        for r in reps:
+            t = r.transport_snapshot()
+            assert t["spans_received"] == 0
+            assert all(g["clock_offset_us"] is None
+                       for g in t["generations"].values()), t
+
+        # -- tracing ON: every request births a trace_id
+        device.set_tracing(True)
+        clean = router.submit(x)
+        assert clean.trace is not None
+        clean.result(60)
+        # hang w0's next dispatch, queue a burst, and SIGKILL it with
+        # requests guaranteed in flight: failover keeps their ids
+        reps[0].hang_once(1.0)
+        futs = [router.submit(np.ones((1, FEATS), np.float32))
+                for _ in range(16)]
+        tids = [f.trace for f in futs]
+        assert all(tids) and len(set(tids)) == 16
+        reps[0].sigkill()
+        for f in futs:
+            f.result(60)
+        assert [f.trace for f in futs] == tids, \
+            "failover must not re-id a request"
+        # supervisor notices the death, then respawns w0 (new
+        # generation, new pid) — two-phase wait, the kill detection
+        # is asynchronous
+        deadline = time.time() + 60
+        while (router._slots["w0"].state == "ready"
+               and time.time() < deadline):
+            time.sleep(0.005)
+        assert router._slots["w0"].state != "ready", \
+            "router never noticed the SIGKILL"
+        while (router._slots["w0"].state != "ready"
+               and time.time() < deadline):
+            time.sleep(0.02)
+        assert router._slots["w0"].state == "ready", \
+            router.replica_snapshot()
+        # traced requests keep flowing INTO the respawned generation
+        # (re-armed at spawn via the spec trace block): drain w1 so
+        # routing has exactly one place to go
+        router.drain("w1")
+        futs2 = [router.submit(np.ones((1, FEATS), np.float32))
+                 for _ in range(8)]
+        for f in futs2:
+            f.result(60)
+        assert all(f.replica == "w0" for f in futs2)
+        time.sleep(0.5)  # heartbeats ship any still-buffered spans
+    finally:
+        router.stop()
+        device.set_tracing(False)
+    # tracing kept the three zero-silent-loss equations EXACT, plus
+    # the transport ledger
+    s1, f1 = _snaps()
+    rec = fleet.reconcile(s0, s1, f0, f1, replicas=reps)
+    assert rec["ok"], rec
+    assert rec["fleet_delta"]["failovers"] >= 1
+
+    path = str(tmp_path / "merged_trace.json")
+    router.export_trace(path)
+    evs = json.load(open(path))["traceEvents"]
+    pids = {e["pid"] for e in evs}
+    assert os.getpid() in pids and len(pids) >= 3, pids
+
+    def tid_of(e):
+        return (e.get("args") or {}).get("trace")
+
+    # the acceptance criterion: ONE trace_id whose spans come from
+    # >= 2 distinct pids and order causally: submit -> route -> ipc
+    # (parent clock, exact) -> worker dispatch -> reply (worker clock
+    # under the estimated offset; 5 ms slop absorbs offset error)
+    nested = 0
+    for t in {tid_of(e) for e in evs if tid_of(e)}:
+        spans = {e["name"]: e for e in evs if tid_of(e) == t}
+        need = {"submit", "route", "ipc", "dispatch", "reply"}
+        if not need <= set(spans):
+            continue
+        if spans["dispatch"]["pid"] == spans["submit"]["pid"]:
+            continue
+        assert (spans["submit"]["ts"] <= spans["route"]["ts"]
+                <= spans["ipc"]["ts"]), t
+        assert spans["dispatch"]["ts"] >= spans["ipc"]["ts"] - 5e3, t
+        assert spans["dispatch"]["ts"] <= spans["reply"]["ts"], t
+        nested += 1
+    assert nested >= 1, "no trace nests across the process boundary"
+    # the failover hop rode the SAME trace as its request
+    fo = [e for e in evs if e["name"] == "failover"]
+    assert fo and all(tid_of(e) in set(tids) for e in fo)
+    # the respawned generation (gen 2, a NEW pid) served traced
+    # requests — context propagation survived the respawn
+    gens = reps[0].transport_snapshot()["generations"]
+    assert len(gens) >= 2, gens
+    pid2 = gens[max(gens)]["pid"]
+    assert any(e["pid"] == pid2 and tid_of(e) for e in evs), \
+        "no traced span from the respawned worker generation"

@@ -1,0 +1,409 @@
+"""Fleet-wide distributed tracing (ISSUE 15): trace context across
+threads and the process boundary, merged timelines, the aggregated
+SLO surface.
+
+Acceptance pins:
+  - a trace context (`trace_id` + parent span id) is born at
+    `FleetRouter.submit` and threads through routing, the serving
+    engine's dispatcher thread, failover hops, and
+    `submit_with_backoff` retries — every span one request touches
+    carries ONE id;
+  - the wire carries the context as an OPTIONAL suffix on REQ frames:
+    tracing disabled is ZERO extra wire bytes (byte-for-byte payload
+    equality with the pre-trace format) and zero recorded spans;
+  - span ship-back is bounded end to end: the worker's ship buffer
+    overflow drops oldest and COUNTS it (`ship_dropped`), and each
+    frame carries at most the per-frame bound — frames never grow
+    unboundedly;
+  - `merge_chrome_traces` folds N processes' spans into one timeline
+    under per-source clock offsets; `aggregate_fleet` rolls router +
+    worker metrics JSONL + spans into one schema-stable fleet record;
+  - `MetricsLogger` v2 records carry pid + a wall/monotonic clock
+    pair; `read_metrics` accepts v1 and v2 records MIXED in one log.
+"""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from singa_tpu import device, export_cache, fleet, fleet_proc, \
+    serve, stats, trace
+from singa_tpu.serve import ServeDispatchError, ServeOverloadError, \
+    ServeReply
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    saved = fleet.get_config()
+    saved_serve = serve.get_config()
+    device.set_tracing(False, ship_capacity=0)
+    trace.clear()  # earlier test files leave spans in the ring
+    yield
+    device.set_tracing(False, ship_capacity=0)
+    trace.clear()
+    fleet._CONFIG.update(saved)
+    serve.configure(**saved_serve)
+    export_cache.configure(directory=None, buckets=None)
+
+
+# ---------------------------------------------------------------------------
+# Context API + strict disabled no-op
+# ---------------------------------------------------------------------------
+def test_trace_context_api_and_disabled_noop():
+    # disabled: no ids, no context, no spans — the strict no-op
+    assert trace.current_trace() is None
+    with trace.context("deadbeef"):
+        assert trace.current_trace() is None  # null context
+        with trace.span("x"):
+            pass
+    assert trace.records() == []
+
+    device.set_tracing(True)
+    t1, t2 = trace.new_trace_id(), trace.new_trace_id()
+    assert t1 != t2 and len(t1) == 16
+    with trace.context(t1, 42):
+        assert trace.current_trace() == {"trace_id": t1, "parent": 42}
+        with trace.context(t2):  # nesting: innermost wins
+            assert trace.current_trace()["trace_id"] == t2
+            with trace.span("inner"):
+                pass
+        assert trace.current_trace()["trace_id"] == t1
+        with trace.span("outer"):
+            assert trace.current_span_id() is not None
+    by = {r["name"]: r for r in trace.records()}
+    assert by["inner"]["trace"] == t2
+    assert by["outer"]["trace"] == t1
+    # top-level span under a context inherits the REMOTE parent
+    assert by["outer"]["remote_parent"] == 42
+    assert "remote_parent" not in by["inner"]
+
+
+def test_record_span_explicit_trace_and_fallback():
+    device.set_tracing(True)
+    trace.record_span("queue_wait", 0.0, 0.001, trace=("aa", 7),
+                      rows=1)
+    with trace.context("bb"):
+        trace.record_span("ipc", 0.0, 0.002)  # context fallback
+    trace.record_span("plain", 0.0, 0.003)
+    by = {r["name"]: r for r in trace.records()}
+    assert by["queue_wait"]["trace"] == "aa"
+    assert by["queue_wait"]["remote_parent"] == 7
+    assert by["ipc"]["trace"] == "bb"
+    assert "trace" not in by["plain"]
+
+
+# ---------------------------------------------------------------------------
+# Wire format: zero extra bytes disabled, suffix round trip, bounds
+# ---------------------------------------------------------------------------
+def test_req_payload_zero_extra_wire_bytes_when_untraced():
+    """The zero-extra-wire-bytes contract: an untraced REQ payload is
+    BYTE-FOR-BYTE the pre-trace format (f64 deadline + tree), so a
+    disabled-mode fleet's frames are identical to PR 13's."""
+    import struct
+
+    batch = [np.arange(8, dtype=np.float32).reshape(2, 4)]
+    legacy = struct.pack(">d", -1.0) + fleet_proc.encode_tree(
+        list(batch))
+    assert fleet_proc.encode_req_payload(None, batch) == legacy
+    legacy_dl = struct.pack(">d", 25.0) + fleet_proc.encode_tree(
+        list(batch))
+    assert fleet_proc.encode_req_payload(25.0, batch) == legacy_dl
+    # and the whole FRAME is therefore byte-identical too
+    assert fleet_proc.encode_frame(fleet_proc.REQ, 3, legacy) == \
+        fleet_proc.encode_frame(
+            fleet_proc.REQ, 3, fleet_proc.encode_req_payload(
+                None, batch))
+
+    # traced: suffix present, full round trip
+    p = fleet_proc.encode_req_payload(50.0, batch,
+                                      trace=("0123456789abcdef", 9))
+    assert len(p) > len(legacy_dl)
+    dl, arrays, tid, parent = fleet_proc.decode_req_payload(p)
+    assert dl == 50.0 and tid == "0123456789abcdef" and parent == 9
+    np.testing.assert_array_equal(arrays[0], batch[0])
+    # parent-less suffix round-trips as None
+    p2 = fleet_proc.encode_req_payload(None, batch, trace=("ff", None))
+    assert fleet_proc.decode_req_payload(p2)[2:] == ("ff", None)
+
+
+def test_trailing_garbage_after_tree_is_loud():
+    batch = [np.ones((1, 2), np.float32)]
+    p = fleet_proc.encode_req_payload(None, batch) + b"Xjunk"
+    with pytest.raises(fleet_proc.FrameCorruptError):
+        fleet_proc.decode_req_payload(p)
+
+
+def test_ship_buffer_overflow_increments_drop_counter():
+    """Satellite edge case: span ship-back overflow increments the
+    drop counter instead of growing frames unboundedly — the buffer
+    is bounded, drains are bounded per call (the per-frame bound),
+    and the loss is visible in cache_stats()."""
+    device.set_tracing(True, ship_capacity=4)
+    stats.reset_cache_stats()
+    for i in range(11):
+        trace.record_span("dispatch", 0.0, 0.001, trace=("t%d" % i,))
+    s = stats.cache_stats()["trace"]
+    assert s["ship_dropped"] == 7, s
+    assert s["ship_pending"] == 4
+    # drains are bounded per call — one frame never carries more
+    assert len(trace.drain_shipped(2)) == 2
+    assert len(trace.drain_shipped(100)) == 2
+    assert trace.drain_shipped(100) == []
+    # untraced spans never enter the ship buffer
+    trace.record_span("plain", 0.0, 0.001)
+    assert stats.cache_stats()["trace"]["ship_pending"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Merge + aggregate
+# ---------------------------------------------------------------------------
+def test_merge_chrome_traces_applies_offsets_and_pids(tmp_path):
+    device.set_tracing(True)
+    with trace.context("abc"):
+        with trace.span("submit"):
+            time.sleep(0.001)
+    path = str(tmp_path / "merged.json")
+    worker_spans = [{"name": "dispatch", "ts": 1000.0, "dur": 500.0,
+                     "tid": 5, "trace": "abc"}]
+    trace.merge_chrome_traces(path, [
+        {"records": trace.records()},
+        {"records": worker_spans, "pid": 4242, "offset_us": 2500.0},
+    ])
+    evs = json.load(open(path))["traceEvents"]
+    assert {e["pid"] for e in evs} == {os.getpid(), 4242}
+    d = [e for e in evs if e["pid"] == 4242][0]
+    assert d["ts"] == 3500.0  # worker clock + offset
+    assert d["args"]["trace"] == "abc"
+    assert evs == sorted(evs, key=lambda e: (e["ts"], -e["dur"]))
+    # merging a chrome FILE back in preserves its events
+    path2 = str(tmp_path / "remerged.json")
+    trace.merge_chrome_traces(path2, [{"path": path}])
+    assert len(json.load(open(path2))["traceEvents"]) == len(evs)
+
+
+def test_aggregate_fleet_rolls_streams_into_one_record(tmp_path):
+    rpath = str(tmp_path / "router_fleet.jsonl")
+    with open(rpath, "w") as f:
+        f.write(json.dumps({
+            "time": 1.0, "step": 1, "extra": {
+                "event": "route", "fleet_requests": 10,
+                "fleet_replies": 9, "fleet_failed": 1, "routed": 9,
+                "failovers": 1, "refused": 0, "rejected": 0,
+                "ejections": 1, "restarts": 1,
+                "kills_injected": 1}}) + "\n")
+        f.write(json.dumps({
+            "time": 2.0, "step": 2, "extra": {
+                "event": "transition", "replica": "w0",
+                "to_state": "dead", "reason": "killed",
+                "fleet_requests": 10}}) + "\n")
+        f.write("{torn partial line")
+    wpath = str(tmp_path / "w0.worker.jsonl")
+    with open(wpath, "w") as f:
+        for i in range(3):
+            f.write(json.dumps({
+                "time": float(i), "step": i, "pid": 4242, "extra": {
+                    "bucket": 8, "rows": 4, "expired": 0, "shed": 1,
+                    "retries": 0, "failed": 0}}) + "\n")
+    spans = [{"name": "queue_wait", "ts": 0.0, "dur": 1000.0},
+             {"name": "queue_wait", "ts": 5.0, "dur": 3000.0},
+             {"name": "dispatch", "ts": 9.0, "dur": 2000.0,
+              "trace": "t1"},
+             {"name": "ipc", "ts": 2.0, "dur": 700.0, "trace": "t2"},
+             {"name": "not_a_segment", "ts": 0.0, "dur": 1.0}]
+    agg = trace.aggregate_fleet(paths=[str(tmp_path)], spans=spans)
+    assert agg["schema"] == trace.FLEET_AGGREGATE_SCHEMA
+    assert agg["requests"] == 10 and agg["replies"] == 9
+    assert agg["availability_pct"] == 90.0
+    assert agg["failovers"] == 1 and agg["kills"] == 1
+    assert agg["events"] == [{"t": 2.0, "replica": "w0",
+                              "to_state": "dead", "reason": "killed"}]
+    assert agg["workers"]["4242"]["dispatches"] == 3
+    assert agg["workers"]["4242"]["rows"] == 12
+    assert agg["workers"]["4242"]["shed"] == 1  # cumulative in-stream
+    segs = agg["segments"]
+    assert segs["queue_wait"]["count"] == 2
+    assert segs["queue_wait"]["p50_ms"] == 2.0
+    assert segs["dispatch"]["p99_ms"] == 2.0
+    assert "not_a_segment" not in segs
+    assert agg["trace_ids"] == 2
+    # no inputs at all -> the same stable schema, everything empty
+    empty = trace.aggregate_fleet()
+    assert set(empty) == set(agg)
+    assert empty["availability_pct"] is None
+
+
+def test_fleet_top_cli_renders_aggregate(tmp_path, capsys):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "fleet_top_for_test", os.path.join(_ROOT, "tools",
+                                           "fleet_top.py"))
+    ft = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ft)
+    rpath = str(tmp_path / "bench_fleet.jsonl")
+    with open(rpath, "w") as f:
+        f.write(json.dumps({"time": 1.0, "step": 1, "extra": {
+            "event": "route", "fleet_requests": 4, "fleet_replies": 4,
+            "routed": 4}}) + "\n")
+    rc = ft.main(["--dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "availability 100.0%" in out
+    assert "requests 4" in out
+    # an empty dir fails loudly (exit 1), never a silent empty table
+    assert ft.main(["--dir", str(tmp_path / "nope")]) == 1
+
+
+# ---------------------------------------------------------------------------
+# MetricsLogger v2: pid + wall/mono pair, mixed-log read (satellite)
+# ---------------------------------------------------------------------------
+def test_metrics_v2_pid_mono_and_mixed_log_read(tmp_path):
+    path = str(tmp_path / "mixed.jsonl")
+    # a v1 record as PR 5 wrote it: no pid, no mono
+    v1 = {"schema": 1, "time": 123.0, "step": 1, "loss": 0.5,
+          "step_s": 0.1, "data_wait_s": None, "dispatch_s": None,
+          "device_sync_s": None, "examples_per_sec": 10.0,
+          "cache": {}, "resilience": {}, "accum": {}, "metrics": {},
+          "extra": {}}
+    with open(path, "w") as f:
+        f.write(json.dumps(v1) + "\n")
+    with trace.MetricsLogger(path) as ml:
+        rec = ml.log_step(2, loss=0.25, examples=8, step_s=0.05)
+    assert rec["schema"] == trace.SCHEMA_VERSION == 2
+    assert rec["pid"] == os.getpid()
+    assert isinstance(rec["mono"], float)
+    # the (time, mono) pair recovers this process's clock offset
+    assert abs((rec["time"] - rec["mono"])
+               - (time.time() - time.perf_counter())) < 2.0
+    recs = trace.read_metrics(path)
+    assert [r["step"] for r in recs] == [1, 2]
+    assert "pid" not in recs[0] and recs[1]["pid"] == os.getpid()
+    assert recs[0]["loss"] == 0.5  # v1 record fully readable
+
+
+# ---------------------------------------------------------------------------
+# Router threading: one id per request, failover + retry keep it
+# ---------------------------------------------------------------------------
+class _StubReplica:
+    """Minimal Replica-protocol stub that records the trace context
+    active at submit time."""
+
+    def __init__(self, name, fail_first=0):
+        self.name = name
+        self.killed = False
+        self.seen = []
+        self.fail_first = fail_first
+        self.shed = 0
+
+    def start(self):
+        return self
+
+    def kill(self):
+        self.killed = True
+
+    def drain_stop(self):
+        pass
+
+    def restart(self):
+        return self
+
+    def stop(self, drain=True):
+        pass
+
+    def warmup(self, *a):
+        return 0
+
+    def submit(self, *arrays, deadline_ms=None):
+        ctx = trace.current_trace()
+        self.seen.append(None if ctx is None else ctx["trace_id"])
+        if self.shed > 0:
+            self.shed -= 1
+            raise ServeOverloadError("shedding", retry_after_ms=1.0)
+        r = ServeReply(1)
+        if self.fail_first > 0:
+            self.fail_first -= 1
+            r._fail(ServeDispatchError("stub replica failure"))
+        else:
+            r._deliver(np.zeros((1, 2), np.float32))
+        return r
+
+    def health(self):
+        return {"state": "ready", "time": round(time.time(), 3),
+                "name": self.name}
+
+    def depth(self):
+        return 0
+
+    def hang_once(self, s):
+        pass
+
+    def freeze_health(self, s):
+        pass
+
+
+def test_router_births_one_trace_id_failover_keeps_it():
+    device.set_tracing(True)
+    a, b = _StubReplica("a", fail_first=1), _StubReplica("b")
+    router = fleet.FleetRouter([a, b], supervise_interval_s=5.0,
+                               seed=1).start()
+    try:
+        fut = router.submit(np.zeros((1, 2), np.float32))
+        assert fut.trace is not None
+        fut.result(10)
+        assert fut.hops == 1  # a failed it, b served it
+        # BOTH replicas saw the SAME trace id — the context followed
+        # the failover hop
+        assert a.seen == [fut.trace]
+        assert b.seen == [fut.trace]
+        by = [r for r in trace.records()
+              if r.get("trace") == fut.trace]
+        names = [r["name"] for r in by]
+        assert "submit" in names and "route" in names
+        assert "failover" in names
+        # a second request gets a DIFFERENT id
+        fut2 = router.submit(np.zeros((1, 2), np.float32))
+        fut2.result(10)
+        assert fut2.trace != fut.trace
+    finally:
+        router.stop()
+
+
+def test_submit_with_backoff_one_trace_across_retries():
+    device.set_tracing(True)
+    a = _StubReplica("a")
+    a.shed = 1  # first attempt sheds, second lands
+    router = fleet.FleetRouter([a], supervise_interval_s=5.0,
+                               max_shed_retries=0, seed=2).start()
+    try:
+        fut = serve.submit_with_backoff(router.submit,
+                                        np.zeros((1, 2), np.float32),
+                                        seed=3, max_sleep_s=0.01)
+        fut.result(10)
+        # shed attempt + landed attempt: one trace id end to end
+        assert len(a.seen) == 2
+        assert a.seen[0] == a.seen[1] == fut.trace
+        assert any(r["name"] == "shed_backoff"
+                   and r.get("trace") == fut.trace
+                   for r in trace.records())
+    finally:
+        router.stop()
+
+
+def test_disabled_fleet_is_zero_spans_and_no_ids():
+    a = _StubReplica("a")
+    router = fleet.FleetRouter([a], supervise_interval_s=5.0,
+                               seed=4).start()
+    try:
+        stats.reset_cache_stats()
+        fut = router.submit(np.zeros((1, 2), np.float32))
+        fut.result(10)
+        assert fut.trace is None
+        assert a.seen == [None]
+        assert trace.records() == []
+        assert stats.cache_stats()["trace"]["spans"] == 0
+    finally:
+        router.stop()

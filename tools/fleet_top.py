@@ -1,0 +1,139 @@
+#!/usr/bin/env python3
+"""fleet_top — one-screen fleet SLO surface (ISSUE 15).
+
+Rolls the fleet's telemetry — the router's control-plane metrics
+JSONL, the per-replica/worker serving JSONLs, and (optionally) the
+merged Chrome trace `FleetRouter.export_trace` / `bench.py --stage
+fleet` writes — into ONE aggregated view via
+`singa_tpu.trace.aggregate_fleet`:
+
+  - availability (router replies / requests) + terminal counters
+  - per-segment latency decomposition p50/p99: queue_wait / ipc /
+    dispatch / reply / route — where a fleet request's time goes
+  - the failover / ejection / restart / kill event timeline
+  - per-worker dispatch totals (keyed by writer pid, the v2
+    MetricsLogger field)
+
+Usage:
+  tools/fleet_top.py [--dir metrics] [--trace metrics/bench_fleet_trace.json]
+                     [--files a.jsonl b.jsonl ...] [--events N] [--json]
+
+With --dir (default ./metrics) every `*fleet*.jsonl` under it joins
+the roll-up; --files names streams explicitly; --json emits the raw
+schema-stable aggregate record instead of the table.
+
+Exit codes: 0 = aggregated, 1 = no input records found.
+"""
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..")))
+
+
+def _fmt(v, suffix=""):
+    return "-" if v is None else f"{v}{suffix}"
+
+
+def render(agg, events_n):
+    lines = []
+    lines.append(
+        f"fleet: requests {_fmt(agg['requests'])}  replies "
+        f"{_fmt(agg['replies'])}  failed {_fmt(agg['failed'])}  "
+        f"rejected {_fmt(agg['rejected'])}  availability "
+        f"{_fmt(agg['availability_pct'], '%')}")
+    lines.append(
+        f"routing: routed {_fmt(agg['routed'])}  failovers "
+        f"{_fmt(agg['failovers'])}  refused {_fmt(agg['refused'])}  "
+        f"ejections {_fmt(agg['ejections'])}  restarts "
+        f"{_fmt(agg['restarts'])}  kills {_fmt(agg['kills'])}")
+    segs = agg.get("segments") or {}
+    if segs:
+        lines.append(f"  {'segment':<16} {'count':>7} {'p50_ms':>9} "
+                     f"{'p99_ms':>9}")
+        for name in ("queue_wait", "ipc", "dispatch", "reply",
+                     "route", "failover", "submit", "batch_assemble"):
+            s = segs.get(name)
+            if s is None:
+                continue
+            lines.append(f"  {name:<16} {s['count']:>7d} "
+                         f"{s['p50_ms']:>9.3f} {s['p99_ms']:>9.3f}")
+    else:
+        lines.append("  (no spans — pass --trace, or run with "
+                     "device.set_tracing(True))")
+    workers = agg.get("workers") or {}
+    if workers:
+        lines.append(f"  {'worker':<24} {'dispatches':>10} "
+                     f"{'rows':>8} {'expired':>8} {'shed':>6} "
+                     f"{'failed':>7}")
+        for key in sorted(workers):
+            w = workers[key]
+            lines.append(f"  {key:<24} {w['dispatches']:>10d} "
+                         f"{w['rows']:>8d} {w['expired']:>8d} "
+                         f"{w['shed']:>6d} {w['failed']:>7d}")
+    evs = agg.get("events") or []
+    if evs:
+        lines.append(f"events (last {min(events_n, len(evs))} of "
+                     f"{len(evs)}):")
+        for e in evs[-events_n:]:
+            lines.append(f"  t={e.get('t')}  {e.get('replica')} -> "
+                         f"{e.get('to_state')}"
+                         + (f"  ({e['reason']})" if e.get("reason")
+                            else ""))
+    if agg.get("trace_ids"):
+        lines.append(f"traces: {agg['trace_ids']} trace ids over "
+                     f"{agg['span_count']} spans")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default="metrics",
+                    help="directory whose *fleet*.jsonl streams join "
+                         "the roll-up (default: ./metrics)")
+    ap.add_argument("--files", nargs="*", default=None,
+                    help="explicit metrics JSONL paths (overrides "
+                         "--dir globbing)")
+    ap.add_argument("--trace", default=None,
+                    help="merged Chrome trace JSON "
+                         "(FleetRouter.export_trace output) for the "
+                         "per-segment latency decomposition")
+    ap.add_argument("--events", type=int, default=8,
+                    help="how many tail events to show")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the raw aggregate record")
+    a = ap.parse_args(argv)
+
+    from singa_tpu import trace
+
+    if a.files is not None:
+        paths = list(a.files)
+    else:
+        paths = sorted(glob.glob(os.path.join(a.dir,
+                                              "*fleet*.jsonl")))
+    agg = trace.aggregate_fleet(paths=paths, chrome_trace=a.trace)
+    have_input = bool(agg["requests"] or agg["workers"]
+                      or agg["span_count"])
+    if a.json:
+        print(json.dumps(agg, sort_keys=True))
+    else:
+        if not have_input:
+            print(f"fleet_top: no fleet records under "
+                  f"{a.files or a.dir!r} (and no --trace spans)",
+                  file=sys.stderr)
+            return 1
+        print(render(agg, a.events))
+    return 0 if have_input else 1
+
+
+if __name__ == "__main__":
+    try:
+        import signal
+
+        signal.signal(signal.SIGPIPE, signal.SIG_DFL)  # `| head` etc.
+    except (ImportError, AttributeError, ValueError):
+        pass
+    sys.exit(main())

@@ -178,11 +178,27 @@ def main():
                       f"{kills}/"
                       f"{c.get('failovers', 0)} failovers/"
                       f"{c.get('restarts', 0)} restarts{cbad}")
+            # distributed tracing (ISSUE 15): the per-segment latency
+            # decomposition + merged-timeline evidence — rendered only
+            # when the result carries the new blocks (old logs fold
+            # byte-identically)
+            seg = ""
+            lb = r.get("latency_breakdown")
+            if isinstance(lb, dict) and lb:
+                parts = [f"{k[0] if k != 'queue_wait' else 'q'}"
+                         f"{lb[k]['p99_ms']}"
+                         for k in ("queue_wait", "ipc", "dispatch",
+                                   "reply") if k in lb]
+                seg = ", p99 segs " + "/".join(parts) + " ms"
+            tr_ = r.get("trace")
+            if isinstance(tr_, dict):
+                seg += (f", trace: {tr_.get('span_count')} spans/"
+                        f"{tr_.get('pids')} pids")
             rows.append((stage,
                          f"{r['fleet_requests_per_sec']:.1f} req/s  "
                          f"({r.get('replicas')} replicas{tp}, p50 "
                          f"{r.get('p50_ms')} ms/p99 {r.get('p99_ms')} "
-                         f"ms{fo}{rst}{bad}{ch}"
+                         f"ms{fo}{rst}{bad}{seg}{ch}"
                          + _stage_breakdown(r) + ")" + mark))
         elif "serve_requests_per_sec" in r:
             # serving tier (ISSUE 7): throughput + SLO percentiles +

@@ -94,8 +94,11 @@ fi
 if [ "$1" = "fleet" ]; then
   dir=${2:-metrics}
   # fleet control-plane streams are tagged *fleet* (ISSUE 11:
-  # FleetRouter's MetricsLogger + bench.py --stage fleet write there)
-  f=$(ls -t "$dir"/*fleet*.jsonl 2>/dev/null | head -1)
+  # FleetRouter's MetricsLogger + bench.py --stage fleet write there);
+  # per-WORKER serving streams (*.worker.jsonl) are data-plane — skip
+  # them so the newest-file pick lands on the router's log
+  f=$(ls -t "$dir"/*fleet*.jsonl 2>/dev/null | grep -v '\.worker\.jsonl$' | head -1)
+  [ -z "$f" ] && f=$(ls -t "$dir"/*fleet*.jsonl 2>/dev/null | head -1)
   if [ -z "$f" ]; then
     echo "tpu_watch: no fleet metrics JSONL under $dir/ yet" >&2
     exit 1
@@ -133,6 +136,16 @@ for line in sys.stdin:
               "pipe_stalls_injected", "torn_frames_injected"):
         if x.get(k):
             bits.append(k + " " + str(x[k]))
+    # per-segment latency columns (ISSUE 15): rendered ONLY when the
+    # record carries them (the aggregate record trace.aggregate_fleet
+    # appends); pre-trace records print exactly as before
+    segs = x.get("segments") or {}
+    for name in ("queue_wait", "ipc", "dispatch", "reply"):
+        s = segs.get(name)
+        if s and s.get("p99_ms") is not None:
+            bits.append(name + " p99 " + str(s["p99_ms"]) + "ms")
+    if x.get("availability_pct") is not None:
+        bits.append("avail " + str(x["availability_pct"]) + "%")
     print("  ".join(bits))
 '
   exit $?
