@@ -83,6 +83,7 @@ __all__ = [
     # the state) + its resilience layer (ISSUE 8).
     "set_serving",
     "set_serving_resilience",
+    "set_decode_serving",
     "set_fleet",
     # Migration aliases (reference names):
     "create_cuda_gpu",
@@ -742,6 +743,38 @@ def set_serving_resilience(**kw) -> None:
 
     if kw:
         serve.configure_resilience(**kw)
+
+
+def set_decode_serving(max_sessions=None, max_new_tokens=None,
+                       prefill_batch=None, decode_block=None) -> None:
+    """Process defaults for the KV-cached decode tier
+    (`ServingEngine.submit_decode`; ISSUE 16): `max_sessions` sizes
+    the KV-slot pool — the admission-control bound on concurrent
+    generative sessions (queued + live; no free slot ⇒ a loud
+    `ServeOverloadError` with `retry_after_ms`, counted `shed` in
+    `cache_stats()["decode"]`); `max_new_tokens` caps the per-session
+    generation length a submit may request; `prefill_batch` bounds how
+    many new sessions prefill per dispatcher cycle (the prefill/decode
+    split — long prompts never stall the fused decode batch by more
+    than this); `decode_block` caps the greedy run-ahead — how many
+    fused steps may dispatch as one scanned program when no session
+    joins, leaves, expires, or samples inside the block (1 = every
+    token its own dispatch). Engines constructed afterwards read
+    these; per-engine constructor args override. Only the arguments
+    given change."""
+    from . import serve
+
+    kw = {}
+    if max_sessions is not None:
+        kw["max_sessions"] = max_sessions
+    if max_new_tokens is not None:
+        kw["max_new_tokens"] = max_new_tokens
+    if prefill_batch is not None:
+        kw["prefill_batch"] = prefill_batch
+    if decode_block is not None:
+        kw["decode_block"] = decode_block
+    if kw:
+        serve.configure_decode(**kw)
 
 
 def set_fleet(**kw) -> None:

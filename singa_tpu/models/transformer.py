@@ -171,11 +171,14 @@ class TransformerLM(model.Model):
         var = jnp.var(x, axis=-1, keepdims=True)
         return (x - mu) / jnp.sqrt(var + eps) * g + b
 
-    def _stack_step(self, params, ids, cache, pos0):
+    def _stack_step(self, params, ids, cache, pos0, last_index=None):
         """Run S tokens (positions pos0..pos0+S-1) through the block
         stack, writing their K/V into `cache` at those slots and
         attending over every filled slot. Returns (last-token logits,
-        new cache). Works for both prefill (S=P) and decode (S=1)."""
+        new cache). Works for both prefill (S=P) and decode (S=1).
+        `last_index` (traced scalar) selects which row's logits to
+        return instead of the last — bucket-padded prefill reads the
+        REAL last prompt token, not the pad tail."""
         import jax
         import jax.numpy as jnp
         from jax import lax
@@ -226,8 +229,59 @@ class TransformerLM(model.Model):
             h = h + lin(jax.nn.gelu(lin(x, blk["fc1"]),
                                     approximate=False), blk["fc2"])
         h = self._ln(h, params["ln_f"])
-        return (jnp.matmul(h[:, -1], params["head"], precision=prec),
+        if last_index is None:
+            last = h[:, -1]
+        elif getattr(last_index, "ndim", 0) == 1:
+            # per-row last index ([B] vector) — cohort prefill packs
+            # sessions with different real prompt lengths into one
+            # bucket-padded batch; each row reads ITS last real token
+            last = jnp.take_along_axis(
+                h, last_index[:, None, None], axis=1)[:, 0]
+        else:
+            last = lax.dynamic_index_in_dim(h, last_index, 1,
+                                            keepdims=False)
+        return (jnp.matmul(last, params["head"], precision=prec),
                 new_cache)
+
+    def _program_cache(self):
+        """`_gen_cache`: the model's compiled decode-program cache —
+        a bounded `stats.TieredLRUCache` sharing the process-wide
+        `cache_stats()["decode"]` counters (was an unbounded dict;
+        a long-lived server cycling sampling configs and shapes must
+        evict, not grow)."""
+        from .. import stats as stats_mod
+
+        cache = getattr(self, "_gen_cache", None)
+        if cache is None:
+            cache = self._gen_cache = stats_mod.TieredLRUCache(
+                "decode", stats=stats_mod.decode_stats().cache)
+        return cache
+
+    @staticmethod
+    def _count_first_trace(fn):
+        """Time `fn`'s first invocation (trace + compile + run) into
+        the decode CacheStats — the retrace-storm signal for the
+        decode tier."""
+        import time
+
+        import jax
+
+        from .. import stats as stats_mod
+
+        state = [True]
+
+        def wrapped(*a):
+            if state[0]:
+                state[0] = False
+                t0 = time.perf_counter()
+                out = fn(*a)
+                jax.block_until_ready(out)
+                stats_mod.decode_stats().cache.record_trace(
+                    time.perf_counter() - t0)
+                return out
+            return fn(*a)
+
+        return wrapped
 
     def _compiled_decode(self, B, P, max_new, temperature, top_k):
         """Build (or fetch) the jitted prefill+scan decode program for
@@ -239,11 +293,10 @@ class TransformerLM(model.Model):
 
         key_ = (B, P, max_new, float(temperature), int(top_k),
                 autograd._policy_key())  # policy baked in at trace time
-        cache_dict = getattr(self, "_gen_cache", None)
-        if cache_dict is None:
-            cache_dict = self._gen_cache = {}
-        if key_ in cache_dict:
-            return cache_dict[key_]
+        cache_dict = self._program_cache()
+        hit = cache_dict.get(key_)
+        if hit is not None:
+            return hit
 
         def sample(logits, key):
             if temperature == 0.0:
@@ -276,8 +329,295 @@ class TransformerLM(model.Model):
                 jnp.zeros((0, B), jnp.int32))
             return jnp.concatenate([toks.T, last[:, None]], axis=1)
 
+        run = self._count_first_trace(run)
         cache_dict[key_] = run
         return run
+
+    # -- token-granularity decode tier (ISSUE 16) -----------------------
+    #
+    # generate() fuses prefill + the whole decode loop into one
+    # program per request shape; a serving tier needs the OPPOSITE
+    # factoring — ONE warm single-step executable shared by every
+    # in-flight session, so sequences can join/leave the fused batch
+    # between steps. decode_step / prefill_step / sample_fn are that
+    # factoring, with the bit-identity contract: a session decoded
+    # through the shared slab reproduces generate()'s exact token
+    # stream (same logits bits, same key-split sequence).
+
+    def _slot_step(self, params, cache, tok, pos):
+        """One fused decode step over every batch slot at PER-ROW
+        positions: row b writes its K/V at cache slot (b, pos[b]) and
+        attends slots 0..pos[b]. `cache` is a PER-LAYER list of
+        [2, B, H, T, D] arrays — one buffer per layer, not one stacked
+        [L, ...] slab — so XLA:CPU never materialises a whole-slab
+        copy per layer (`at[li].set` on a stacked slab costs a full
+        slab pass per layer; the per-layer list halves steady-state
+        step time). The op sequence mirrors `_stack_step` S=1 exactly
+        (same matmul/einsum forms, same mask constant) so a slab row
+        decodes bitwise identically to the same request running alone
+        through `generate()`. Returns (logits [B, V], new per-layer
+        cache list)."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        H = self.blocks._seq[0].attn.num_heads
+        B = tok.shape[0]
+        maxT = cache[0].shape[-2]
+        h = params["embed"][tok[:, None]] + params["pos"][pos][:, None]
+        E = h.shape[-1]
+        D = E // H
+        scale = 1.0 / float(np.sqrt(D))
+        # row b (absolute position pos[b]) may attend slot j <= pos[b]
+        mask = pos[:, None] >= jnp.arange(maxT)[None, :]      # [B, maxT]
+        neg = jnp.asarray(jnp.finfo(h.dtype).min / 2, h.dtype)
+        new_cache = []
+
+        prec = tensor.get_matmul_precision()
+
+        def lin(x, wb):
+            w, b = wb
+            y = jnp.matmul(x, w, precision=prec)
+            return y if b is None else y + b
+
+        for li, blk in enumerate(params["blocks"]):
+            x = self._ln(h, blk["ln1"])
+
+            def split(t):  # [B,1,E] -> [B,H,1,D]
+                return t.reshape(B, 1, H, D).transpose(0, 2, 1, 3)
+
+            q = split(lin(x, blk["q"]))
+            kk = split(lin(x, blk["k"]))
+            vv = split(lin(x, blk["v"]))
+            kv = jnp.stack([kk, vv])                  # [2,B,H,1,D]
+
+            def upd(c_row, kv_row, p):
+                # c_row [2,H,T,D], kv_row [2,H,1,D]: write at slot p
+                return lax.dynamic_update_slice(c_row, kv_row,
+                                                (0, 0, p, 0))
+
+            new_li = jax.vmap(upd, in_axes=(1, 1, 0), out_axes=1)(
+                cache[li], kv, pos)
+            new_cache.append(new_li)
+            k_all = new_li[0]
+            v_all = new_li[1]
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k_all,
+                           precision=prec) * scale
+            s = jnp.where(mask[:, None, None], s, neg)
+            p = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bhqk,bhkd->bhqd", p, v_all, precision=prec)
+            o = o.transpose(0, 2, 1, 3).reshape(B, 1, E)
+            h = h + lin(o, blk["o"])
+            x = self._ln(h, blk["ln2"])
+            h = h + lin(jax.nn.gelu(lin(x, blk["fc1"]),
+                                    approximate=False), blk["fc2"])
+        h = self._ln(h, params["ln_f"])
+        return (jnp.matmul(h[:, -1], params["head"], precision=prec),
+                new_cache)
+
+    def _aot_step(self, kind, jitted, args, extras):
+        """Route a decode-tier step through the AOT store when armed:
+        load the serialized executable (no trace) or trace once +
+        publish, falling back to the plain jit on store miss/failure.
+        `args` must be the CONCRETE first-call arguments."""
+        import jax
+
+        from .. import export_cache
+
+        if not export_cache.active():
+            return self._count_first_trace(jitted)
+        key, parts = export_cache.step_key(self, None, kind, args,
+                                           extras=extras)
+        exp = export_cache.load(key)
+        if exp is None:
+            exp = export_cache.export_and_save(key, parts, jitted,
+                                               args)
+            if exp is None:
+                return self._count_first_trace(jitted)
+        return jax.jit(exp.call)
+
+    def decode_step(self, params, cache, tok, pos):
+        """ONE fused decode step for the serving tier: advance every
+        slab row by one token (`tok` [B] int32 at per-row positions
+        `pos` [B] int32), returning (next-token logits [B, V], new
+        cache). `cache` is the per-layer list `_slot_step` documents.
+        Compiled once per slab shape — the one warm executable
+        continuous batching dispatches every step — and AOT-exported
+        through export_cache when the store is armed."""
+        import jax.numpy as jnp
+
+        cache_dict = self._program_cache()
+        key_ = ("slot_step", tuple(c.shape for c in cache),
+                jnp.asarray(cache[0]).dtype.name,
+                autograd._policy_key())
+        fn = cache_dict.get(key_)
+        if fn is None:
+            import jax
+
+            jitted = jax.jit(
+                lambda p, c, t, po: self._slot_step(p, c, t, po))
+            args = (params, list(cache), tok, pos)
+            fn = self._aot_step(
+                "decode_step", jitted, args,
+                extras={"slab": [list(c.shape) for c in cache],
+                        "policy": autograd._policy_key()})
+            cache_dict[key_] = fn
+        return fn(params, list(cache), tok, pos)
+
+    def decode_scan(self, params, cache, tok, pos, k):
+        """`k` GREEDY fused decode steps in ONE program (`lax.scan`
+        over `_slot_step` + in-graph argmax). XLA updates the scan's
+        cache carry in place — the per-dispatch whole-slab copy that
+        JAX's CPU backend cannot elide (no buffer donation) is paid
+        once per BLOCK instead of once per token, which is where the
+        serving tier's throughput win over sequential `generate()`
+        comes from. In-graph `jnp.argmax` is the exact greedy program
+        `generate()` scans with (and equals host `np.argmax` on
+        identical logits bits — both first-max-wins), so a block
+        decodes bit-identically to k single steps. Returns
+        (toks [k, B] — one sampled token per step per row, new
+        cache). The caller only dispatches a block when no session
+        joins, leaves, expires, or samples within it."""
+        import jax.numpy as jnp
+
+        cache_dict = self._program_cache()
+        key_ = ("slot_scan", int(k), tuple(c.shape for c in cache),
+                jnp.asarray(cache[0]).dtype.name,
+                autograd._policy_key())
+        fn = cache_dict.get(key_)
+        if fn is None:
+            import jax
+
+            def scan_k(p, c, t, po):
+                def body(carry, _):
+                    c, t, po = carry
+                    logits, c = self._slot_step(p, c, t, po)
+                    t2 = jnp.argmax(logits, -1).astype(jnp.int32)
+                    return (c, t2, po + 1), t2
+
+                (c, _t, _po), toks = jax.lax.scan(
+                    body, (c, t, po), None, length=int(k))
+                return toks, c
+
+            jitted = jax.jit(scan_k)
+            args = (params, list(cache), tok, pos)
+            fn = self._aot_step(
+                "decode_scan", jitted, args,
+                extras={"slab": [list(c.shape) for c in cache],
+                        "block": int(k),
+                        "policy": autograd._policy_key()})
+            cache_dict[key_] = fn
+        return fn(params, list(cache), tok, pos)
+
+    def prefill_step(self, params, cache, ids, n_real):
+        """Prefill one session's bucket-padded prompt: run `ids`
+        [B, Pb] at positions 0..Pb-1, writing K/V into `cache`, and
+        return (logits at row n_real-1 — the REAL last prompt token —
+        [B, V], new cache). Pad rows beyond n_real do write K/V, but
+        the causal mask hides them from every real prompt row and the
+        decode steps overwrite slot p before any query can attend it,
+        so bucketed prefill is exact, not approximate. Compiled once
+        per (Pb, slab) shape; AOT-exported like decode_step."""
+        import jax.numpy as jnp
+
+        cache_dict = self._program_cache()
+        key_ = ("prefill", ids.shape, cache.shape,
+                jnp.asarray(cache).dtype.name, autograd._policy_key())
+        fn = cache_dict.get(key_)
+        if fn is None:
+            import jax
+
+            jitted = jax.jit(
+                lambda p, c, i, n: self._stack_step(
+                    p, i, c, 0, last_index=n - 1))
+            args = (params, cache, ids, n_real)
+            fn = self._aot_step(
+                "prefill_step", jitted, args,
+                extras={"prompt_bucket": list(ids.shape),
+                        "slab": list(cache.shape),
+                        "policy": autograd._policy_key()})
+            cache_dict[key_] = fn
+        return fn(params, cache, ids, n_real)
+
+    def prefill_slab(self, params, slab, ids, n_real, slots):
+        """Prefill a COHORT of bucket-padded prompts and scatter their
+        K/V into slab rows `slots` in a single program: `_stack_step`
+        runs `ids` [Bp, Pb] against a fresh Pb-wide cache materialised
+        in-graph, each row reads its own last real token's logits
+        (`n_real` [Bp] int32), and every layer's rows land in the slab
+        via one scatter. Param streaming — the dominant prefill cost
+        on memory-bound hosts — is paid once per cohort instead of
+        once per session, the same amortization the fused decode step
+        applies. The slab keeps its stale tail beyond Pb; decode
+        overwrites position p before any query attends it (see
+        `prefill_step`'s pad argument). `slots` [Bp] int32 is traced —
+        one executable per (Bp, Pb) serves every row assignment.
+        Returns (logits [Bp, V], new slab)."""
+        import jax.numpy as jnp
+
+        cache_dict = self._program_cache()
+        key_ = ("prefill_slab", ids.shape,
+                tuple(c.shape for c in slab),
+                jnp.asarray(slab[0]).dtype.name,
+                autograd._policy_key())
+        fn = cache_dict.get(key_)
+        if fn is None:
+            import jax
+
+            L = len(slab)
+            H = int(slab[0].shape[2])
+            D = int(slab[0].shape[4])
+
+            def pf(p, sl, i, n, s):
+                Bp, Pb = i.shape
+                c1 = jnp.zeros((L, 2, Bp, H, Pb, D), sl[0].dtype)
+                logits, c1 = self._stack_step(p, i, c1, 0,
+                                              last_index=n - 1)
+                new = [sl[li].at[:, s, :, :Pb, :].set(c1[li])
+                       for li in range(L)]
+                return logits, new
+
+            jitted = jax.jit(pf)
+            args = (params, list(slab), ids, n_real, slots)
+            fn = self._aot_step(
+                "prefill_slab", jitted, args,
+                extras={"prompt_bucket": list(ids.shape),
+                        "slab": [list(c.shape) for c in slab],
+                        "policy": autograd._policy_key()})
+            cache_dict[key_] = fn
+        return fn(params, list(slab), ids, n_real, slots)
+
+    def sample_fn(self, temperature, top_k):
+        """The EXACT sampling program generate() compiles (argmax when
+        temperature == 0, else temperature-scaled top-k categorical)
+        as a standalone jitted fn `(logits [B, V], key) -> tok [B]`.
+        The serving tier samples each session host-side with the same
+        `jax.random.split` sequence generate() traces, keeping
+        streamed tokens bit-identical to the sequential path."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        key_ = ("sample", float(temperature), int(top_k),
+                autograd._policy_key())
+        cache_dict = self._program_cache()
+        fn = cache_dict.get(key_)
+        if fn is not None:
+            return fn
+
+        def sample(logits, key):
+            if temperature == 0.0:
+                return jnp.argmax(logits, -1).astype(jnp.int32)
+            z = logits / temperature
+            if top_k > 0:
+                k = min(int(top_k), int(logits.shape[-1]))
+                kth = lax.top_k(z, k)[0][..., -1:]
+                z = jnp.where(z < kth, -jnp.inf, z)
+            return jax.random.categorical(key, z).astype(jnp.int32)
+
+        fn = jax.jit(sample)
+        cache_dict[key_] = fn
+        return fn
 
     def _shard_decode_params(self, params, mesh):
         """Lay the decode params out for tensor-parallel inference on
@@ -373,11 +713,17 @@ class TransformerLM(model.Model):
         L = len(params["blocks"])
         H = self.blocks._seq[0].attn.num_heads
         D = params["embed"].shape[-1] // H
-        # cache sized to the actual T = P + max_new (each (P, max_new)
-        # pair is its own compiled program via key_ anyway — the scan
-        # length is static — so padding to max_len would only make
-        # every decode step attend over unused slots)
-        cache = jnp.zeros((L, 2, B, H, T, D), params["embed"].dtype)
+        # cache seq dim rounded up to a power of two, NOT the exact
+        # T = P + max_new: pow2 reduction widths are mutually bitwise
+        # stable on XLA CPU (trailing masked slots contribute exact
+        # zeros in identical lane order), which is what lets the
+        # serving tier's shared decode slab (any pow2 >= T) reproduce
+        # generate()'s streams bit-for-bit. Odd widths vectorize with
+        # a remainder tail and drift in the last ulp. Not max_len:
+        # every decode step still attends only ~T slots.
+        t_alloc = 1 << (T - 1).bit_length()
+        cache = jnp.zeros((L, 2, B, H, t_alloc, D),
+                          params["embed"].dtype)
         run = self._compiled_decode(B, P, max_new_tokens, temperature,
                                     top_k)
         new = np.asarray(run(params, jnp.asarray(prompt_ids), cache,

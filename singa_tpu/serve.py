@@ -128,6 +128,8 @@ __all__ = [
     "get_config",
     "configure_resilience",
     "get_resilience_config",
+    "configure_decode",
+    "get_decode_config",
     "prewarm_forward",
     "submit_with_backoff",
     "terminal_counters",
@@ -317,6 +319,53 @@ def get_resilience_config() -> Dict:
 
 
 # ---------------------------------------------------------------------------
+# Decode-tier knobs (ISSUE 16; user-facing setter:
+# device.set_decode_serving). Engines snapshot these at construction.
+# ---------------------------------------------------------------------------
+_DECODE_CONFIG: Dict = {
+    # KV-slot pool size: how many decode sessions may be in flight at
+    # once (waiting-for-prefill + decoding). The pool IS admission
+    # control — no free slot => submit_decode sheds with
+    # ServeOverloadError + retry_after_ms.
+    "max_sessions": 8,
+    # Ceiling on per-session max_new_tokens (bounds the slab's seq
+    # dim together with the model's max_len).
+    "max_new_tokens": 64,
+    # Prefills per dispatcher cycle: new sessions prefill in their own
+    # dispatches BETWEEN fused decode steps (the prefill/decode
+    # split), and this caps how many, so a burst of long prompts
+    # never stalls the in-flight decode batch for more than one
+    # cycle's worth of prefill work.
+    "prefill_batch": 2,
+    # Run-ahead ceiling: up to this many fused steps dispatch as ONE
+    # scanned program (TransformerLM.decode_scan) when no session
+    # joins, leaves, expires, or samples inside the block. 1 disables
+    # run-ahead (every token is its own dispatch).
+    "decode_block": 8,
+}
+
+
+def configure_decode(**kw) -> Dict:
+    """Update decode-serving defaults (`max_sessions`,
+    `max_new_tokens`, `prefill_batch`, `decode_block`). User-facing
+    setter: `device.set_decode_serving`."""
+    for k, v in kw.items():
+        if k not in _DECODE_CONFIG:
+            raise KeyError(
+                f"unknown decode serving key {k!r}; known: "
+                f"{sorted(_DECODE_CONFIG)}")
+        v = int(v)
+        if v < 1:
+            raise ValueError(f"{k} must be >= 1")
+        _DECODE_CONFIG[k] = v
+    return dict(_DECODE_CONFIG)
+
+
+def get_decode_config() -> Dict:
+    return dict(_DECODE_CONFIG)
+
+
+# ---------------------------------------------------------------------------
 # Observability: cache_stats()["serve"]
 # ---------------------------------------------------------------------------
 class _ServeStats:
@@ -478,7 +527,8 @@ class ServeReply:
     passed mid-dispatch (counted `late`)."""
 
     __slots__ = ("_ev", "_wlock", "_value", "_error", "n", "t_submit",
-                 "t_reply", "state", "deadline_exceeded")
+                 "t_reply", "state", "deadline_exceeded", "_stream",
+                 "_stream_cv", "_stream_closed")
 
     def __init__(self, n: int):
         self._ev = threading.Event()
@@ -490,6 +540,12 @@ class ServeReply:
         self.deadline_exceeded = False
         self.t_submit = time.perf_counter()
         self.t_reply: Optional[float] = None
+        # Incremental token stream (decode-tier replies; ISSUE 16).
+        # Forward-tier replies never push — their stream just closes
+        # empty at delivery.
+        self._stream: List[int] = []
+        self._stream_cv = threading.Condition()
+        self._stream_closed = False
 
     def done(self) -> bool:
         return self._ev.is_set()
@@ -506,6 +562,46 @@ class ServeReply:
     def latency_s(self) -> Optional[float]:
         return (None if self.t_reply is None
                 else self.t_reply - self.t_submit)
+
+    # -- streaming (decode tier) ------------------------------------------
+    def tokens(self, timeout: Optional[float] = None):
+        """Iterate the session's generated tokens INCREMENTALLY, in
+        order, as the decode tier streams them — yields each token id
+        (int) as soon as its fused decode step lands, ending when the
+        session finishes. A failed session raises its stored error
+        AFTER yielding every token that was streamed before the
+        failure (the delivered prefix is real — it was produced by
+        completed decode steps — only the continuation is lost).
+        `timeout` bounds each wait for the NEXT token. The final
+        sequence of a completed session is bit-identical to
+        `result()`'s trailing `max_new_tokens` column block."""
+        i = 0
+        while True:
+            with self._stream_cv:
+                while (i >= len(self._stream)
+                       and not self._stream_closed):
+                    if not self._stream_cv.wait(timeout):
+                        raise TimeoutError(
+                            f"no decode token within {timeout}s "
+                            f"(state: {self.state})")
+                if i < len(self._stream):
+                    tok = self._stream[i]
+                else:  # closed and drained
+                    break
+            i += 1
+            yield tok
+        if self._error is not None:
+            raise self._error
+
+    def _push_token(self, tok: int) -> None:
+        with self._stream_cv:
+            self._stream.append(int(tok))
+            self._stream_cv.notify_all()
+
+    def _close_stream(self) -> None:
+        with self._stream_cv:
+            self._stream_closed = True
+            self._stream_cv.notify_all()
 
     # -- engine side -----------------------------------------------------
     def _deliver(self, value) -> bool:
@@ -524,7 +620,8 @@ class ServeReply:
             self._value = value
             self.state = "done"
             self._ev.set()
-            return True
+        self._close_stream()  # outside _wlock: fixed lock order
+        return True
 
     def _fail(self, err: BaseException) -> bool:
         with self._wlock:
@@ -534,7 +631,8 @@ class ServeReply:
             self._error = err
             self.state = "failed"
             self._ev.set()
-            return True
+        self._close_stream()
+        return True
 
 
 class _Request:
@@ -555,6 +653,42 @@ class _Request:
         # thread-local to the submitter
         self.trace = trace
         self.t_enqueue = time.perf_counter()
+
+
+class _DecodeSession:
+    """One admitted generative session in the decode tier (ISSUE 16).
+    Holds the host-side per-session state the continuous-batching loop
+    threads between fused steps: the sampling key at generate()'s
+    exact split position, the last sampled token (next step's input),
+    the absolute write position, and how many tokens remain. `slot` is
+    the session's row in the pooled cache slab (-1 while waiting for
+    prefill)."""
+
+    __slots__ = ("prompt", "n_new", "temperature", "top_k", "seed",
+                 "reply", "deadline", "trace", "key", "tok", "pos",
+                 "left", "slot", "toks", "t_enqueue", "t_last_tok",
+                 "idx")
+
+    def __init__(self, prompt: np.ndarray, n_new: int,
+                 temperature: float, top_k: int, seed: int, reply,
+                 deadline: Optional[float], trace, idx: int):
+        self.prompt = prompt            # [1, P] int32
+        self.n_new = n_new
+        self.temperature = temperature
+        self.top_k = top_k
+        self.seed = seed
+        self.reply = reply
+        self.deadline = deadline        # absolute perf_counter, or None
+        self.trace = trace              # (trace_id, parent_span_id)
+        self.idx = idx                  # per-engine session ordinal
+        self.key = None                 # jax PRNG key (set at prefill)
+        self.tok = 0                    # last sampled token id
+        self.pos = 0                    # next cache write position
+        self.left = n_new               # tokens still to produce
+        self.slot = -1                  # slab row (-1: not joined yet)
+        self.toks: List[int] = []       # produced tokens, in order
+        self.t_enqueue = time.perf_counter()
+        self.t_last_tok: Optional[float] = None  # TPOT span anchor
 
 
 def _pow2_ceil(n: int) -> int:
@@ -607,9 +741,14 @@ class ServingEngine:
                  drain_timeout_s: Optional[float] = None,
                  unhealthy_failures: Optional[int] = None,
                  health_file: Optional[str] = None,
-                 fault_injector=None):
+                 fault_injector=None,
+                 max_sessions: Optional[int] = None,
+                 max_new_tokens: Optional[int] = None,
+                 prefill_batch: Optional[int] = None,
+                 decode_block: Optional[int] = None):
         cfg = get_config()
         res = get_resilience_config()
+        dec = get_decode_config()
         self.model = model
         # Tuned-config default load (ISSUE 9): when the autotuner's
         # store (SINGA_TPU_TUNED_STORE / .tuned/) holds a best-known
@@ -745,6 +884,37 @@ class ServingEngine:
         # health state changes — the unhealthy -> ready transition the
         # acceptance test asserts reads from here.
         self.health_transitions: List = []
+        # -- decode tier (ISSUE 16): KV-slot pool + continuous batch --
+        self.max_sessions = int(max_sessions if max_sessions is not None
+                                else dec["max_sessions"])
+        self.decode_max_new = int(max_new_tokens
+                                  if max_new_tokens is not None
+                                  else dec["max_new_tokens"])
+        self.prefill_batch = int(prefill_batch
+                                 if prefill_batch is not None
+                                 else dec["prefill_batch"])
+        self.decode_block = int(decode_block
+                                if decode_block is not None
+                                else dec["decode_block"])
+        if (self.max_sessions < 1 or self.decode_max_new < 1
+                or self.prefill_batch < 1 or self.decode_block < 1):
+            raise ValueError("max_sessions, max_new_tokens, "
+                             "prefill_batch and decode_block must "
+                             "be >= 1")
+        self._dqueue: deque = deque()       # admitted, awaiting prefill
+        self._decode_live: Dict[int, _DecodeSession] = {}  # slot -> sess
+        self._decode_reserved = 0  # slots promised = queued + live
+        self._decode_lock = threading.Lock()
+        self._decode_have_work = threading.Event()
+        self._decode_thread: Optional[threading.Thread] = None
+        self._decode_running = False
+        self._slab = None               # pooled KV cache, built lazily
+        self._slab_free: List[int] = []  # free slab row indices
+        self._decode_params = None
+        self._decode_step_idx = 0       # fused-step ordinal (chaos key)
+        self._prefill_idx = 0           # admission ordinal (chaos key)
+        self._decode_session_idx = 0
+        self._ema_decode_step_s = 0.0   # feeds decode retry_after_ms
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> "ServingEngine":
@@ -813,7 +983,40 @@ class ServingEngine:
             _STATS.queue_depth = 0
         for req in victims:
             self._fail_request(req, ServeClosedError("engine stopped"))
+        self._stop_decode(drain_timeout_s)
         self._update_health()
+
+    def _stop_decode(self, drain_timeout_s: Optional[float]) -> None:
+        """Tear down the decode tier: stop the decode dispatcher, then
+        fail every waiting AND live session with `ServeClosedError`
+        (counted `failed` — the 4-equation reconciliation stays exact
+        through shutdown) and release their slots. Mid-stream sessions
+        keep the tokens already streamed; only the continuation is
+        lost, and loudly."""
+        with self._decode_lock:
+            self._decode_running = False
+        self._decode_have_work.set()
+        t, self._decode_thread = self._decode_thread, None
+        if t is not None:
+            timeout = (drain_timeout_s if drain_timeout_s is not None
+                       else self.drain_timeout_s)
+            t.join(timeout)
+        with self._decode_lock:
+            waiting = list(self._dqueue)
+            self._dqueue.clear()
+            live = list(self._decode_live.values())
+            self._decode_live.clear()
+            if self._slab is not None:
+                self._slab_free = list(range(
+                    int(self._slab[0].shape[1])))
+            self._decode_reserved = 0
+        dst = stats_mod.decode_stats()
+        for s in waiting + live:
+            if s.reply._fail(ServeClosedError("engine stopped")):
+                dst.failed += 1
+                if s.slot >= 0:
+                    dst.leaves += 1
+        dst.slots_in_use = 0
 
     def warmup(self, *arrays) -> int:
         """Execute the forward once per dispatchable bucket, padding
@@ -997,6 +1200,664 @@ class ServingEngine:
         """Synchronous submit+wait — one request's reply."""
         return self.submit(*arrays,
                            deadline_ms=deadline_ms).result(timeout)
+
+    # -- decode tier: admission (ISSUE 16) --------------------------------
+    def _estimate_decode_retry_ms(self) -> float:
+        """Overload back-off hint for a shed decode session: rolling
+        fused-step seconds × the fewest remaining tokens of any live
+        session — the earliest a slot can free. Called under
+        `_decode_lock`."""
+        per = self._ema_decode_step_s or self.max_wait_s or 1e-3
+        left = min((s.left for s in self._decode_live.values()),
+                   default=1)
+        return max(1.0, round(per * max(1, left) * 1e3, 3))
+
+    def submit_decode(self, prompt_ids, max_new_tokens: int,
+                      temperature: float = 0.0, top_k: int = 0,
+                      seed: int = 0,
+                      deadline_ms: Optional[float] = None) -> ServeReply:
+        """Enqueue one generative session (prompt [P] or [1, P] int
+        ids, extended by `max_new_tokens`) and return its `ServeReply`.
+        `reply.tokens()` streams each generated token as its fused
+        decode step lands; `reply.result()` blocks for the full
+        [1, P + max_new_tokens] array, bit-identical to
+        `model.generate()` with the same sampling config and seed.
+
+        Admission control IS the KV-slot pool: the engine holds
+        `max_sessions` cache slots, and a session is admitted only by
+        reserving one — queued + live sessions never exceed the pool,
+        so decode memory is bounded by construction. No free slot ⇒
+        `ServeOverloadError` with `retry_after_ms` (rolling step time ×
+        the soonest-finishing session), counted `shed` in
+        `cache_stats()["decode"]`. The slot frees on finish, expiry,
+        failure, or stop() — every admitted session lands in exactly
+        one of completed/failed/expired, and with shed the four
+        buckets reconcile: sessions == completed+failed+expired+shed.
+        """
+        prompt = np.asarray(prompt_ids, np.int32)
+        if prompt.ndim == 1:
+            prompt = prompt[None, :]
+        if prompt.ndim != 2 or prompt.shape[0] != 1:
+            raise ValueError(
+                f"decode prompt must be [P] or [1, P] token ids, got "
+                f"shape {prompt.shape} — sessions are single-sequence; "
+                "the engine fuses them across slots itself")
+        P = int(prompt.shape[1])
+        n_new = int(max_new_tokens)
+        if P < 1:
+            raise ValueError("decode prompt must be non-empty")
+        if n_new < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if n_new > self.decode_max_new:
+            raise ValueError(
+                f"max_new_tokens {n_new} exceeds the engine ceiling "
+                f"{self.decode_max_new} (device.set_decode_serving)")
+        model_max = int(getattr(self.model, "max_len", 0) or 0)
+        if model_max and P + n_new > model_max:
+            raise ValueError(
+                f"prompt {P} + max_new_tokens {n_new} exceeds the "
+                f"model's max_len {model_max}")
+        dl = deadline_ms if deadline_ms is not None else self.deadline_ms
+        if dl is not None and float(dl) <= 0:
+            raise ValueError("deadline_ms must be > 0")
+        deadline = (None if dl is None
+                    else time.perf_counter() + float(dl) / 1e3)
+        ctx = trace_mod.current_trace()
+        sess_trace = (None if ctx is None else
+                      (ctx["trace_id"],
+                       trace_mod.current_span_id() or ctx["parent"]))
+        dst = stats_mod.decode_stats()
+        with self._decode_lock:
+            # re-checked under the lock _stop_decode takes: past this
+            # point stop() is guaranteed to drain the decode queue
+            # once more, so an admitted session cannot strand
+            if not self._running:
+                raise ServeClosedError(
+                    "engine not running: call start()")
+            dst.sessions += 1
+            dst.slots = self.max_sessions
+            if self._decode_reserved >= self.max_sessions:
+                dst.shed += 1
+                raise ServeOverloadError(
+                    f"decode slot pool exhausted ({self.max_sessions} "
+                    "sessions reserved); retry after the hinted "
+                    "backoff",
+                    retry_after_ms=self._estimate_decode_retry_ms())
+            self._decode_reserved += 1
+            self._decode_session_idx += 1
+            reply = ServeReply(1)
+            sess = _DecodeSession(prompt, n_new, float(temperature),
+                                  int(top_k), int(seed), reply,
+                                  deadline, sess_trace,
+                                  self._decode_session_idx)
+            self._dqueue.append(sess)
+            need_thread = self._decode_thread is None
+            if need_thread:
+                self._decode_running = True
+                self._decode_thread = threading.Thread(
+                    target=self._decode_supervised_loop,
+                    name="singa_tpu-serve-decode", daemon=True)
+                self._decode_thread.start()
+        self._decode_have_work.set()
+        return reply
+
+    def warm_decode(self, prompt_lens=(), max_new_tokens=None) -> int:
+        """Pre-compile (or AOT-load, when the export_cache store is
+        armed) every decode-tier executable this engine can dispatch:
+        the fused `decode_step`, each pow2 `decode_scan` rung up to
+        `decode_block`, and a cohort prefill per (batch rung up to
+        `prefill_batch`, prompt bucket). Continuous batching admits
+        sessions MID-STREAM, so the first-ever cohort size or
+        run-ahead rung would otherwise pay its compile inside live
+        sessions' latency budget — call this before offering traffic.
+        `prompt_lens` are the raw prompt lengths expected (bucketed
+        exactly like submit_decode buckets them); `max_new_tokens`
+        sizes the slab's sequence rung (defaults to the engine
+        ceiling). Warm dispatches run real (cheap) programs against
+        the pooled slab and discard the results — pad prefill rows
+        carry an out-of-bounds slot, so nothing is written. Returns
+        the number of executables warmed."""
+        import jax.numpy as jnp
+
+        n_new = int(max_new_tokens if max_new_tokens is not None
+                    else self.max_new_tokens)
+        pol = self.policy
+
+        def bseq(n):
+            return (pol.bucket_seq(n)
+                    if pol.max_seq is not None and n <= pol.max_seq
+                    else _pow2_ceil(n))
+
+        pbs = sorted({bseq(max(1, int(p))) for p in prompt_lens})
+        if not pbs:
+            pbs = [bseq(1)]
+        need_t = max(pbs) + n_new
+        with self._decode_lock:
+            if self._slab is None:
+                geom = self._build_slab(need_t)
+            elif need_t > int(self._slab[0].shape[3]):
+                geom = self._grow_slab(need_t)
+            else:
+                geom = self._decode_geom()
+        params = geom[0]
+        model = self.model
+        Sb = int(self._slab[0].shape[1])
+        warmed = 0
+        tok = jnp.zeros(Sb, jnp.int32)
+        pos = jnp.zeros(Sb, jnp.int32)
+        lg, _ = model.decode_step(params, self._slab, tok, pos)
+        np.asarray(lg)
+        warmed += 1
+        ks = set()
+        k = 2
+        while k <= self.decode_block:
+            ks.add(k)
+            k <<= 1
+        if self.decode_block > 1:
+            ks.add(self.decode_block)  # its own rung when not pow2
+        for k in sorted(ks):
+            toks, _ = model.decode_scan(params, self._slab, tok, pos,
+                                        k)
+            np.asarray(toks)
+            warmed += 1
+        bmax = min(self.prefill_batch, Sb)
+        bmax = (pol.bucket_batch(bmax) if bmax <= pol.max_batch
+                else _pow2_ceil(bmax))
+        bb = 1
+        while bb <= bmax:
+            for pb in pbs:
+                ids = jnp.zeros((bb, pb), jnp.int32)
+                nv = jnp.ones(bb, jnp.int32)
+                sv = jnp.full(bb, Sb, jnp.int32)  # OOB: writes nothing
+                lg, _ = model.prefill_slab(params, self._slab, ids,
+                                           nv, sv)
+                np.asarray(lg)
+                warmed += 1
+            bb <<= 1
+        return warmed
+
+    # -- decode tier: the continuous-batching dispatcher ------------------
+    def _slab_seq_bucket(self, need_t: int) -> int:
+        """Sequence-dim bucket for the pooled slab: the PR 6 pow2
+        ladder (`policy.bucket_seq`), capped at the model's max_len
+        ceiling. Every rung is a power of two — the property that
+        keeps slab rows bitwise identical to `generate()` at ANY rung
+        (see `TransformerLM.generate`'s cache comment), so the slab
+        can start small and climb the ladder as longer sessions
+        arrive instead of paying max_len memory traffic per step."""
+        cap = _pow2_ceil(int(self.model.max_len))
+        pol = self.policy
+        if pol.max_seq is not None and need_t <= pol.max_seq:
+            return min(pol.bucket_seq(need_t), cap)
+        return min(_pow2_ceil(max(1, int(need_t))), cap)
+
+    def _decode_geom(self):
+        """(params, L, H, D, Sb, Tslab) read off the live slab."""
+        s0 = self._slab[0]
+        return (self._decode_params, len(self._slab),
+                int(s0.shape[2]), int(s0.shape[4]),
+                int(s0.shape[1]), int(s0.shape[3]))
+
+    def _build_slab(self, need_t: int):
+        """Allocate the pooled KV cache + the decode-tier executables'
+        static geometry. The cache is a PER-LAYER list of
+        [2, Sb, H, Tslab, D] buffers (one stacked [L, ...] array would
+        cost a full extra slab pass per layer inside the fused step —
+        see `TransformerLM._slot_step`). Batch slots ride the PR 6
+        bucket ladder (`policy.bucket_batch(max_sessions)`); the
+        sequence dim starts at the smallest ladder rung covering
+        `need_t` and grows via `_grow_slab`. Returns
+        (params, L, H, D, Sb, Tslab)."""
+        import jax.numpy as jnp
+
+        model = self.model
+        params = model._decode_params()
+        L = len(params["blocks"])
+        H = model.blocks._seq[0].attn.num_heads
+        D = int(params["embed"].shape[-1]) // H
+        Sb = (self.policy.bucket_batch(self.max_sessions)
+              if self.max_sessions <= self.policy.max_batch
+              else _pow2_ceil(self.max_sessions))
+        Tslab = self._slab_seq_bucket(need_t)
+        self._slab = [jnp.zeros((2, Sb, H, Tslab, D),
+                                params["embed"].dtype)
+                      for _ in range(L)]
+        self._slab_free = list(range(Sb))
+        self._decode_params = params
+        return params, L, H, D, Sb, Tslab
+
+    def _grow_slab(self, need_t: int):
+        """Climb the sequence ladder mid-stream: zero-pad every layer
+        buffer out to the next rung covering `need_t`. Live rows carry
+        their K/V across the copy unchanged, and because every rung is
+        pow2 their remaining tokens still decode bit-identically to
+        `generate()` — growth is invisible to in-flight streams.
+        Returns the refreshed geometry."""
+        import jax.numpy as jnp
+
+        old_t = int(self._slab[0].shape[3])
+        new_t = self._slab_seq_bucket(need_t)
+        if new_t > old_t:
+            pad = ((0, 0), (0, 0), (0, 0), (0, new_t - old_t), (0, 0))
+            self._slab = [jnp.pad(c, pad) for c in self._slab]
+        return self._decode_geom()
+
+    def _decode_free_slot(self, sess: "_DecodeSession") -> None:
+        """Return a session's slab row to the pool (lowest-index-first
+        reuse keeps slot assignment deterministic under a seeded
+        schedule). Called under `_decode_lock`."""
+        if sess.slot >= 0:
+            self._decode_live.pop(sess.slot, None)
+            self._slab_free.append(sess.slot)
+            self._slab_free.sort()
+            sess.slot = -1
+        self._decode_reserved -= 1
+
+    def _decode_finish(self, sess: "_DecodeSession", dst) -> None:
+        """Retire a finished session: deliver the full sequence (the
+        exact array `generate()` returns) and free the slot."""
+        out = np.concatenate(
+            [sess.prompt, np.asarray([sess.toks], np.int32)], axis=1)
+        if sess.reply._deliver(out):
+            dst.completed += 1
+        dst.retires += 1
+        if sess.slot >= 0:
+            dst.leaves += 1
+        with self._decode_lock:
+            self._decode_free_slot(sess)
+
+    def _decode_fail_session(self, sess: "_DecodeSession", dst,
+                             err: BaseException,
+                             expired: bool = False) -> None:
+        """Terminal decode failure: exactly one of expired/failed per
+        session (first write wins), slot freed either way."""
+        if sess.reply._fail(err):
+            if expired:
+                dst.expired += 1
+            else:
+                dst.failed += 1
+        if sess.slot >= 0:
+            dst.leaves += 1
+        with self._decode_lock:
+            self._decode_free_slot(sess)
+
+    def _decode_expire(self, dst) -> None:
+        """Expire sessions whose deadline passed — queued (before any
+        prefill capacity is spent) AND live mid-stream (the slot frees
+        for queued work; the streamed prefix stays delivered)."""
+        now = time.perf_counter()
+        victims: List[_DecodeSession] = []
+        with self._decode_lock:
+            for sess in list(self._dqueue):
+                if sess.deadline is not None and now >= sess.deadline:
+                    self._dqueue.remove(sess)
+                    victims.append(sess)
+            for sess in list(self._decode_live.values()):
+                if sess.deadline is not None and now >= sess.deadline:
+                    victims.append(sess)
+        for sess in victims:
+            self._decode_fail_session(sess, dst, ServeDeadlineError(
+                f"decode session expired after "
+                f"{(now - sess.t_enqueue) * 1e3:.1f} ms with "
+                f"{sess.left} of {sess.n_new} tokens left"),
+                expired=True)
+
+    def _decode_supervised_loop(self) -> None:
+        """`_decode_loop` under the same supervisor discipline as the
+        forward dispatcher: an escaping exception fails the LIVE
+        sessions loudly (their slab rows may be mid-step) and restarts
+        the loop, bounded by `max_restarts`."""
+        dst = stats_mod.decode_stats()
+        while True:
+            try:
+                self._decode_loop()
+                return  # clean exit (stop())
+            except BaseException as e:  # noqa: BLE001 — supervisor
+                with self._decode_lock:
+                    live = list(self._decode_live.values())
+                for sess in live:
+                    self._decode_fail_session(sess, dst,
+                                              ServeDispatchError(
+                        f"decode dispatcher died mid-stream: {e!r}"))
+                _STATS.restarts += 1
+                self._restarts += 1
+                if not self._decode_running:
+                    return
+                if self._restarts > self.max_restarts:
+                    with self._decode_lock:
+                        self._decode_running = False
+                        waiting = list(self._dqueue)
+                        self._dqueue.clear()
+                    for sess in waiting:
+                        self._decode_fail_session(sess, dst,
+                                                  ServeClosedError(
+                            f"decode dispatcher restarts exhausted "
+                            f"({self.max_restarts})"))
+                    return
+
+    def _decode_loop(self) -> None:
+        """Token-granularity continuous batching: every cycle expires
+        stale sessions, admits up to `prefill_batch` queued sessions
+        through ONE fused cohort prefill dispatch (bounded, so a burst
+        of prompts never stalls the decode batch for long), then
+        advances EVERY live session one token with ONE fused
+        `decode_step` over the pooled slab — sequences join and leave
+        the fused batch between steps, and a freed slot re-admits
+        queued work mid-stream."""
+        dst = stats_mod.decode_stats()
+        dst.slots = self.max_sessions
+        geom = None
+        while True:
+            with self._decode_lock:
+                has_work = bool(self._dqueue or self._decode_live)
+                running = self._decode_running
+            if not running:
+                return  # stop() fails the remaining sessions
+            if not has_work:
+                self._decode_have_work.wait(0.05)
+                self._decode_have_work.clear()
+                continue
+            self._decode_expire(dst)
+            # -- admit: ONE cohort prefill dispatch, bounded per cycle
+            cohort = []
+            while len(cohort) < self.prefill_batch:
+                with self._decode_lock:
+                    if not self._dqueue:
+                        break
+                    head = self._dqueue[0]
+                    P_h = int(head.prompt.shape[1])
+                    pol = self.policy
+                    Pb_h = (pol.bucket_seq(P_h)
+                            if pol.max_seq is not None
+                            and P_h <= pol.max_seq
+                            else _pow2_ceil(P_h))
+                    need_t = max(P_h + head.n_new, Pb_h)
+                    if self._slab is None:
+                        geom = self._build_slab(need_t)
+                    elif need_t > int(self._slab[0].shape[3]):
+                        geom = self._grow_slab(need_t)
+                    if not self._slab_free:
+                        break
+                    sess = self._dqueue.popleft()
+                    slot = self._slab_free.pop(0)
+                    self._prefill_idx += 1
+                    ordinal = self._prefill_idx
+                cohort.append((sess, slot, ordinal))
+            if cohort:
+                if geom is None:
+                    geom = self._decode_geom()
+                self._decode_prefill(cohort, geom, dst)
+            # -- one fused decode step over every live slot
+            with self._decode_lock:
+                live = sorted(self._decode_live.items())
+            if not live:
+                continue
+            self._decode_fused_step(live, geom, dst)
+
+    def _release_slot(self, slot: int) -> None:
+        """Return a slab row to the free pool (sorted, so admission
+        order stays deterministic)."""
+        with self._decode_lock:
+            self._slab_free.append(slot)
+            self._slab_free.sort()
+
+    def _decode_prefill(self, cohort, geom, dst) -> None:
+        """Admit a cohort of `(sess, slot, ordinal)` in ONE fused
+        prefill+scatter dispatch: every prompt is padded to the
+        cohort's widest pow2 bucket, run through `prefill_slab` (which
+        materialises the narrow cache in-graph, reads each row's real
+        last-token logits, and scatters every layer's rows into the
+        pooled slab), then each session samples its first token at
+        generate()'s exact key-split position and streams it — the
+        TTFT edge. Param streaming is paid once per cohort, not once
+        per session. Chaos `prefill_fail` is checked per session
+        BEFORE the dispatch, so a poisoned prompt fails ITS session
+        and the rest of the cohort still admits; a failure of the
+        fused dispatch itself fails the whole cohort (the batch shares
+        one program) but never the sessions already streaming."""
+        import jax
+        import jax.numpy as jnp
+
+        model = self.model
+        params = geom[0]
+        inj = self.fault_injector
+        pol = self.policy
+        members = []
+        for sess, slot, ordinal in cohort:
+            if inj is not None and inj.should("prefill_fail", ordinal):
+                self._release_slot(slot)
+                sess.slot = -1
+                self._decode_fail_session(sess, dst,
+                                          ServeDispatchError(
+                    f"decode prefill failed: injected prefill "
+                    f"failure (session {ordinal})"))
+                continue
+            members.append((sess, slot))
+        if not members:
+            return
+        # one bucket for the cohort: the widest member's pow2 rung.
+        # Prefilling a short prompt at a wider rung is exact — pad
+        # rows write K/V the causal mask hides and decode overwrites
+        # slot p before any query attends it (see prefill_slab).
+        Pb = 1
+        for sess, _ in members:
+            P = int(sess.prompt.shape[1])
+            Pb = max(Pb, (pol.bucket_seq(P)
+                          if pol.max_seq is not None and P <= pol.max_seq
+                          else _pow2_ceil(P)))
+        # bucket the cohort's batch dim on the pow2 ladder too — a
+        # cohort of every size 1..prefill_batch would otherwise compile
+        # its own executable (program-cache churn on every admission
+        # mix). Pad rows carry an OUT-OF-BOUNDS slot index: XLA scatter
+        # drops OOB updates, so a pad row touches nothing.
+        Bp = len(members)
+        Bb = (pol.bucket_batch(Bp) if Bp <= pol.max_batch
+              else _pow2_ceil(Bp))
+        n_slots = int(self._slab[0].shape[1])
+        ids = np.zeros((Bb, Pb), np.int32)
+        nvec = np.ones(Bb, np.int32)
+        slotv = np.full(Bb, n_slots, np.int32)  # OOB => dropped
+        for r, (sess, slot) in enumerate(members):
+            P = int(sess.prompt.shape[1])
+            ids[r, :P] = sess.prompt[0]
+            nvec[r] = P
+            slotv[r] = slot
+        t0 = time.perf_counter()
+        try:
+            logits, new_slab = model.prefill_slab(
+                params, self._slab, jnp.asarray(ids),
+                jnp.asarray(nvec), jnp.asarray(slotv))
+            lg = np.asarray(logits)
+        except BaseException as e:  # noqa: BLE001 — isolate: a failed
+            # cohort dispatch fails ITS members, never the sessions
+            # already streaming from the slab
+            for sess, slot in members:
+                self._release_slot(slot)
+                sess.slot = -1
+                self._decode_fail_session(sess, dst,
+                                          ServeDispatchError(
+                    f"decode prefill failed: {e!r}"))
+            return
+        self._slab = new_slab
+        now = time.perf_counter()
+        trace_mod.record_span("prefill", t0, now, rows=Bp, bucket=Pb)
+        for r, (sess, slot) in enumerate(members):
+            P = int(sess.prompt.shape[1])
+            if sess.temperature == 0.0:
+                # host argmax on identical float bits == the traced
+                # jnp.argmax (both first-max-wins): no extra dispatch
+                tok = int(np.argmax(lg[r]))
+            else:
+                sess.key = jax.random.PRNGKey(sess.seed)
+                sess.key, sub = jax.random.split(sess.key)
+                sampler = model.sample_fn(sess.temperature,
+                                          sess.top_k)
+                tok = int(np.asarray(
+                    sampler(jnp.asarray(lg[r:r + 1]), sub))[0])
+            sess.slot = slot
+            sess.tok = tok
+            sess.pos = P
+            sess.left = sess.n_new - 1
+            sess.toks.append(tok)
+            sess.reply.state = "dispatching"
+            sess.reply._push_token(tok)
+            sess.t_last_tok = now
+            trace_mod.record_span("ttft", sess.reply.t_submit, now,
+                                  trace=sess.trace, prompt=P)
+            dst.prefills += 1
+            dst.joins += 1
+            dst.tokens_streamed += 1
+            if sess.left == 0:
+                self._decode_finish(sess, dst)
+            else:
+                with self._decode_lock:
+                    self._decode_live[slot] = sess
+                    dst.slots_in_use = len(self._decode_live)
+
+    def _decode_run_ahead(self, live) -> int:
+        """How many fused steps may dispatch as ONE scanned block
+        (`decode_scan`) without delaying a join, leave, expiry, or
+        sampled token: capped by `decode_block` and every session's
+        remaining budget, collapsed to 1 whenever a session samples
+        (host-side key splits), carries a deadline (expiry is checked
+        between dispatches), or queued work could take a free slot.
+        The result is floored to a power of two so `decode_scan`
+        compiles one program per LADDER RUNG, not one per distinct
+        remaining-token count (the same churn-bounding argument as the
+        PR 6 shape buckets)."""
+        k = self.decode_block
+        for _, sess in live:
+            if sess.left < k:
+                k = sess.left
+            if sess.temperature != 0.0 or sess.deadline is not None:
+                return 1
+        if k > 1:
+            with self._decode_lock:
+                if self._dqueue and self._slab_free:
+                    return 1  # admission pending: stay token-granular
+        if k < 1:
+            return 1
+        if k == self.decode_block:
+            return k  # the configured block is its own ladder rung
+        return 1 << (int(k).bit_length() - 1)
+
+    def _decode_fused_step(self, live, geom, dst) -> None:
+        """ONE warm dispatch advancing every live slot — a single
+        `decode_step`, or a `decode_scan` block of up to
+        `decode_block` steps when `_decode_run_ahead` proves nothing
+        joins/leaves inside it — with the forward tier's
+        retry/backoff discipline. Tokens are streamed only AFTER the
+        dispatch completes and only from its output — a retried
+        dispatch recomputes from the UNCHANGED slab, so a delivered
+        stream is never torn or duplicated."""
+        import jax
+        import jax.numpy as jnp
+
+        from . import resilience
+
+        model = self.model
+        params = geom[0]
+        Sb = int(self._slab[0].shape[1])
+        tokv = np.zeros(Sb, np.int32)
+        posv = np.zeros(Sb, np.int32)
+        for slot, sess in live:
+            tokv[slot] = sess.tok
+            posv[slot] = sess.pos
+        k = self._decode_run_ahead(live)
+        inj = self.fault_injector
+        t0 = time.perf_counter()
+        attempt = 0
+        while True:
+            self._decode_step_idx += 1
+            idx = self._decode_step_idx
+            try:
+                if inj is not None and inj.should("decode_hang", idx):
+                    time.sleep(inj.hang_s)
+                if inj is not None and inj.should("decode_fail", idx):
+                    raise RuntimeError(
+                        f"injected decode step failure (step {idx})")
+                if k == 1:
+                    logits, new_slab = model.decode_step(
+                        params, self._slab, jnp.asarray(tokv),
+                        jnp.asarray(posv))
+                    lg = np.asarray(logits)  # completes the dispatch
+                    toks = None
+                else:
+                    toks_j, new_slab = model.decode_scan(
+                        params, self._slab, jnp.asarray(tokv),
+                        jnp.asarray(posv), k)
+                    toks = np.asarray(toks_j)  # [k, Sb]
+                break
+            except BaseException as e:  # noqa: BLE001 — retry below
+                if attempt >= self.max_retries:
+                    # retries exhausted: the fused step is the only
+                    # way forward for these sessions — fail them
+                    # loudly, free every slot for queued work
+                    for _, sess in live:
+                        self._decode_fail_session(sess, dst,
+                                                  ServeDispatchError(
+                            f"fused decode step failed after "
+                            f"{attempt} retries: {e!r}"))
+                    with self._decode_lock:
+                        dst.slots_in_use = len(self._decode_live)
+                    return
+                attempt += 1
+                time.sleep(resilience.backoff_delay_s(
+                    attempt, self.backoff_s,
+                    jitter=self.backoff_jitter,
+                    seed=self._jitter_seed))
+        self._slab = new_slab
+        block_s = time.perf_counter() - t0
+        step_s = block_s / k
+        self._ema_decode_step_s = (
+            step_s if not self._ema_decode_step_s
+            else 0.8 * self._ema_decode_step_s + 0.2 * step_s)
+        dst.decode_steps += k
+        trace_mod.record_span("decode_step", t0, t0 + block_s,
+                              rows=len(live), slots=Sb, steps=k)
+        now = time.perf_counter()
+        for slot, sess in live:
+            if toks is not None:
+                seq = [int(t) for t in toks[:, slot]]
+            elif sess.temperature == 0.0:
+                seq = [int(np.argmax(lg[slot]))]
+            else:
+                sess.key, sub = jax.random.split(sess.key)
+                sampler = model.sample_fn(sess.temperature,
+                                          sess.top_k)
+                seq = [int(np.asarray(
+                    sampler(jnp.asarray(lg[slot:slot + 1]), sub))[0])]
+            for tok in seq:
+                sess.toks.append(tok)
+                sess.reply._push_token(tok)
+                trace_mod.record_span("tpot", sess.t_last_tok, now,
+                                      trace=sess.trace)
+                sess.t_last_tok = now
+                dst.tokens_streamed += 1
+            sess.tok = seq[-1]
+            sess.pos += k
+            sess.left -= k
+            if sess.left == 0:
+                self._decode_finish(sess, dst)
+        with self._decode_lock:
+            nlive = len(self._decode_live)
+            qdepth = len(self._dqueue)
+            dst.slots_in_use = nlive
+        if self.metrics is not None:
+            try:
+                self.metrics.log_step(
+                    self._decode_step_idx,
+                    examples=len(live) * k,
+                    step_s=block_s, tier="decode",
+                    sessions=len(live), slots=Sb, block=k,
+                    slab_seq=int(self._slab[0].shape[3]),
+                    occupancy=round(len(live) / Sb, 4),
+                    queue_depth=qdepth,
+                    tokens_streamed=dst.tokens_streamed,
+                    completed=dst.completed, expired=dst.expired,
+                    shed=dst.shed, failed=dst.failed)
+            except Exception:
+                _STATS.errors += 1  # metrics stream closed mid-serve
 
     # -- dispatcher -------------------------------------------------------
     def _fail_request(self, req: _Request, err: BaseException,

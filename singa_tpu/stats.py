@@ -635,6 +635,68 @@ def count_accum_step() -> None:
     _ACCUM.accum_steps += 1
 
 
+class _DecodeStats:
+    """cache_stats()["decode"]: the KV-cache decode view (ISSUE 16) —
+    the compiled-program cache counters (`TransformerLM._gen_cache`
+    routes its TieredLRUCache through `self.cache`, so hits/misses/
+    evictions/retraces surface here) plus the serving tier's KV-slot
+    pool: session terminals (the fourth reconciliation equation,
+    sessions == completed + failed + expired + shed), per-step
+    join/leave/retire traffic, streamed-token volume, and the live
+    slot gauges. Counters reset with reset_cache_stats(); the slot
+    gauges describe the live pool and survive the reset."""
+
+    def __init__(self):
+        self.cache = CacheStats("decode")
+        self.reset()
+        self.slots = 0          # gauge: pool size (0 = no pool built)
+        self.slots_in_use = 0   # gauge: occupied right now
+
+    def reset(self) -> None:
+        self.cache.reset()
+        self.sessions = 0       # admitted decode sessions
+        self.completed = 0      # streamed every token, delivered
+        self.failed = 0         # dispatch/chaos failure mid-stream
+        self.expired = 0        # deadline hit mid-stream
+        self.shed = 0           # refused at admission: no free slot
+        self.joins = 0          # sessions entering the fused batch
+        self.leaves = 0         # sessions leaving (any terminal)
+        self.retires = 0        # slots freed back to the pool
+        self.tokens_streamed = 0
+        self.decode_steps = 0   # fused decode_step dispatches
+        self.prefills = 0       # prefill dispatches
+
+    def snapshot(self) -> Dict:
+        out = self.cache.snapshot()
+        out.update({
+            "sessions": self.sessions,
+            "completed": self.completed,
+            "failed": self.failed,
+            "expired": self.expired,
+            "shed": self.shed,
+            "joins": self.joins,
+            "leaves": self.leaves,
+            "retires": self.retires,
+            "tokens_streamed": self.tokens_streamed,
+            "decode_steps": self.decode_steps,
+            "prefills": self.prefills,
+            "slots": self.slots,
+            "slots_in_use": self.slots_in_use,
+        })
+        return out
+
+
+_DECODE = _DecodeStats()
+register_cache("decode", _DECODE)
+
+
+def decode_stats() -> "_DecodeStats":
+    """The live decode-tier stats object (`cache_stats()["decode"]`):
+    `TransformerLM` shares its `.cache` CacheStats; the serving slot
+    pool bumps the session/slot counters directly."""
+    return _DECODE
+
+
 def cache_stats() -> Dict:
     """Snapshot every registered cache's counters.
 

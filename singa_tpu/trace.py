@@ -927,7 +927,11 @@ FLEET_AGGREGATE_SCHEMA = 1
 # The per-segment latency decomposition: where a fleet request's time
 # goes, one bucket per span name on the request path.
 FLEET_SEGMENTS = ("queue_wait", "ipc", "dispatch", "reply", "route",
-                  "failover", "submit", "batch_assemble")
+                  "failover", "submit", "batch_assemble",
+                  # decode-tier SLO edges (ISSUE 16): time-to-first-
+                  # token and time-per-output-token — additive;
+                  # _segment_stats only emits names actually present
+                  "ttft", "tpot")
 
 
 def _segment_stats(spans) -> Dict[str, Dict]:

@@ -52,7 +52,6 @@ Counters: `cache_stats()["tuning"]`.
 from __future__ import annotations
 
 import hashlib
-import itertools
 import json
 import os
 import random
@@ -670,14 +669,36 @@ def propose(space: Optional[Dict] = None, budget: int = 16,
     if len(picks) > budget:
         picks = picks[:budget]
     elif len(picks) < budget:
-        full = [dict(zip(keys, vals))
-                for vals in itertools.product(*(sp[k] for k in keys))]
-        seen = {canonical(c) for c in picks}
-        rest = [c for c in full if canonical(c) not in seen]
+        # Random fill samples the cartesian product BY INDEX — the
+        # full space runs to millions of configs for the real KNOBS
+        # ladder, so materializing it (the old implementation) cost
+        # ~65 s per call. `random.sample` draws positions, not
+        # values, so sampling `range(n_rest)` and mixed-radix
+        # decoding each index yields the exact candidate list the
+        # materialized version produced for every (space, budget,
+        # seed) — determinism contract unchanged.
+        sizes = [len(sp[k]) for k in keys]
+        strides = [0] * len(keys)
+        acc = 1
+        for i in range(len(keys) - 1, -1, -1):
+            strides[i] = acc
+            acc *= sizes[i]
+        total = acc
+        seen_ix = sorted({
+            sum(sp[k].index(c[k]) * strides[i]
+                for i, k in enumerate(keys)) for c in picks})
         rng = random.Random(seed)
-        need = min(budget - len(picks), len(rest))
-        if need:
-            picks += rng.sample(rest, need)
+        need = min(budget - len(picks), total - len(seen_ix))
+        for j in rng.sample(range(total - len(seen_ix)), need):
+            # shift past the already-picked (single-flip) indices to
+            # land on the j-th REMAINING config in product order
+            for s in seen_ix:
+                if s <= j:
+                    j += 1
+                else:
+                    break
+            picks.append({k: sp[k][(j // strides[i]) % sizes[i]]
+                          for i, k in enumerate(keys)})
     if measured is not None:
         snapped = []
         seen = set()

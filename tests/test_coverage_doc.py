@@ -248,3 +248,28 @@ def test_fleet_tracing_row_and_readme_section_present():
     assert "ship_capacity" in readme
     assert "latency_breakdown" in readme
     assert "fleet_trace_overhead_pct" in readme
+
+
+def test_decode_serving_row_and_readme_section_present():
+    """ISSUE 16 doc contract: the P24 continuous-batching decode-tier
+    row and the README "Decode serving" section exist (KV-slot pool
+    admission, cohort prefill, run-ahead blocks, warm_decode, the 4th
+    reconciliation equation, TTFT/TPOT SLOs, knobs, bench gate)."""
+    cov = open(os.path.join(_ROOT, "COVERAGE.md")).read()
+    assert "| P24 |" in cov
+    assert "tests/test_serve_decode.py" in cov
+    assert "submit_decode" in cov
+    assert "prefill_slab" in cov
+    assert "warm_decode" in cov
+    assert "serve-decode" in cov
+    assert "set_decode_serving" in cov
+    readme = open(os.path.join(_ROOT, "README.md")).read()
+    assert "## Decode serving" in readme
+    assert "submit_decode" in readme
+    assert "retry_after_ms" in readme
+    assert "sessions == completed + failed + expired + shed" in readme
+    assert "warm_decode" in readme
+    assert "decode_block" in readme
+    assert "ttft" in readme and "tpot" in readme
+    assert "serve_decode_tokens_per_sec" in readme
+    assert "set_decode_serving" in readme

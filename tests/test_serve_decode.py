@@ -1,0 +1,345 @@
+"""Token-granularity continuous batching: the KV-cached decode tier
+(ISSUE 16).
+
+Acceptance pins:
+  - sessions JOIN and LEAVE the fused decode batch mid-stream (mixed
+    prompt lengths, staggered arrivals) and every delivered stream is
+    BIT-identical to `model.generate()` with the same sampling config
+    and seed — greedy and seeded sampling, run-ahead blocks and
+    single-step dispatch alike;
+  - admission control IS the KV-slot pool: no free slot ⇒
+    `ServeOverloadError` with a positive `retry_after_ms` hint, and
+    the session is admitted after a slot frees (mid-stream
+    re-admission);
+  - a mid-stream deadline expiry frees the slot and the 4th
+    reconciliation equation stays exact:
+    sessions == completed + failed + expired + shed;
+  - chaos soak (injected prefill/decode failures and hangs): zero
+    silent token loss — every DELIVERED stream is still bit-exact
+    (never torn, never duplicated), every failed session is counted,
+    and the reconciliation balances;
+  - `warm_decode()` precompiles the dispatch ladder (decode_step,
+    every run-ahead rung, every cohort prefill bucket) so mid-stream
+    admission never compiles inside a live session's latency budget.
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+from singa_tpu import device, resilience, serve, stats
+from singa_tpu.models.transformer import TransformerLM
+from singa_tpu import tensor
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+V, D, H, L = 64, 32, 2, 2
+MAXLEN = 16
+NEW = 5
+
+
+@pytest.fixture(autouse=True)
+def _clean_decode_config():
+    """Decode-serving defaults are process knobs; tracing is a
+    process arm — leaving either set would reroute later tests."""
+    saved = serve.get_decode_config()
+    yield
+    device.set_decode_serving(**saved)
+    device.set_tracing(False)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    """One tiny eval-compiled TransformerLM for the whole module —
+    decode executables cache on the model, so sharing it keeps the
+    per-test compile cost to the first user of each ladder rung."""
+    dev = device.get_default_device()
+    dev.SetRandSeed(0)
+    tensor.set_matmul_precision("default")
+    m = TransformerLM(V, d_model=D, num_heads=H, num_layers=L,
+                      max_len=MAXLEN)
+    m.compile([tensor.from_numpy(np.zeros((1, 4), np.int32),
+                                 device=dev)],
+              is_train=False, use_graph=False)
+    m.eval()
+    return m
+
+
+def _prompts(n, lens=(2, 3, 5)):
+    rs = np.random.RandomState(7)
+    return [rs.randint(0, V, (1, lens[i % len(lens)])).astype(np.int32)
+            for i in range(n)]
+
+
+def _decode_delta(fn):
+    """Run `fn` and return the decode-tier counter deltas."""
+    d0 = stats.decode_stats().snapshot()
+    out = fn()
+    d1 = stats.decode_stats().snapshot()
+    return out, {k: d1[k] - d0[k] for k in d1
+                 if isinstance(d1.get(k), (int, float))}
+
+
+def _reconciles(dd):
+    return dd["sessions"] == (dd["completed"] + dd["failed"]
+                              + dd["expired"] + dd["shed"])
+
+
+def test_join_leave_bit_identity_greedy(lm):
+    """Mixed prompt lengths + staggered arrivals: sessions join the
+    fused batch at different steps (forcing cohort prefills and slab
+    sequence-rung growth) and leave as they finish — every stream is
+    bit-identical to the sequential generate() program."""
+    prompts = _prompts(9)
+    want = [lm.generate(p, NEW) for p in prompts]
+    eng = serve.ServingEngine(lm, max_sessions=4, max_new_tokens=NEW,
+                              prefill_batch=4, decode_block=4).start()
+    try:
+        def run():
+            replies = []
+            for i, p in enumerate(prompts):
+                while True:
+                    try:
+                        replies.append(eng.submit_decode(p, NEW))
+                        break
+                    except serve.ServeOverloadError as e:
+                        time.sleep(e.retry_after_ms / 1e3)
+                if i % 3 == 2:
+                    time.sleep(0.01)  # stagger: join mid-stream
+            return [r.result(timeout=60) for r in replies]
+        got, dd = _decode_delta(run)
+    finally:
+        eng.stop()
+    for g, w in zip(got, want):
+        assert np.array_equal(np.asarray(g), w)
+    assert dd["completed"] == len(prompts)
+    assert _reconciles(dd)
+    # zero silent loss: every session streamed exactly NEW tokens
+    assert dd["tokens_streamed"] == len(prompts) * NEW
+
+
+def test_seeded_sampling_bit_identity(lm):
+    """Sampled sessions (temperature > 0, per-session seed) reproduce
+    generate()'s exact key schedule even when fused with OTHER
+    sessions: the per-row logits gather + host-side sampler keep the
+    PRNG stream per-session, not per-dispatch."""
+    prompts = _prompts(6)
+    want = [lm.generate(p, NEW, temperature=0.8, top_k=8, seed=i)
+            for i, p in enumerate(prompts)]
+    eng = serve.ServingEngine(lm, max_sessions=3, max_new_tokens=NEW,
+                              prefill_batch=2, decode_block=4).start()
+    try:
+        replies = []
+        for i, p in enumerate(prompts):
+            while True:
+                try:
+                    replies.append(eng.submit_decode(
+                        p, NEW, temperature=0.8, top_k=8, seed=i))
+                    break
+                except serve.ServeOverloadError as e:
+                    time.sleep(e.retry_after_ms / 1e3)
+        got = [r.result(timeout=60) for r in replies]
+    finally:
+        eng.stop()
+    for g, w in zip(got, want):
+        assert np.array_equal(np.asarray(g), w)
+
+
+def test_decode_block_one_single_step(lm):
+    """decode_block=1 (no run-ahead, one token per dispatch) is the
+    same program semantically: identical streams."""
+    prompts = _prompts(3)
+    want = [lm.generate(p, NEW) for p in prompts]
+    eng = serve.ServingEngine(lm, max_sessions=4, max_new_tokens=NEW,
+                              prefill_batch=4, decode_block=1).start()
+    try:
+        replies = [eng.submit_decode(p, NEW) for p in prompts]
+        got = [r.result(timeout=60) for r in replies]
+    finally:
+        eng.stop()
+    for g, w in zip(got, want):
+        assert np.array_equal(np.asarray(g), w)
+
+
+def test_streaming_tokens_iterator(lm):
+    """`reply.tokens()` streams exactly the generated suffix, in
+    order, as the fused steps land — the streaming surface carries
+    the same bits as the blocking result()."""
+    p = _prompts(1)[0]
+    want = lm.generate(p, NEW)[0, p.shape[1]:]
+    eng = serve.ServingEngine(lm, max_sessions=2, max_new_tokens=NEW,
+                              decode_block=2).start()
+    try:
+        reply = eng.submit_decode(p, NEW)
+        streamed = list(reply.tokens(timeout=60))
+    finally:
+        eng.stop()
+    assert streamed == [int(t) for t in want]
+
+
+def test_slot_exhaustion_sheds_then_readmits(lm):
+    """The KV-slot pool is the admission gate: with every slot
+    reserved a submit sheds loudly (ServeOverloadError carrying a
+    retry hint and counted `shed`), and the SAME session is admitted
+    once a slot frees — mid-stream re-admission."""
+    prompts = _prompts(3)
+    want2 = lm.generate(prompts[2], NEW)
+    eng = serve.ServingEngine(lm, max_sessions=2, max_new_tokens=NEW,
+                              decode_block=2).start()
+    try:
+        def run():
+            r0 = eng.submit_decode(prompts[0], NEW)
+            r1 = eng.submit_decode(prompts[1], NEW)
+            with pytest.raises(serve.ServeOverloadError) as ei:
+                eng.submit_decode(prompts[2], NEW)
+            assert ei.value.retry_after_ms > 0
+            r0.result(timeout=60)
+            r1.result(timeout=60)
+            # both slots are free again: re-admission succeeds
+            deadline = time.time() + 30
+            while True:
+                try:
+                    return eng.submit_decode(
+                        prompts[2], NEW).result(timeout=60)
+                except serve.ServeOverloadError as e:
+                    assert time.time() < deadline
+                    time.sleep(e.retry_after_ms / 1e3)
+        got, dd = _decode_delta(run)
+    finally:
+        eng.stop()
+    assert np.array_equal(np.asarray(got), want2)
+    assert dd["shed"] >= 1
+    assert _reconciles(dd)
+
+
+def test_mid_stream_expiry_frees_slot_and_reconciles(lm):
+    """A deadline that lands mid-stream expires the session LOUDLY
+    (ServeDeadlineError), frees its slot for queued work, and the
+    reconciliation equation stays exact — an expired session is
+    counted in exactly one terminal bucket."""
+    prompts = _prompts(2)
+    want1 = lm.generate(prompts[1], NEW)
+    eng = serve.ServingEngine(lm, max_sessions=1, max_new_tokens=NEW,
+                              decode_block=1).start()
+    try:
+        def run():
+            doomed = eng.submit_decode(prompts[0], NEW,
+                                       deadline_ms=0.01)
+            with pytest.raises((serve.ServeDeadlineError,
+                                TimeoutError)):
+                doomed.result(timeout=60)
+            # the slot is back: the next session is admitted and exact
+            deadline = time.time() + 30
+            while True:
+                try:
+                    return eng.submit_decode(
+                        prompts[1], NEW).result(timeout=60)
+                except serve.ServeOverloadError as e:
+                    assert time.time() < deadline
+                    time.sleep(e.retry_after_ms / 1e3)
+        got, dd = _decode_delta(run)
+    finally:
+        eng.stop()
+    assert np.array_equal(np.asarray(got), want1)
+    assert dd["expired"] == 1
+    assert dd["completed"] == 1
+    assert _reconciles(dd)
+
+
+def test_chaos_soak_zero_silent_token_loss(lm):
+    """Injected prefill failures, decode-step failures, and hangs:
+    every DELIVERED stream is still bit-exact (a retried block
+    recomputes from the unchanged slab — never torn, never
+    duplicated), every casualty is a LOUD error in a terminal
+    bucket, and the reconciliation balances."""
+    prompts = _prompts(12)
+    want = [lm.generate(p, NEW) for p in prompts]
+    inj = resilience.FaultInjector(seed=3, schedule={
+        "prefill_fail": 0.15,
+        "decode_fail": 0.15,
+        "decode_hang": 0.1,
+    }, hang_s=0.001)
+    eng = serve.ServingEngine(lm, max_sessions=4, max_new_tokens=NEW,
+                              prefill_batch=4, decode_block=2,
+                              max_retries=1, backoff_ms=0.1,
+                              max_restarts=100,
+                              fault_injector=inj).start()
+    try:
+        def run():
+            replies = []
+            for p in prompts:
+                while True:
+                    try:
+                        replies.append(eng.submit_decode(p, NEW))
+                        break
+                    except serve.ServeOverloadError as e:
+                        time.sleep(max(e.retry_after_ms, 0.1) / 1e3)
+            out = []
+            for r in replies:
+                try:
+                    out.append(r.result(timeout=60))
+                except (serve.ServeDispatchError,
+                        serve.ServeDeadlineError):
+                    out.append(None)
+            return out
+        got, dd = _decode_delta(run)
+    finally:
+        eng.stop()
+    delivered = sum(1 for g in got if g is not None)
+    for g, w in zip(got, want):
+        if g is not None:
+            assert np.array_equal(np.asarray(g), w)
+    assert delivered == dd["completed"]
+    assert dd["failed"] == len(prompts) - delivered
+    assert _reconciles(dd)
+    # accounting, not just identity: completed sessions streamed all
+    # their tokens; failed ones never smuggled a partial stream into
+    # a delivered result
+    assert delivered >= 1  # the soak must actually deliver something
+    assert dd["failed"] >= 1  # ... and actually injure something
+
+
+def test_warm_decode_precompiles_ladder(lm):
+    """warm_decode() builds the slab and compiles the dispatch ladder
+    up front (> 0 executables touched) and the engine serves
+    bit-exactly afterwards — admission never compiles mid-stream."""
+    p = _prompts(1)[0]
+    want = lm.generate(p, NEW)
+    eng = serve.ServingEngine(lm, max_sessions=4, max_new_tokens=NEW,
+                              prefill_batch=4, decode_block=4).start()
+    try:
+        warmed = eng.warm_decode(prompt_lens=(2, 3, 5),
+                                 max_new_tokens=NEW)
+        got = eng.submit_decode(p, NEW).result(timeout=60)
+    finally:
+        eng.stop()
+    assert warmed > 0
+    assert np.array_equal(np.asarray(got), want)
+
+
+def test_ttft_tpot_spans_under_tracing(lm):
+    """The decode tier emits the PR 15 SLO segments: one `ttft` span
+    per session (submit → first token) and `tpot` spans for the
+    inter-token gaps — the segments bench.py aggregates into p50/p99."""
+    from singa_tpu import trace as trace_mod
+
+    prompts = _prompts(3)
+    eng = serve.ServingEngine(lm, max_sessions=4, max_new_tokens=NEW,
+                              prefill_batch=4, decode_block=2).start()
+    try:
+        device.set_tracing(True, ring_capacity=4096)
+        trace_mod.clear()
+        replies = [eng.submit_decode(p, NEW) for p in prompts]
+        for r in replies:
+            r.result(timeout=60)
+        recs = trace_mod.records()
+    finally:
+        device.set_tracing(False)
+        eng.stop()
+    names = [r.get("name") for r in recs]
+    assert names.count("ttft") == len(prompts)
+    assert names.count("tpot") == len(prompts) * (NEW - 1)
+    seg = trace_mod._segment_stats(recs)
+    assert seg["ttft"]["count"] == len(prompts)
+    assert "p99_ms" in seg["tpot"]

@@ -224,6 +224,33 @@ def main():
                          f"(p50 {r.get('p50_ms')} ms/p99 "
                          f"{r.get('p99_ms')} ms{occ}{sx}{ch}"
                          + _stage_breakdown(r) + ")" + mark))
+        elif "serve_decode_tokens_per_sec" in r:
+            # continuous-batching decode tier (ISSUE 16): token-
+            # granularity serving throughput vs sequential generate()
+            # + TTFT/TPOT SLOs; loud MISMATCH on a bit-identity or
+            # reconciliation break. Old logs (no key) fold unchanged.
+            bad = ("" if r.get("streams_match", True)
+                   and r.get("counters_reconcile", True)
+                   and r.get("tokens_exact", True)
+                   else " MISMATCH")
+            occ = (f", occ {r['occupancy_mean']}"
+                   if "occupancy_mean" in r else "")
+            ch = ""
+            if isinstance(r.get("chaos"), dict):
+                c = r["chaos"]
+                cbad = ("" if c.get("streams_match", True)
+                        and c.get("counters_reconcile", True)
+                        else " MISMATCH")
+                ch = (f", chaos: {c.get('availability_pct')}% avail, "
+                      f"{c.get('failed', 0)} failed{cbad}")
+            rows.append((stage,
+                         f"{r['serve_decode_tokens_per_sec']:.0f} "
+                         f"tok/s  "
+                         f"(x{r.get('speedup_vs_sequential')} vs seq, "
+                         f"ttft p50 {r.get('ttft_p50_ms')} ms/p99 "
+                         f"{r.get('ttft_p99_ms')} ms, tpot p99 "
+                         f"{r.get('tpot_p99_ms')} ms{occ}{bad}{ch}"
+                         + _stage_breakdown(r) + ")" + mark))
         elif "pipeline_images_per_sec" in r:
             # multi-axis parallel stage (ISSUE 10): pipeline img/s +
             # measured-vs-analytic bubble, MoE tok/s + dropped

@@ -22,6 +22,15 @@
 #                                      ServingEngine's MetricsLogger
 #                                      stream carries.
 
+#   tools/tpu_watch.sh decode [DIR]    tail the NEWEST *decode*.jsonl under
+#                                      DIR and render the decode tier's
+#                                      per-dispatch record (fused sessions/
+#                                      slots, run-ahead block, slab seq
+#                                      rung, occupancy, queue depth) plus
+#                                      the session reconciliation counters
+#                                      the continuous-batching engine
+#                                      streams.
+
 #   tools/tpu_watch.sh fleet [DIR]     tail the NEWEST *fleet*.jsonl under
 #                                      DIR and render the FleetRouter's
 #                                      records: route events (replica
@@ -186,6 +195,55 @@ for line in sys.stdin:
     elif arm == "moe":
         bits.append(f"E={x.get('experts')} dropped "
                     f"{x.get('dropped_frac')}")
+    print("  ".join(bits))
+'
+  exit $?
+fi
+
+# NOTE: this block must stay ABOVE the serve flavor — serve's
+# *serve*.jsonl glob also matches bench_serve_decode.jsonl.
+if [ "$1" = "decode" ]; then
+  dir=${2:-metrics}
+  f=$(ls -t "$dir"/*decode*.jsonl 2>/dev/null | head -1)
+  if [ -z "$f" ]; then
+    echo "tpu_watch: no decode metrics JSONL under $dir/ yet" >&2
+    exit 1
+  fi
+  echo "tpu_watch: tailing $f" >&2
+  tail -n +1 -F "$f" | python3 -u -c '
+import json, sys
+
+def fmt(v, nd=3):
+    if v is None:
+        return "-"
+    return str(round(v, nd))
+
+for line in sys.stdin:
+    line = line.strip()
+    if not line:
+        continue
+    try:
+        r = json.loads(line)
+    except ValueError:
+        continue  # partial trailing line from a killed writer
+    if not isinstance(r, dict):
+        continue
+    x = r.get("extra") or {}
+    bits = [
+        "dispatch " + str(r.get("step", "?")).rjust(6),
+        "sess " + str(x.get("sessions", "-")) + "/" + str(x.get("slots", "-")),
+        "block " + fmt(x.get("block"), 0),
+        "seq " + fmt(x.get("slab_seq"), 0),
+        "occ " + fmt(x.get("occupancy"), 2),
+        "q " + fmt(x.get("queue_depth"), 0),
+        "tok/s " + fmt(r.get("examples_per_sec"), 0),
+        "toks " + fmt(x.get("tokens_streamed"), 0),
+    ]
+    # session reconciliation counters: completed + expired + shed +
+    # failed — streamed so the tail shows the balance moving live
+    for k in ("completed", "expired", "shed", "failed"):
+        if k in x:
+            bits.append(k + " " + fmt(x.get(k), 0))
     print("  ".join(bits))
 '
   exit $?
