@@ -43,3 +43,26 @@ def create(feats=32, hidden=32, classes=8, compile_batch=32,
     for p in m.param_tensors():
         p.data = jnp.round(p.data * 16.0) / 16.0
     return m
+
+
+def create_lm(vocab=64, d_model=32, num_heads=2, num_layers=2,
+              max_len=64, compile_prompt=4, seed=0, device_index=0):
+    """A compiled eval-mode `TransformerLM` for the decode tier
+    (ISSUE 17): same kwargs => bit-identical params in every process,
+    so a session's KV slab exported from one worker transplants into
+    another — and a stream resumed after migration (or re-prefilled
+    after a SIGKILL) continues bit-identically to the single-engine
+    `generate()`."""
+    from singa_tpu import device, tensor
+    from singa_tpu.models.transformer import TransformerLM
+
+    dev = device.create_replica_device(device_index)
+    dev.SetRandSeed(seed)
+    tensor.set_matmul_precision("default")
+    m = TransformerLM(vocab, d_model=d_model, num_heads=num_heads,
+                      num_layers=num_layers, max_len=max_len)
+    m.compile([tensor.from_numpy(
+        np.zeros((1, compile_prompt), np.int32), device=dev)],
+        is_train=False, use_graph=False)
+    m.eval()
+    return m

@@ -66,6 +66,22 @@ quiescence (every returned future resolved), checked by
                      + fleet.rejected  (every router future resolves
                      into exactly one terminal bucket)
 
+Fleet decode serving (ISSUE 17): `submit_decode` routes generative
+sessions with SESSION AFFINITY (sticky by session_id while the sticky
+replica has a free KV slot) over occupancy-aware placement (most free
+KV slots from the same health surface heartbeats ship, ties by least
+depth). Each session's `FleetDecodeReply` is a stream proxy whose pump
+thread survives the replica underneath changing: `drain(name)`
+checkpoints live sessions (`export_decode_sessions` -> the PR 13 wire's
+MIGRATE frame) and the proxy resumes each checkpoint on another
+replica mid-stream, bit-identically (KV transplant); a SIGKILLed
+replica's sessions re-prefill from the proxy's delivered token ledger
+(correctness first — migration is only the fast path). Never a torn
+or duplicated token: resumed streams re-play the ledger prefix and
+the proxy verifies it against what it already delivered. The PR 16
+session equation (sessions == completed + failed + expired + shed)
+joins `reconcile` fleet-wide via the decode0/decode1 snapshots.
+
 `Replica` is a small duck-typed protocol (start/kill/restart/submit/
 health/depth/...) so a later multi-process transport slots in without
 touching the routing logic; `EngineReplica` is the in-process
@@ -90,6 +106,7 @@ from .serve import (
     ServeClosedError,
     ServeDeadlineError,
     ServeDispatchError,
+    ServeMigratedError,
     ServeOverloadError,
     ServePoisonedError,
     ServeQueueFullError,
@@ -99,6 +116,7 @@ from .serve import (
 __all__ = [
     "FleetRouter",
     "FleetReply",
+    "FleetDecodeReply",
     "EngineReplica",
     "FleetUnavailableError",
     "configure",
@@ -224,6 +242,25 @@ class _FleetStats:
         self.failovers = 0
         self.refused = 0
         self.shed_retries = 0
+        # decode-tier sessions (ISSUE 17): router terminals mirror the
+        # forward family (every FleetDecodeReply resolves into exactly
+        # one of decode_replies/decode_failed; a submit_decode that
+        # never produced a future counts decode_rejected), and the
+        # placement attempts split by WHY the session moved —
+        # decode_routed (fresh placements), decode_migrations (planned
+        # checkpoint hand-offs: drain shipped a `ServeMigratedError`
+        # and the stream proxy resumed it elsewhere), decode_replays
+        # (unplanned: the replica died mid-stream and the proxy
+        # re-prefilled from its delivered token ledger on another one)
+        self.decode_requests = 0
+        self.decode_replies = 0
+        self.decode_failed = 0
+        self.decode_rejected = 0
+        self.decode_routed = 0
+        self.decode_migrations = 0
+        self.decode_replays = 0
+        self.decode_refused = 0
+        self.decode_shed_retries = 0
         # rotation events
         self.ejections = 0
         self.rejoins = 0
@@ -251,6 +288,15 @@ class _FleetStats:
             "failovers": self.failovers,
             "refused": self.refused,
             "shed_retries": self.shed_retries,
+            "decode_requests": self.decode_requests,
+            "decode_replies": self.decode_replies,
+            "decode_failed": self.decode_failed,
+            "decode_rejected": self.decode_rejected,
+            "decode_routed": self.decode_routed,
+            "decode_migrations": self.decode_migrations,
+            "decode_replays": self.decode_replays,
+            "decode_refused": self.decode_refused,
+            "decode_shed_retries": self.decode_shed_retries,
             "ejections": self.ejections,
             "rejoins": self.rejoins,
             "restarts": self.restarts,
@@ -274,8 +320,9 @@ def fleet_stats() -> _FleetStats:
 
 
 def reconcile(serve0: Dict, serve1: Dict, fleet0: Dict,
-              fleet1: Dict, replicas: Optional[Sequence] = None
-              ) -> Dict:
+              fleet1: Dict, replicas: Optional[Sequence] = None,
+              decode0: Optional[Dict] = None,
+              decode1: Optional[Dict] = None) -> Dict:
     """Check the three zero-silent-loss equations over a
     (before, after) window of `cache_stats()["serve"]` /
     `cache_stats()["fleet"]` snapshots. Exact integer equality — one
@@ -291,13 +338,32 @@ def reconcile(serve0: Dict, serve1: Dict, fleet0: Dict,
     `reconcile_transport` — and fold its verdict into `ok`: every
     admitted request either produced a frame that arrived or was
     swept into `failed` when its worker generation died (a
-    killed-in-flight request can land in failover, never vanish)."""
+    killed-in-flight request can land in failover, never vanish).
+
+    Pass `decode0`/`decode1` (`cache_stats()["decode"]` snapshots) to
+    ALSO check the decode tier fleet-wide (ISSUE 17). Two more exact
+    equations join the report:
+
+      decode sessions    sessions == completed + failed + expired +
+                         shed  (the PR 16 per-engine equation — the
+                         parent mirrors every remote session, exports
+                         net to zero once the session resumes, so the
+                         SAME equation holds across the whole fleet at
+                         quiescence, SIGKILLs and migrations included)
+      decode terminals   fleet.decode_requests == decode_replies +
+                         decode_failed + decode_rejected (every
+                         `FleetDecodeReply` resolves exactly once)
+    """
     sd = {k: serve1[k] - serve0[k] for k in
           ("requests", "replies", "expired", "shed", "dropped",
            "overflowed", "failed")}
     fd = {k: fleet1[k] - fleet0[k] for k in
           ("requests", "replies", "failed", "rejected", "routed",
            "failovers", "refused")}
+    fdd = {k: fleet1.get(k, 0) - fleet0.get(k, 0) for k in
+           ("decode_requests", "decode_replies", "decode_failed",
+            "decode_rejected", "decode_routed", "decode_migrations",
+            "decode_replays", "decode_refused")}
     engine_ok = sd["requests"] == (sd["replies"] + sd["expired"]
                                    + sd["shed"] + sd["dropped"]
                                    + sd["overflowed"] + sd["failed"])
@@ -313,6 +379,22 @@ def reconcile(serve0: Dict, serve1: Dict, fleet0: Dict,
         "serve_delta": sd,
         "fleet_delta": fd,
     }
+    decode_router_ok = fdd["decode_requests"] == (
+        fdd["decode_replies"] + fdd["decode_failed"]
+        + fdd["decode_rejected"])
+    out["decode_router_terminals"] = bool(decode_router_ok)
+    out["fleet_decode_delta"] = fdd
+    out["ok"] = bool(out["ok"] and decode_router_ok)
+    if decode0 is not None and decode1 is not None:
+        dd = {k: decode1[k] - decode0[k] for k in
+              ("sessions", "completed", "failed", "expired", "shed",
+               "migrated", "resumed")}
+        decode_sessions_ok = dd["sessions"] == (
+            dd["completed"] + dd["failed"] + dd["expired"]
+            + dd["shed"])
+        out["decode_sessions"] = bool(decode_sessions_ok)
+        out["decode_delta"] = dd
+        out["ok"] = bool(out["ok"] and decode_sessions_ok)
     if replicas is not None:
         tr = reconcile_transport(replicas)
         out["transport"] = tr["ok"]
@@ -328,18 +410,28 @@ def reconcile_transport(replicas: Sequence) -> Dict:
       parent terminals   sent == delivered + err_replies +
                          transport_failed  (every admitted IPC
                          request resolved into exactly one parent-side
-                         outcome; pending must be 0)
-      generation ledger  admitted == frames + swept  (every admitted
-                         request either produced a reply/error frame
-                         that arrived, or was swept into `failed`
-                         when its generation died — the kill-time
-                         accounting)
+                         outcome; pending must be 0), and the decode
+                         LANE likewise: decode_sent ==
+                         decode_delivered + decode_err_replies +
+                         decode_transport_failed + migrated_out (a
+                         migrated session is an outcome too — it left
+                         on a MIGRATE frame to resume elsewhere)
+      generation ledger  admitted == frames + swept + migrated (every
+                         admitted request either produced a
+                         reply/error frame that arrived, was swept
+                         into `failed` when its generation died — the
+                         kill-time accounting — or left on a MIGRATE
+                         frame)
       worker handshake   for generations that drained CLEANLY (the
                          BYE frame carries the worker's final
                          counters): the worker's own engine-terminal
-                         equation holds on the shipped snapshot — the
-                         cross-process proof that the worker lost
-                         nothing internally either.
+                         equation holds on the shipped snapshot — and
+                         when the handshake carries decode-session
+                         books, the 4-equation decode reconciliation
+                         (sessions == completed + failed + expired +
+                         shed) holds on them too — the cross-process
+                         proof that the worker lost nothing
+                         internally either.
 
     Replicas without a `transport_snapshot` (in-process
     `EngineReplica`s) are skipped — their accounting is already the
@@ -351,13 +443,20 @@ def reconcile_transport(replicas: Sequence) -> Dict:
         if snap_fn is None:
             continue
         t = snap_fn()
+        dec = t.get("decode") or {}
         parent_ok = (t["pending"] == 0
                      and t["sent"] == (t["delivered"] + t["err_replies"]
-                                       + t["transport_failed"]))
+                                       + t["transport_failed"])
+                     and dec.get("sent", 0) == (
+                         dec.get("delivered", 0)
+                         + dec.get("err_replies", 0)
+                         + dec.get("transport_failed", 0)
+                         + dec.get("migrated_out", 0)))
         gens_ok = True
         hands_ok = True
         for g, gen in t["generations"].items():
-            if gen["admitted"] != gen["frames"] + gen["swept"]:
+            if gen["admitted"] != (gen["frames"] + gen["swept"]
+                                   + gen.get("migrated", 0)):
                 gens_ok = False
             h = gen["handshake"]
             if gen["clean"] and h:
@@ -366,6 +465,11 @@ def reconcile_transport(replicas: Sequence) -> Dict:
                                       + wt["shed"] + wt["dropped"]
                                       + wt["overflowed"]
                                       + wt["failed"]):
+                    hands_ok = False
+                wd = h.get("decode")
+                if wd and wd["sessions"] != (
+                        wd["completed"] + wd["failed"]
+                        + wd["expired"] + wd["shed"]):
                     hands_ok = False
         r_ok = bool(parent_ok and gens_ok and hands_ok)
         per[r.name] = {"ok": r_ok, "parent_terminals": bool(parent_ok),
@@ -524,9 +628,21 @@ class EngineReplica:
     def drain_stop(self) -> None:
         """Drain semantics for the router: stop admitting, let the
         in-flight dispatch finish, fail the still-queued futures so
-        the router reroutes them (`ServeClosedError` -> failover)."""
+        the router reroutes them (`ServeClosedError` -> failover).
+        Live decode sessions are CHECKPOINTED first
+        (`export_decode_sessions`, ISSUE 17): each one's reply fails
+        `ServeMigratedError` carrying the portable checkpoint, which
+        the fleet stream proxy resumes on another replica with zero
+        token loss — a drain migrates sessions, it never kills them."""
         eng = self.engine
         if eng is not None:
+            try:
+                eng.export_decode_sessions()
+            except Exception:
+                # export is the FAST path only: if it fails, stop()
+                # fails the sessions `ServeClosedError` and the proxy
+                # replays each from its delivered token ledger
+                pass
             eng.stop(drain=False, drain_timeout_s=1.0)
 
     def restart(self) -> "EngineReplica":
@@ -561,6 +677,32 @@ class EngineReplica:
         if eng is None:
             raise ServeClosedError(f"replica {self.name} not started")
         return eng.warmup(*arrays)
+
+    # -- decode tier (ISSUE 17) -------------------------------------------
+    def submit_decode(self, prompt_ids, max_new_tokens: int,
+                      temperature: float = 0.0, top_k: int = 0,
+                      seed: int = 0,
+                      deadline_ms: Optional[float] = None):
+        eng = self.engine
+        if eng is None or self.killed:
+            raise ServeClosedError(f"replica {self.name} is dead")
+        return eng.submit_decode(prompt_ids, max_new_tokens,
+                                 temperature=temperature, top_k=top_k,
+                                 seed=seed, deadline_ms=deadline_ms)
+
+    def resume_decode(self, ckpt: Dict):
+        eng = self.engine
+        if eng is None or self.killed:
+            raise ServeClosedError(f"replica {self.name} is dead")
+        return eng.resume_decode(ckpt)
+
+    def warm_decode(self, prompt_lens=(), max_new_tokens=None,
+                    samplers=()) -> int:
+        eng = self.engine
+        if eng is None:
+            raise ServeClosedError(f"replica {self.name} not started")
+        return eng.warm_decode(prompt_lens, max_new_tokens,
+                               samplers=samplers)
 
     # -- health/load signals ----------------------------------------------
     def health(self) -> Dict:
@@ -800,6 +942,263 @@ class FleetReply:
 
 
 # ---------------------------------------------------------------------------
+# The fleet decode stream proxy (ISSUE 17)
+# ---------------------------------------------------------------------------
+class FleetDecodeReply:
+    """Future + token stream for one fleet decode SESSION. The caller
+    holds THIS object for the session's whole life; which replica is
+    generating underneath changes — planned migration on `drain()`,
+    ledger replay after a SIGKILL — without the stream ever tearing,
+    duplicating, or going quiet unannounced.
+
+    One pump thread per session transfers the current inner
+    `ServeReply`'s tokens into the proxy stream, de-duplicated BY
+    COUNT: a resumed inner reply re-plays the ledger prefix first
+    (`resume_decode`'s contract), the pump skips tokens it already
+    delivered, and every skipped token is ASSERTED equal to what was
+    delivered — a checkpoint that diverges from the delivered prefix
+    is the exact torn-stream corruption the chaos invariant forbids,
+    and it fails the session loudly rather than silently forking it.
+
+    Re-placement, in the pump (never in the caller's wait):
+
+      `ServeMigratedError`  — planned hand-off: the source replica
+          drained and shipped a checkpoint; resume it elsewhere (KV
+          transplant, the fast path). Does NOT consume the failover
+          budget (a drain is an operator action, not a failure), but
+          is bounded at `max_failover_hops + fleet size` hand-offs so
+          a rolling drain of everything cannot ping-pong forever.
+      `ServeDispatchError` / `ServeClosedError`  — the replica died
+          mid-stream (SIGKILL): re-prefill from the proxy's OWN
+          delivered token ledger (`kv=None` — correctness first,
+          migration is only the fast path) on another replica, up to
+          `max_failover_hops` hops.
+      `ServePoisonedError` / `ServeDeadlineError`  — terminal by
+          contract, exactly like the forward tier.
+
+    Exactly one terminal outcome is counted into
+    `cache_stats()["fleet"]` (`decode_replies`/`decode_failed`) per
+    session. `tokens()` / `result()` match `ServeReply`'s surface; a
+    completed session's full sequence is bit-identical to the
+    single-engine `generate()` with the same prompt, sampling config
+    and seed, however many replicas it crossed."""
+
+    __slots__ = ("_router", "session_id", "_inner", "replica", "hops",
+                 "migrations", "_tried", "_params", "_stream",
+                 "_stream_cv", "_stream_closed", "_ev", "_value",
+                 "_error", "t_submit", "t_reply", "trace", "_pump")
+
+    def __init__(self, router: "FleetRouter", session_id: str, inner,
+                 replica: str, trace: Optional[str], params: Dict):
+        self._router = router
+        self.session_id = session_id
+        self._inner = inner
+        self.replica = replica
+        self.hops = 0          # unplanned re-placements (replays)
+        self.migrations = 0    # planned hand-offs (drain checkpoints)
+        self._tried = {replica}
+        self._params = params
+        self._stream: List[int] = []
+        self._stream_cv = threading.Condition()
+        self._stream_closed = False
+        self._ev = threading.Event()
+        self._value = None
+        self._error: Optional[BaseException] = None
+        self.t_submit = time.perf_counter()
+        self.t_reply: Optional[float] = None
+        self.trace = trace
+        self._pump: Optional[threading.Thread] = None
+
+    # -- caller surface (mirrors ServeReply) ------------------------------
+    def done(self) -> bool:
+        return self._ev.is_set()
+
+    @property
+    def state(self) -> str:
+        if self._ev.is_set():
+            return "failed" if self._error is not None else "done"
+        return f"{self._inner.state}@{self.replica}"
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        return (None if self.t_reply is None
+                else self.t_reply - self.t_submit)
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._ev.wait(timeout):
+            raise TimeoutError(
+                f"fleet decode session not finished (state: "
+                f"{self.state})")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+    def tokens(self, timeout: Optional[float] = None):
+        """Iterate the session's generated tokens in order as they
+        stream — across migrations and replays, one seamless gapless
+        sequence. A failed session raises its error AFTER yielding
+        every delivered token; `timeout` bounds each wait for the
+        NEXT token."""
+        i = 0
+        while True:
+            with self._stream_cv:
+                while (i >= len(self._stream)
+                       and not self._stream_closed):
+                    if not self._stream_cv.wait(timeout):
+                        raise TimeoutError(
+                            f"no decode token within {timeout}s "
+                            f"(state: {self.state})")
+                if i < len(self._stream):
+                    tok = self._stream[i]
+                else:
+                    break
+            i += 1
+            yield tok
+        if self._error is not None:
+            raise self._error
+
+    # -- pump internals ----------------------------------------------------
+    def _start_pump(self) -> None:
+        self._pump = threading.Thread(
+            target=self._pump_loop,
+            name=f"singa_tpu-fleet-decode-{self.session_id}",
+            daemon=True)
+        self._pump.start()
+
+    def _ingest(self, i: int, tok: int) -> None:
+        """Deliver the current inner reply's i-th token. `i` below the
+        delivered count is a resumed ledger re-play: skip it, but
+        VERIFY it — prefix divergence is a torn stream."""
+        with self._stream_cv:
+            if i < len(self._stream):
+                if self._stream[i] != tok:
+                    raise RuntimeError(
+                        f"torn decode stream for session "
+                        f"{self.session_id}: resumed replica "
+                        f"{self.replica} re-played token {i} as {tok} "
+                        f"but {self._stream[i]} was already delivered "
+                        "— checkpoint diverged from the delivered "
+                        "prefix")
+                return
+            self._stream.append(int(tok))
+            self._stream_cv.notify_all()
+
+    def _finish(self, value, err: Optional[BaseException]) -> None:
+        self._value = value
+        self._error = err
+        self.t_reply = time.perf_counter()
+        with self._stream_cv:
+            self._stream_closed = True
+            self._stream_cv.notify_all()
+        self._ev.set()
+        if err is None:
+            _STATS.decode_replies += 1
+        else:
+            _STATS.decode_failed += 1
+
+    def _replay_ckpt(self) -> Dict:
+        """Build a resume checkpoint from what THIS proxy delivered —
+        the only state guaranteed to survive a SIGKILLed replica. KV
+        stays None: the target re-prefills prompt + ledger, which is
+        bit-identical to the lost slab by construction."""
+        with self._stream_cv:
+            led = list(self._stream)
+        p = self._params
+        rem = None
+        if p["deadline_abs"] is not None:
+            rem = (p["deadline_abs"] - time.perf_counter()) * 1e3
+            if rem <= 0:
+                raise ServeDeadlineError(
+                    f"decode session {self.session_id} deadline "
+                    f"passed during re-placement with {len(led)} of "
+                    f"{p['n_new']} tokens delivered")
+        return {"prompt": p["prompt"],
+                "toks": np.asarray(led, np.int32),
+                "n_new": p["n_new"],
+                "temperature": p["temperature"],
+                "top_k": p["top_k"],
+                "seed": p["seed"],
+                "deadline_ms_left": rem,
+                "kv": None}
+
+    def _re_place(self, ckpt: Dict, planned: bool,
+                  err: Optional[BaseException] = None) -> None:
+        t0 = time.perf_counter()
+        with trace_mod.context(self.trace):
+            inner, name = self._router._route_decode(
+                lambda h: h.resume_decode(ckpt),
+                exclude={self.replica}, resume=True)
+        if planned:
+            self.migrations += 1
+            _STATS.decode_migrations += 1
+        else:
+            _STATS.decode_replays += 1
+        self._tried.add(name)
+        self.replica = name
+        self._inner = inner
+        self._router._set_affinity(self.session_id, name)
+        trace_mod.record_span(
+            "decode_migrate" if planned else "decode_replay",
+            t0, time.perf_counter(), trace=self.trace, to=name,
+            session=self.session_id,
+            delivered=int(np.asarray(ckpt["toks"]).size),
+            error=None if err is None else repr(err))
+
+    def _pump_loop(self) -> None:
+        from .resilience import annotate_exception
+
+        while True:
+            inner = self._inner
+            i = 0
+            try:
+                for tok in inner.tokens():
+                    self._ingest(i, int(tok))
+                    i += 1
+                self._finish(inner.result(0.0), None)
+                return
+            except ServeMigratedError as e:
+                ckpt = e.ckpt
+                cap = (self._router.max_failover_hops
+                       + len(self._router._slots))
+                try:
+                    if self.migrations >= cap:
+                        raise FleetUnavailableError(
+                            f"decode session {self.session_id} "
+                            f"migrated {self.migrations} times "
+                            f"(bound {cap}) — the fleet is draining "
+                            "faster than it serves")
+                    if ckpt is None:  # defensive: exporter always
+                        ckpt = self._replay_ckpt()  # attaches one
+                    self._re_place(ckpt, planned=True, err=e)
+                except BaseException as e2:
+                    self._finish(None, e2)
+                    return
+            except (ServePoisonedError, ServeDeadlineError) as e:
+                # terminal by contract: a poison verdict poisons every
+                # replica in turn, and an expired deadline has expired
+                self._finish(None, e)
+                return
+            except (ServeDispatchError, ServeClosedError) as e:
+                if self.hops >= self._router.max_failover_hops:
+                    annotate_exception(
+                        e, f"fleet decode: {self.hops} replay hop(s) "
+                           f"exhausted (max_failover_hops "
+                           f"{self._router.max_failover_hops})")
+                    self._finish(None, e)
+                    return
+                self.hops += 1
+                try:
+                    self._re_place(self._replay_ckpt(), planned=False,
+                                   err=e)
+                except BaseException as e2:
+                    self._finish(None, e2)
+                    return
+            except BaseException as e:
+                self._finish(None, e)
+                return
+
+
+# ---------------------------------------------------------------------------
 # The router
 # ---------------------------------------------------------------------------
 class FleetRouter:
@@ -872,6 +1271,10 @@ class FleetRouter:
         self._thread: Optional[threading.Thread] = None
         self._submit_idx = 0
         self._event_idx = 0
+        # session_id -> replica name: the decode tier's sticky map
+        # (ISSUE 17). Guarded by _lock; bounded FIFO so a long-lived
+        # router can't grow it without bound.
+        self._affinity: Dict[str, str] = {}
         # (time, event, replica, reason) — the fleet transition log
         self.events: List = []
         _STATS._routers.add(self)
@@ -912,6 +1315,13 @@ class FleetRouter:
         t, self._thread = self._thread, None
         if t is not None:
             t.join(5.0)
+        # decode occupancy snapshot BEFORE the replicas stop (a
+        # stopped replica's health has no decode block) — the final
+        # record ships it so `aggregate_fleet`/`tools/fleet_top.py`
+        # can render per-replica session occupancy post-mortem
+        rd = {name: snap["decode"]
+              for name, snap in self.replica_snapshot().items()
+              if "decode" in snap}
         for slot in self._slots.values():
             if slot.state in ("dead", "failed"):
                 continue
@@ -923,7 +1333,8 @@ class FleetRouter:
         # final control-plane record: the TERMINAL counters (replies/
         # failed resolve after routing, so the periodic route records
         # undercount them) — what aggregate_fleet's availability reads
-        self._log_metrics("stop")
+        self._log_metrics("stop", **({"replica_decode": rd} if rd
+                                     else {}))
 
     def __enter__(self) -> "FleetRouter":
         return self.start()
@@ -939,6 +1350,19 @@ class FleetRouter:
         return sum(s.handle.warmup(*arrays)
                    for s in self._slots.values()
                    if s.in_rotation())
+
+    def warm_decode(self, prompt_lens=(), max_new_tokens=None,
+                    samplers=()) -> int:
+        """Warm every replica's decode-tier executables (fused step,
+        scan rungs, cohort prefills, and the `samplers`
+        (temperature, top_k) pairs sampled traffic will use) — with
+        the shared store armed, N× deserialize-only. Returns total
+        executables warmed."""
+        return sum(s.handle.warm_decode(prompt_lens, max_new_tokens,
+                                        samplers=samplers)
+                   for s in self._slots.values()
+                   if s.in_rotation()
+                   and hasattr(s.handle, "warm_decode"))
 
     # -- admission --------------------------------------------------------
     def submit(self, *arrays,
@@ -992,6 +1416,72 @@ class FleetRouter:
               deadline_ms: Optional[float] = None):
         return self.submit(*arrays,
                            deadline_ms=deadline_ms).result(timeout)
+
+    def submit_decode(self, prompt_ids, max_new_tokens: int,
+                      temperature: float = 0.0, top_k: int = 0,
+                      seed: int = 0,
+                      deadline_ms: Optional[float] = None,
+                      session_id: Optional[str] = None
+                      ) -> FleetDecodeReply:
+        """Route one generative session (ISSUE 17) and return its
+        `FleetDecodeReply` — a stream + future that survives replica
+        drains (live KV-slab migration) and SIGKILLs (ledger replay)
+        without tearing or duplicating a single token.
+
+        Placement is session-affine on top of occupancy-aware
+        least-depth: a `session_id` that routed before goes back to
+        the SAME replica while it has a free KV slot (its warm state
+        — radix-shared prefixes, resident slabs — lives there);
+        otherwise the fresh `ready` replica with the MOST free KV
+        slots wins, ties broken by queue depth. Admission-aware
+        re-placement: a replica that sheds (`ServeOverloadError`,
+        slot pool exhausted) causes the router to try the OTHER
+        replicas first — the hint's `retry_after_ms` is honored, with
+        seed-keyed jitter, only when the WHOLE rotation is full, up
+        to `max_shed_retries` rounds before the overload propagates
+        to the caller (counted `decode_rejected`)."""
+        if not self._running:
+            raise ServeClosedError("fleet router not running: call "
+                                   "start()")
+        _STATS.decode_requests += 1
+        prompt = np.asarray(prompt_ids, np.int32)
+        if prompt.ndim == 1:
+            prompt = prompt[None, :]
+        with self._lock:
+            self._submit_idx += 1
+            idx = self._submit_idx
+        deadline_abs = (None if deadline_ms is None
+                        else time.perf_counter()
+                        + float(deadline_ms) / 1e3)
+        sid = (str(session_id) if session_id is not None
+               else f"s{idx}")
+        ctx = trace_mod.current_trace()
+        tid = (ctx["trace_id"] if ctx
+               else (trace_mod.new_trace_id() if trace_mod.enabled()
+                     else None))
+        try:
+            with trace_mod.context(tid):
+                with trace_mod.span("submit_decode", request=idx,
+                                    session=sid):
+                    inner, name = self._route_decode(
+                        lambda h: h.submit_decode(
+                            prompt, max_new_tokens,
+                            temperature=temperature, top_k=top_k,
+                            seed=seed, deadline_ms=deadline_ms),
+                        exclude=set(), resume=False, affinity=sid)
+        except BaseException:
+            _STATS.decode_rejected += 1
+            raise
+        _STATS.decode_routed += 1
+        self._set_affinity(sid, name)
+        self._chaos_route(idx, self._slots[name])
+        params = {"prompt": prompt, "n_new": int(max_new_tokens),
+                  "temperature": float(temperature),
+                  "top_k": int(top_k), "seed": int(seed),
+                  "deadline_abs": deadline_abs}
+        r = FleetDecodeReply(self, sid, inner, name, tid, params)
+        r._start_pump()
+        return r
 
     # -- routing core -----------------------------------------------------
     def _refresh(self, slot: _ReplicaSlot) -> None:
@@ -1150,6 +1640,121 @@ class FleetRouter:
                 "no replica in rotation can accept the request "
                 f"(states: { {s.name: s.state for s in self._slots.values()} })")
 
+    # -- decode routing (ISSUE 17) ----------------------------------------
+    def _set_affinity(self, sid: str, name: str) -> None:
+        with self._lock:
+            self._affinity[sid] = name
+            while len(self._affinity) > 4096:  # bounded FIFO
+                self._affinity.pop(next(iter(self._affinity)))
+
+    def _decode_free_slots(self, slot: _ReplicaSlot) -> int:
+        """Free KV slots from the replica's health surface — the
+        per-replica occupancy every heartbeat ships (proc transport)
+        or the engine computes live (in-process). Unreadable or
+        decode-less health reads as ZERO free slots: fail closed,
+        the replica still serves via the least-depth tiebreak."""
+        try:
+            d = (slot.handle.health() or {}).get("decode") or {}
+            return int(d.get("free_slots", 0))
+        except Exception:
+            return 0
+
+    def _pick_decode(self, exclude,
+                     affinity: Optional[str] = None
+                     ) -> Optional[_ReplicaSlot]:
+        """Session-affine placement over fresh health: the sticky
+        replica wins while it is in rotation WITH a free KV slot
+        (admission-aware: a full sticky replica re-places instead of
+        bouncing off its slot pool); otherwise most-free-slots among
+        fresh `ready` replicas, ties by least depth. `degraded` only
+        when nothing is ready; None when rotation is empty."""
+        with self._lock:
+            slots = list(self._slots.values())
+            sticky = (self._affinity.get(affinity)
+                      if affinity is not None else None)
+        for slot in slots:
+            if slot.name not in exclude:
+                self._refresh(slot)
+        ready = [s for s in slots if s.state == "ready"
+                 and s.name not in exclude]
+        pool = ready or [s for s in slots if s.state == "degraded"
+                         and s.name not in exclude]
+        if not pool:
+            return None
+        free = {s.name: self._decode_free_slots(s) for s in pool}
+        if sticky is not None and free.get(sticky, 0) > 0:
+            for s in pool:
+                if s.name == sticky:
+                    return s
+        return min(pool, key=lambda s: (-free[s.name],
+                                        s.handle.depth(), s.routed,
+                                        s.name))
+
+    def _route_decode(self, call, exclude, resume: bool,
+                      affinity: Optional[str] = None):
+        """Pick + place one decode session with shed-aware re-try.
+        `call(handle)` performs the placement (`submit_decode` or
+        `resume_decode`); a shed replica is excluded for the round
+        and the OTHERS are tried before the smallest `retry_after_ms`
+        hint is honored — the fleet's answer to one full slot pool is
+        the rest of the fleet, not a sleep. Returns (inner ServeReply,
+        replica name); raises the decisive error when nothing
+        admits."""
+        from . import resilience
+
+        shed_round = 0
+        while True:
+            refused_now: set = set()
+            shed_hints: Dict[str, float] = {}
+            last_shed: Optional[ServeOverloadError] = None
+            while True:
+                st = self._pick_decode(exclude | refused_now,
+                                       affinity)
+                if st is None and exclude:
+                    # every UNtried replica refused or left rotation:
+                    # a previously-tried one may have restarted
+                    st = self._pick_decode(refused_now, affinity)
+                if st is None:
+                    break
+                try:
+                    with trace_mod.span("route_decode",
+                                        replica=st.name,
+                                        resume=resume):
+                        r = call(st.handle)
+                except ServeOverloadError as e:
+                    _STATS.decode_refused += 1
+                    st.refusals += 1
+                    shed_hints[st.name] = e.retry_after_ms
+                    last_shed = e
+                    refused_now.add(st.name)
+                    continue
+                except ServeClosedError as e:
+                    if getattr(e, "counted", False):
+                        _STATS.decode_refused += 1
+                    self._refresh(st)
+                    if st.state in ("ready", "degraded"):
+                        self._transition(st, "dead",
+                                         "decode submit refused: "
+                                         "closed")
+                    refused_now.add(st.name)
+                    continue
+                st.routed += 1
+                return r, st.name
+            if shed_hints and shed_round < self.max_shed_retries:
+                shed_round += 1
+                _STATS.decode_shed_retries += 1
+                delay = resilience.backoff_delay_s(
+                    shed_round, max(min(shed_hints.values()), 1.0)
+                    / 1e3, jitter=0.5, seed=self._seed,
+                    salt="fleet-decode-shed")
+                time.sleep(min(delay, self.max_shed_sleep_s))
+                continue
+            if last_shed is not None:
+                raise last_shed
+            raise FleetUnavailableError(
+                "no replica in rotation can admit the decode session "
+                f"(states: { {s.name: s.state for s in self._slots.values()} })")
+
     # -- chaos (fleet-level FaultInjector kinds) --------------------------
     def _chaos_route(self, idx: int, slot: _ReplicaSlot) -> None:
         inj = self.fault_injector
@@ -1204,8 +1809,14 @@ class FleetRouter:
         """Rolling-restart primitive: take `name` out of rotation
         (nothing new routes to it), let its in-flight dispatch
         finish, and reroute its queued requests through failover.
-        The replica ends `stopped` — restart it explicitly with
-        `rejoin(name)` when it should serve again."""
+        Live decode sessions MIGRATE (ISSUE 17): `drain_stop`
+        checkpoints each one (KV slab + token ledger + sampling
+        config + deadline remainder), the session's stream proxy
+        catches the `ServeMigratedError` and resumes the checkpoint
+        on another replica — the caller's `FleetDecodeReply` keeps
+        yielding, zero tokens lost. The replica ends `stopped` —
+        restart it explicitly with `rejoin(name)` when it should
+        serve again."""
         slot = self._slots[name]
         self._transition(slot, "draining", "drain requested")
         _STATS.drains += 1
@@ -1333,12 +1944,35 @@ class FleetRouter:
                     ("sent", "delivered", "err_replies",
                      "transport_failed", "ipc_timeouts",
                      "torn_frames_detected", "pending", "heartbeats")}
+            # decode-tier occupancy (ISSUE 17): sessions in flight,
+            # free KV slots, tokens/sec EMA — from the same health
+            # surface routing reads (heartbeat-shipped over proc
+            # transport), absent when the replica serves no decode
+            try:
+                d = (slot.handle.health() or {}).get("decode")
+            except Exception:
+                d = None
+            if d:
+                out[slot.name]["decode"] = {
+                    "active_sessions": int(d.get(
+                        "active_sessions", 0)),
+                    "free_slots": int(d.get("free_slots", 0)),
+                    "tokens_per_s": float(d.get("tokens_per_s",
+                                                0.0))}
         return out
 
     def _log_metrics(self, event: str, **extra) -> None:
         m = self.metrics
         if m is None:
             return
+        if event == "route" and "replica_decode" not in extra:
+            # periodic route records carry the LIVE per-replica decode
+            # occupancy (mid-run, not just the stop-time snapshot)
+            rd = {name: snap["decode"]
+                  for name, snap in self.replica_snapshot().items()
+                  if "decode" in snap}
+            if rd:
+                extra = dict(extra, replica_decode=rd)
         try:
             with self._lock:
                 self._event_idx += 1
@@ -1353,6 +1987,11 @@ class FleetRouter:
                 fleet_failed=_STATS.failed,
                 routed=_STATS.routed, failovers=_STATS.failovers,
                 refused=_STATS.refused, rejected=_STATS.rejected,
+                decode_requests=_STATS.decode_requests,
+                decode_replies=_STATS.decode_replies,
+                decode_failed=_STATS.decode_failed,
+                decode_migrations=_STATS.decode_migrations,
+                decode_replays=_STATS.decode_replays,
                 ejections=_STATS.ejections, rejoins=_STATS.rejoins,
                 restarts=_STATS.restarts,
                 kills_injected=_STATS.kills_injected,

@@ -90,11 +90,16 @@ from .serve import (
     ServeClosedError,
     ServeDeadlineError,
     ServeDispatchError,
+    ServeMigratedError,
     ServeOverloadError,
     ServePoisonedError,
     ServeQueueFullError,
     ServeReply,
     ServingEngine,
+    note_remote_decode_export,
+    note_remote_decode_session,
+    note_remote_decode_terminal,
+    note_remote_decode_tokens,
     note_remote_request,
     note_remote_terminal,
 )
@@ -110,6 +115,10 @@ __all__ = [
     "decode_tree_prefix",
     "encode_req_payload",
     "decode_req_payload",
+    "encode_decode_payload",
+    "decode_decode_payload",
+    "encode_resume_payload",
+    "decode_resume_payload",
     "encode_trace_suffix",
     "decode_trace_suffix",
     "encode_error",
@@ -168,6 +177,18 @@ CTRL_OK = 8  # worker -> parent: JSON result for a sync CTRL/WARM
 WARM = 9     # parent -> worker: encoded arrays (engine.warmup)
 BYE = 10     # worker -> parent: JSON final counters (the reconciliation
              # handshake) — last frame of a clean drain/stop
+# Decode-tier session frames (ISSUE 17). Byte-absent when unused: a
+# fleet that never calls submit_decode puts none of these on the wire,
+# and the forward-tier frame stream is byte-identical to PR 13.
+DECODE = 11  # parent -> worker: decode-session params tree (+ optional
+             # trace suffix) — ACKed synchronously like REQ
+TOK = 12     # worker -> parent: one streamed token (i32) as its fused
+             # decode step lands — feeds the parent reply's stream
+MIGRATE = 13 # worker -> parent: the session's live-migration
+             # checkpoint (drain path) — supersedes ERR: a migrated
+             # session has no local terminal, it re-admits elsewhere
+RESUME = 14  # parent -> worker: checkpoint admission (encoded ckpt
+             # tree + optional trace suffix) — ACKed like DECODE
 
 
 def encode_frame(ftype: int, req_id: int, payload: bytes,
@@ -403,6 +424,50 @@ def decode_req_payload(payload: bytes):
     return (None if dl < 0 else dl), arrays, tid, parent
 
 
+def encode_decode_payload(prompt, n_new, temperature, top_k, seed,
+                          deadline_ms, trace=None) -> bytes:
+    """One DECODE payload: the session params as a wire tree (+ the
+    trace suffix IFF `trace` is given — the REQ contract verbatim:
+    an untraced session adds zero wire bytes)."""
+    payload = encode_tree({
+        "prompt": np.asarray(prompt, np.int32),
+        "n_new": int(n_new),
+        "temperature": float(temperature),
+        "top_k": int(top_k),
+        "seed": int(seed),
+        "deadline_ms": (None if deadline_ms is None
+                        else float(deadline_ms)),
+    })
+    if trace is not None:
+        payload += encode_trace_suffix(trace[0], trace[1])
+    return payload
+
+
+def decode_decode_payload(payload: bytes):
+    """(params_dict, trace_id, parent) — the worker side of
+    `encode_decode_payload`. Scalars come back as 0-d numpy arrays
+    (the tree codec's scalar form); the engine coerces."""
+    d, off = decode_tree_prefix(payload, 0)
+    tid, parent = decode_trace_suffix(payload, off)
+    return d, tid, parent
+
+
+def encode_resume_payload(ckpt: Dict, trace=None) -> bytes:
+    """One RESUME payload: the migration checkpoint tree (numpy
+    arrays / scalars / None leaves only — `export_decode_sessions`'s
+    documented contract) + the optional trace suffix."""
+    payload = encode_tree(dict(ckpt))
+    if trace is not None:
+        payload += encode_trace_suffix(trace[0], trace[1])
+    return payload
+
+
+def decode_resume_payload(payload: bytes):
+    ckpt, off = decode_tree_prefix(payload, 0)
+    tid, parent = decode_trace_suffix(payload, off)
+    return ckpt, tid, parent
+
+
 # ---------------------------------------------------------------------------
 # Structured error mapping: the worker's exact single-engine exception
 # types survive the boundary, so the router's failover/shed/poison
@@ -462,6 +527,15 @@ _ERR_TERMINAL = {
     "transport": "failed",
 }
 
+# Decode-SESSION mirror buckets (the 4-equation books): an admission
+# refusal maps overload -> shed; an admitted session's error frame
+# maps deadline -> expired; everything else is failed. `completed`
+# comes from the final REP, and migration is not a terminal at all.
+_DECODE_ERR_TERMINAL = {
+    "deadline": "expired",
+    "overload": "shed",
+}
+
 
 # ---------------------------------------------------------------------------
 # Parent-side request bookkeeping
@@ -469,7 +543,7 @@ _ERR_TERMINAL = {
 class _Pending:
     __slots__ = ("reply", "gen", "acked", "ack_err", "ack_ev",
                  "ipc_abs", "sweep_failed", "claimed", "trace",
-                 "t_send")
+                 "t_send", "decode")
 
     def __init__(self, reply: ServeReply, gen: int):
         self.reply = reply
@@ -481,6 +555,10 @@ class _Pending:
         self.sweep_failed = False  # future failed, frame still owed
         self.trace = None  # (trace_id, parent) on a traced request
         self.t_send: Optional[float] = None  # REQ send perf_counter
+        # decode-tier SESSION (DECODE/RESUME): terminals mirror into
+        # the decode books, not the forward ones, and TOK frames feed
+        # the reply's stream while the entry stays pending
+        self.decode = False
         # One-terminal arbiter for UN-ADMITTED requests: the
         # submit()-timeout path, the reader's ERR-refusal path, and
         # the death sweep can all race to mirror this request's
@@ -499,14 +577,15 @@ class _Pending:
 
 class _Gen:
     """Per-worker-generation reconciliation ledger: at quiescence
-    `admitted == frames + swept` exactly — an admitted request either
-    produced a reply/error frame that arrived, or was swept into
-    `failed` when its generation died. `handshake` holds the worker's
-    final counters when the generation drained cleanly (the BYE
-    frame); a SIGKILLed generation has none, which is exactly why the
-    parent-side ledger is the authoritative one."""
+    `admitted == frames + swept + migrated` exactly — an admitted
+    request either produced a reply/error frame that arrived, was
+    swept into `failed` when its generation died, or (decode sessions
+    only) LEFT on a MIGRATE frame to resume elsewhere. `handshake`
+    holds the worker's final counters when the generation drained
+    cleanly (the BYE frame); a SIGKILLed generation has none, which
+    is exactly why the parent-side ledger is the authoritative one."""
 
-    __slots__ = ("admitted", "frames", "swept", "ack_errs",
+    __slots__ = ("admitted", "frames", "swept", "migrated", "ack_errs",
                  "handshake", "clean", "exit_code", "pid",
                  "clock_offset_us", "clock_rtt_s", "clock_wall_us")
 
@@ -514,6 +593,7 @@ class _Gen:
         self.admitted = 0
         self.frames = 0
         self.swept = 0
+        self.migrated = 0
         self.ack_errs = 0
         self.handshake: Optional[Dict] = None
         self.clean = False
@@ -662,6 +742,16 @@ class ProcReplica:
         self.torn_frames_detected = 0
         self.ipc_timeouts = 0
         self.hb_received = 0
+        # decode-tier lane (ISSUE 17): its own sent/terminal counters
+        # so the forward parent-terminals equation is untouched; at
+        # quiescence decode_sent == decode_delivered +
+        # decode_err_replies + decode_transport_failed + migrated_out.
+        self.decode_sent = 0
+        self.decode_delivered = 0
+        self.decode_err_replies = 0
+        self.decode_transport_failed = 0
+        self.migrated_out = 0
+        self.decode_tokens = 0
         # shipped worker spans (ISSUE 15): raw worker-clock records
         # piggybacked on REP/HB/BYE frames, kept per generation for
         # `trace_source()` to hand `trace.merge_chrome_traces` with
@@ -919,7 +1009,11 @@ class ProcReplica:
             raise ValueError("serve request needs at least one input")
         n = int(batch[0].shape[0])
         with self._plock:
-            inflight = len(self._pending)
+            # decode sessions are long-lived streams with their own
+            # admission control (the worker's KV-slot pool) — they
+            # must not starve the forward lane's in-flight budget
+            inflight = sum(1 for e in self._pending.values()
+                           if not e.decode)
         if inflight >= self.max_inflight:
             # shed instead of ballooning the pipe: the hint is the
             # worker's own estimate from its last heartbeat
@@ -998,10 +1092,138 @@ class ProcReplica:
         self.sent += 1
         return reply
 
+    def submit_decode(self, prompt_ids, max_new_tokens: int,
+                      temperature: float = 0.0, top_k: int = 0,
+                      seed: int = 0,
+                      deadline_ms: Optional[float] = None) -> ServeReply:
+        """Submit one generative session across the boundary
+        (`ServingEngine.submit_decode`, DECODE frame). Admission is
+        synchronous like `submit` — a refusal keeps its exact engine
+        type (`ServeOverloadError.retry_after_ms` is the worker's own
+        slot-pool hint) — and the returned reply's `tokens()` stream
+        is fed by TOK frames as the worker's fused steps land, with
+        the final REP delivering the full `[1, P + n]` array. A drain
+        mid-stream fails the reply with `ServeMigratedError` carrying
+        the checkpoint (MIGRATE frame) for re-placement."""
+        prompt = np.asarray(prompt_ids, np.int32)
+        if prompt.ndim == 1:
+            prompt = prompt[None, :]
+        if prompt.ndim != 2 or prompt.shape[0] != 1 \
+                or prompt.shape[1] < 1:
+            raise ValueError(
+                f"decode prompt must be [P] or [1, P] token ids, got "
+                f"shape {prompt.shape}")
+        if int(max_new_tokens) < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        trace = None
+        if trace_mod.enabled():
+            ctx = trace_mod.current_trace()
+            if ctx is not None:
+                trace = (ctx["trace_id"],
+                         trace_mod.current_span_id() or ctx["parent"])
+        payload = encode_decode_payload(
+            prompt, max_new_tokens, temperature, top_k, seed,
+            deadline_ms, trace=trace)
+        return self._decode_roundtrip(DECODE, payload, deadline_ms,
+                                      trace)
+
+    def resume_decode(self, ckpt: Dict) -> ServeReply:
+        """Admit a migrated session's checkpoint on THIS replica
+        (RESUME frame -> `ServingEngine.resume_decode`): the worker
+        re-streams the ledger prefix through TOK frames first, then
+        the live continuation — one seamless stream for a consumer
+        that dedupes by count."""
+        trace = None
+        if trace_mod.enabled():
+            ctx = trace_mod.current_trace()
+            if ctx is not None:
+                trace = (ctx["trace_id"],
+                         trace_mod.current_span_id() or ctx["parent"])
+        dl = ckpt.get("deadline_ms_left")
+        payload = encode_resume_payload(ckpt, trace=trace)
+        return self._decode_roundtrip(RESUME, payload,
+                                      None if dl is None else float(
+                                          np.asarray(dl)), trace)
+
+    def _decode_roundtrip(self, ftype: int, payload: bytes,
+                          deadline_ms: Optional[float],
+                          trace) -> ServeReply:
+        """The shared DECODE/RESUME admission dance — `submit`'s
+        REQ -> ACK protocol with the terminal mirrors routed into the
+        decode-session books (`note_remote_decode_*`) instead of the
+        forward ones. Sessions do NOT count toward `max_inflight`
+        (they are long-lived streams; the worker's KV-slot pool is
+        their admission control) and carry no transport sweep deadline
+        unless the session itself has one."""
+        if not self._alive():
+            raise ServeClosedError(f"replica {self.name} is dead")
+        reply = ServeReply(1)
+        with self._plock:
+            self._next_id += 1
+            rid = self._next_id
+            ent = _Pending(reply, self._gen)
+            ent.decode = True
+            self._pending[rid] = ent
+        note_remote_decode_session(resumed=(ftype == RESUME))
+        ent.trace = trace
+        ent.t_send = time.perf_counter()
+        try:
+            self._send(ftype, rid, payload)
+        except ServeClosedError:
+            with self._plock:
+                popped = self._pending.pop(rid, None)
+                claim = popped is not None and popped.take_claim()
+            if claim:
+                note_remote_decode_terminal("failed")
+            err = ServeClosedError(
+                f"replica {self.name} died before the decode session "
+                "was admitted")
+            err.counted = True
+            raise err
+        if not ent.ack_ev.wait(self.ipc_deadline_s):
+            with self._plock:
+                claim = ent.take_claim()
+            self.ipc_timeouts += 1
+            reply._fail(ProcTransportError(
+                f"replica {self.name}: no decode admission ACK within "
+                f"{self.ipc_deadline_s * 1e3:.0f} ms (worker hung or "
+                "pipe stalled)"))
+            if claim:
+                note_remote_decode_terminal("failed")
+            err = ServeClosedError(
+                f"replica {self.name}: decode admission timed out")
+            err.counted = True
+            raise err
+        if ent.ack_err is not None:
+            raise ent.ack_err
+        if deadline_ms is not None:
+            # transport bound past the session's own deadline — the
+            # worker expires THAT; a deadline-free session is bounded
+            # by its token budget, not by the IPC sweep
+            ent.ipc_abs = (time.perf_counter() + self.ipc_deadline_s
+                           + float(deadline_ms) / 1e3)
+        self.decode_sent += 1
+        return reply
+
     def warmup(self, *arrays) -> int:
         batch = ServingEngine._as_batch(arrays)
         res = self._ctrl_sync(WARM, encode_tree(list(batch)),
                               timeout=self.spawn_timeout_s)
+        return int(res.get("warmed", 0))
+
+    def warm_decode(self, prompt_lens=(), max_new_tokens=None,
+                    samplers=()) -> int:
+        """Worker-side `ServingEngine.warm_decode` over the wire: with
+        the shared store prewarmed this is deserialize-only — the
+        respawn-readiness probe the decode tier's restart story pins
+        (store hits >= 1, traces == 0, from `counters()`)."""
+        res = self._ctrl_sync(CTRL, json.dumps(
+            {"op": "warm_decode",
+             "prompt_lens": [int(p) for p in prompt_lens],
+             "max_new_tokens": max_new_tokens,
+             "samplers": [[float(t), int(k)] for t, k in samplers]}
+            ).encode("utf-8"),
+            timeout=self.spawn_timeout_s)
         return int(res.get("warmed", 0))
 
     def counters(self, timeout: float = 5.0) -> Dict:
@@ -1079,7 +1301,8 @@ class ProcReplica:
         with self._plock:
             gens = {
                 g: {"admitted": gen.admitted, "frames": gen.frames,
-                    "swept": gen.swept, "ack_errs": gen.ack_errs,
+                    "swept": gen.swept, "migrated": gen.migrated,
+                    "ack_errs": gen.ack_errs,
                     "clean": gen.clean, "exit_code": gen.exit_code,
                     "handshake": gen.handshake,
                     "pid": gen.pid,
@@ -1097,6 +1320,14 @@ class ProcReplica:
                 "heartbeats": self.hb_received,
                 "spans_received": self.spans_received,
                 "spans_dropped": self.spans_dropped,
+                "decode": {
+                    "sent": self.decode_sent,
+                    "delivered": self.decode_delivered,
+                    "err_replies": self.decode_err_replies,
+                    "transport_failed": self.decode_transport_failed,
+                    "migrated_out": self.migrated_out,
+                    "tokens": self.decode_tokens,
+                },
                 "generations": gens,
             }
 
@@ -1237,8 +1468,45 @@ class ProcReplica:
             if late:
                 ent.reply.deadline_exceeded = True
             if ent.reply._deliver(value):
-                self.delivered += 1
-                note_remote_terminal("replies", late=late)
+                if ent.decode:
+                    self.decode_delivered += 1
+                    note_remote_decode_terminal("completed")
+                else:
+                    self.delivered += 1
+                    note_remote_terminal("replies", late=late)
+        elif ftype == TOK:
+            with self._plock:
+                ent = self._pending.get(rid)
+            if ent is None or not ent.decode:
+                return  # late token for a swept/unknown session:
+                # dropped — never appended to a terminal stream
+            toks = np.frombuffer(payload, ">i4")
+            for t in toks:
+                ent.reply._push_token(int(t))
+            self.decode_tokens += len(toks)
+            note_remote_decode_tokens(len(toks))
+        elif ftype == MIGRATE:
+            ckpt = decode_tree(payload)
+            with self._plock:
+                ent = self._pending.pop(rid, None)
+                if ent is not None:
+                    g.migrated += 1
+            if ent is None:
+                return
+            # the session LEFT this replica's books without a terminal
+            # (the worker already decremented its own `sessions`):
+            # mirror the net-out, then hand the checkpoint to whoever
+            # holds the reply — the fleet's stream proxy re-places it.
+            # Mirror ONLY on the first-write win: a sweep-failed
+            # session already booked its terminal, and netting it out
+            # here too would break the parent's 4-equation books.
+            if ent.reply._fail(ServeMigratedError(
+                    f"replica {self.name}: decode session migrated "
+                    "off the draining worker "
+                    f"({len(np.asarray(ckpt.get('toks', ())).ravel())}"
+                    " tokens in the ledger)", ckpt=ckpt)):
+                self.migrated_out += 1
+                note_remote_decode_export()
         elif ftype == ERR:
             d = json.loads(payload.decode("utf-8"))
             err = decode_error(d)
@@ -1257,10 +1525,15 @@ class ProcReplica:
             if not ent.acked:
                 if claim:
                     kind = d.get("kind", "dispatch")
-                    note_remote_terminal({
-                        "overload": "shed", "queue_full": "dropped",
-                        "overflow": "overflowed",
-                    }.get(kind, "failed"))
+                    if ent.decode:
+                        note_remote_decode_terminal(
+                            _DECODE_ERR_TERMINAL.get(kind, "failed"))
+                    else:
+                        note_remote_terminal({
+                            "overload": "shed",
+                            "queue_full": "dropped",
+                            "overflow": "overflowed",
+                        }.get(kind, "failed"))
                 if isinstance(err, ServeClosedError):
                     # the parent mirrored requests+<terminal> for
                     # this refusal: the router must count it
@@ -1271,9 +1544,15 @@ class ProcReplica:
             with self._plock:
                 g.frames += 1
             if ent.reply._fail(err):
-                self.err_replies += 1
-                note_remote_terminal(_ERR_TERMINAL.get(
-                    d.get("kind", "dispatch"), "failed"))
+                if ent.decode:
+                    self.decode_err_replies += 1
+                    note_remote_decode_terminal(
+                        _DECODE_ERR_TERMINAL.get(
+                            d.get("kind", "dispatch"), "failed"))
+                else:
+                    self.err_replies += 1
+                    note_remote_terminal(_ERR_TERMINAL.get(
+                        d.get("kind", "dispatch"), "failed"))
         elif ftype == HB:
             t_rx = time.perf_counter()
             hb = json.loads(payload.decode("utf-8"))
@@ -1357,8 +1636,12 @@ class ProcReplica:
                     f"deadline ({self.ipc_deadline_s * 1e3:.0f} ms "
                     "past the request deadline) — worker hung or "
                     "pipe stalled")):
-                self.transport_failed += 1
-                note_remote_terminal("failed")
+                if ent.decode:
+                    self.decode_transport_failed += 1
+                    note_remote_decode_terminal("failed")
+                else:
+                    self.transport_failed += 1
+                    note_remote_terminal("failed")
             # the entry STAYS pending: if the worker is merely slow
             # its frame still arrives (dropped, but counted), and if
             # the worker dies the death sweep moves it to `swept` —
@@ -1391,11 +1674,22 @@ class ProcReplica:
                     # terminal but keep it out of transport_failed
                     # (the parent-terminals equation is over admitted
                     # requests only)
-                    note_remote_terminal("failed")
+                    if ent.decode:
+                        note_remote_decode_terminal("failed")
+                    else:
+                        note_remote_terminal("failed")
                 continue
             if won:
-                self.transport_failed += 1
-                note_remote_terminal("failed")
+                if ent.decode:
+                    # a SIGKILLed worker's live sessions fail LOUDLY
+                    # here; the fleet's stream proxy re-prefills from
+                    # its delivered-token ledger (replay — migration
+                    # is only the fast path)
+                    self.decode_transport_failed += 1
+                    note_remote_decode_terminal("failed")
+                else:
+                    self.transport_failed += 1
+                    note_remote_terminal("failed")
         for waiter in ctrl:
             waiter["ev"].set()
 

@@ -33,6 +33,8 @@ import sys
 import threading
 import time
 
+import numpy as np
+
 
 def _log(msg: str) -> None:
     print(f"[fleet-worker {os.getpid()}] {msg}", file=sys.stderr,
@@ -105,6 +107,18 @@ def main() -> int:
                                  metrics=metrics,
                                  **(spec.get("engine") or {}))
     engine.start()
+    if spec.get("warm_decode"):
+        # decode-tier AOT warmup at boot (and at every RESPAWN —
+        # restart() reuses this spec): with the shared store prewarmed
+        # this is deserialize-only, so a respawned replica re-enters
+        # the decode rotation without paying a compile
+        wd = spec["warm_decode"]
+        t0 = time.perf_counter()
+        n = engine.warm_decode(wd.get("prompt_lens") or (),
+                               wd.get("max_new_tokens"),
+                               samplers=wd.get("samplers") or ())
+        _log(f"{name}: decode tier warmed ({n} executables, "
+             f"{time.perf_counter() - t0:.2f}s)")
 
     import socket
 
@@ -128,6 +142,7 @@ def main() -> int:
 
     def counters_payload():
         s = stats.cache_stats()
+        d = s["decode"]
         out = {
             "terminal": serve.terminal_counters(),
             "poisoned": s["serve"]["poisoned"],
@@ -135,6 +150,14 @@ def main() -> int:
             "export": {"hits": s["export"]["hits"],
                        "traces": s["export"]["traces"],
                        "misses": s["export"]["misses"]},
+            # decode-session books (ISSUE 17): the worker side of the
+            # fleet-wide 4-equation reconciliation — sessions ==
+            # completed + failed + expired + shed, with migrated/
+            # resumed tracking the sessions that crossed replicas
+            "decode": {k: int(d.get(k, 0)) for k in (
+                "sessions", "completed", "failed", "expired", "shed",
+                "migrated", "resumed", "tokens_streamed", "prefills",
+                "decode_steps", "slots", "slots_in_use")},
             "pid": os.getpid(),
         }
         if trace_mod.enabled():
@@ -232,6 +255,55 @@ def main() -> int:
             flush_done()
             time.sleep(0.001)
 
+    # -- decode tier (ISSUE 17) -------------------------------------------
+    # One streamer thread per admitted session: every generated token
+    # rides a TOK frame as its fused step lands, and the terminal is
+    # exactly ONE of REP (completed — the full [1, P+n] array, the
+    # bit-identity surface), ERR (failed/expired), or MIGRATE (the
+    # session left with the drain checkpoint; supersedes ERR — a
+    # migrated session has no local terminal, it re-admits elsewhere).
+    decode_threads = []
+
+    def stream_decode(rid, reply):
+        try:
+            try:
+                for tok in reply.tokens():
+                    send(wire.TOK, rid, struct.pack(">i", int(tok)))
+            except serve.ServeMigratedError as e:
+                send(wire.MIGRATE, rid, wire.encode_tree(e.ckpt))
+                return
+            except BaseException as e:  # noqa: BLE001 — wire
+                send(wire.ERR, rid, json.dumps(
+                    wire.encode_error(e)).encode("utf-8"))
+                return
+            val = reply.result(0.0)
+            flags = 1 if reply.deadline_exceeded else 0
+            send(wire.REP, rid, bytes([flags]) + wire.encode_tree(val),
+                 rep_frame=True)
+        except OSError:
+            pass  # parent gone: its death sweep owns the accounting
+
+    def admit_decode(rid, admit, tid, parent):
+        """Shared DECODE/RESUME admission: sync ACK (exact engine
+        error types on refusal, the REQ contract) then a streamer
+        thread owns the session's frames."""
+        if tid is not None and not trace_mod.enabled():
+            arm_tracing()
+        try:
+            with trace_mod.context(tid, parent):
+                reply = admit()
+        except BaseException as e:  # noqa: BLE001 — wire
+            send(wire.ERR, rid, json.dumps(
+                wire.encode_error(e)).encode("utf-8"))
+            return
+        send(wire.ACK, rid,
+             b"" if tid is None
+             else struct.pack(">d", time.perf_counter()))
+        t = threading.Thread(target=stream_decode, args=(rid, reply),
+                             daemon=True)
+        decode_threads.append(t)
+        t.start()
+
     def handle_ctrl(rid, msg):
         op = msg.get("op")
         if op == "drain":
@@ -239,6 +311,17 @@ def main() -> int:
         if op == "counters":
             send(wire.CTRL_OK, rid,
                  json.dumps(counters_payload()).encode("utf-8"))
+        elif op == "warm_decode":
+            try:
+                warmed = engine.warm_decode(
+                    msg.get("prompt_lens") or (),
+                    msg.get("max_new_tokens"),
+                    samplers=msg.get("samplers") or ())
+                send(wire.CTRL_OK, rid, json.dumps(
+                    {"warmed": warmed}).encode("utf-8"))
+            except BaseException as e:  # noqa: BLE001 — wire
+                send(wire.ERR, rid, json.dumps(
+                    wire.encode_error(e)).encode("utf-8"))
         elif op == "hang_once":
             hang_s = float(msg.get("s", 0.05))
             orig = engine._chaos_attempt
@@ -309,6 +392,24 @@ def main() -> int:
                          else struct.pack(">d", time.perf_counter()))
                     with outbox_lock:
                         outbox.append((rid, reply))
+                elif ftype == wire.DECODE:
+                    d, tid, parent = wire.decode_decode_payload(payload)
+                    dl = d.get("deadline_ms")
+                    admit_decode(rid, lambda: engine.submit_decode(
+                        np.asarray(d["prompt"], np.int32),
+                        int(np.asarray(d["n_new"])),
+                        temperature=float(np.asarray(d["temperature"])),
+                        top_k=int(np.asarray(d["top_k"])),
+                        seed=int(np.asarray(d["seed"])),
+                        deadline_ms=(None if dl is None
+                                     else float(np.asarray(dl)))),
+                        tid, parent)
+                elif ftype == wire.RESUME:
+                    ckpt, tid, parent = \
+                        wire.decode_resume_payload(payload)
+                    admit_decode(rid,
+                                 lambda: engine.resume_decode(ckpt),
+                                 tid, parent)
                 elif ftype == wire.WARM:
                     arrays = wire.decode_tree(payload)
                     try:
@@ -333,7 +434,24 @@ def main() -> int:
     # flush EVERY outstanding future as a frame, then ship the final
     # counters — the reconciliation handshake — and exit 0.
     _log(f"{name}: draining ({drain_mode})")
+    # Live KV-slab migration (ISSUE 17): checkpoint every in-flight
+    # decode session BEFORE the engine stop can fail it — the
+    # streamer threads turn each ServeMigratedError into a MIGRATE
+    # frame, and the parent re-places the session on another replica
+    # with zero token loss. Runs in BOTH drain modes: migrating a
+    # session is strictly better than failing it.
+    try:
+        exported = engine.export_decode_sessions()
+        if exported:
+            _log(f"{name}: exported {len(exported)} live decode "
+                 "session(s) for migration")
+    except Exception as e:  # noqa: BLE001 — drain must proceed
+        _log(f"{name}: decode-session export failed ({e!r})")
     engine.stop(drain=(drain_mode == "drain"))
+    for t in decode_threads:
+        # every session's terminal frame (REP/ERR/MIGRATE) must be on
+        # the wire before the BYE handshake ships the final counters
+        t.join(10.0)
     flush_done(block_all=True)
     stop_ev.set()
     if metrics is not None:

@@ -63,6 +63,41 @@ def _numel(dims: List[int]) -> int:
     return int(math.prod(dims)) if dims else 1
 
 
+def _split_operands(s: str) -> List[str]:
+    """Split an operand list on top-level commas only — inline types
+    (`f32[8,32]{1,0} %arg`) carry commas inside brackets/braces that a
+    naive split would tear."""
+    out, depth, cur = [], 0, []
+    for ch in s:
+        if ch in "[{(":
+            depth += 1
+        elif ch in "]})":
+            depth -= 1
+        if ch == "," and depth == 0:
+            out.append("".join(cur).strip())
+            cur = []
+        else:
+            cur.append(ch)
+    tail = "".join(cur).strip()
+    if tail:
+        out.append(tail)
+    return out
+
+
+def _operand_shape(op_str: str, shapes: Dict[str, tuple]):
+    """(dtype, dims) for one operand reference. Post-optimization text
+    spells operands WITH their inline type (`f32[8,32]{1,0} %Arg_0.1`)
+    — parse that directly; pre-optimization text spells just `%name`,
+    which resolves through the module-wide shape table."""
+    toks = op_str.split()
+    if not toks:
+        return None
+    sh = _shape_of(toks[0])
+    if sh:
+        return sh
+    return shapes.get(toks[-1].lstrip("%"))
+
+
 class _Instr:
     __slots__ = ("name", "dtype", "dims", "opcode", "line")
 
@@ -140,8 +175,8 @@ def _instr_flops(ins: _Instr, shapes: Dict[str, tuple]) -> float:
         m = _OPERANDS_RE.search(ins.line)
         c = _CONTRACT_RE.search(ins.line)
         if m and c:
-            ops = [o.strip().lstrip("%") for o in m.group(1).split(",")]
-            lhs = shapes.get(ops[0].split(" ")[0]) if ops else None
+            ops = _split_operands(m.group(1))
+            lhs = _operand_shape(ops[0], shapes) if ops else None
             if lhs:
                 cdims = [int(d) for d in c.group(1).split(",") if d]
                 k = _numel([lhs[1][d] for d in cdims if d < len(lhs[1])])
@@ -151,8 +186,9 @@ def _instr_flops(ins: _Instr, shapes: Dict[str, tuple]) -> float:
         m = _OPERANDS_RE.search(ins.line)
         dl = _DIMLABELS_RE.search(ins.line)
         if m and dl:
-            ops = [o.strip().lstrip("%") for o in m.group(1).split(",")]
-            rhs = shapes.get(ops[1].split(" ")[0]) if len(ops) > 1 else None
+            ops = _split_operands(m.group(1))
+            rhs = (_operand_shape(ops[1], shapes)
+                   if len(ops) > 1 else None)
             if rhs:
                 o_pos = dl.group(2).index("o")
                 rhs_n = _numel(rhs[1])
@@ -167,8 +203,8 @@ def _instr_flops(ins: _Instr, shapes: Dict[str, tuple]) -> float:
         # ~1 flop per reduced input element; approximate via operand.
         m = _OPERANDS_RE.search(ins.line)
         if m:
-            ops = [o.strip().lstrip("%") for o in m.group(1).split(",")]
-            src = shapes.get(ops[0].split(" ")[0]) if ops else None
+            ops = _split_operands(m.group(1))
+            src = _operand_shape(ops[0], shapes) if ops else None
             if src:
                 return float(_numel(src[1]))
         return float(out_n)

@@ -587,6 +587,52 @@ class TransformerLM(model.Model):
             cache_dict[key_] = fn
         return fn(params, list(slab), ids, n_real, slots)
 
+    def export_slab_rows(self, slab, slot, pos):
+        """Snapshot one session's live K/V out of the decode slab as a
+        single host array [L, 2, H, pos, D] — the portable half of KV
+        migration. Pure host-side gather (no compile): the slab leaves
+        are device arrays, `np.asarray` forces the transfer, and only
+        the first `pos` sequence rows are real (the tail past `pos` is
+        stale garbage decode would overwrite anyway, so it never
+        crosses the wire)."""
+        return np.stack(
+            [np.asarray(c[:, slot, :, :pos, :]) for c in slab])
+
+    def import_slab_rows(self, slab, slot, rows):
+        """Transplant `export_slab_rows` output into row `slot` of a
+        (possibly different-geometry) slab, returning the new slab.
+        The seq dim is zero-padded host-side to the target's rung so
+        ONE executable per slab geometry serves every (slot, pos)
+        pair — `slot` is traced, and the stale-tail argument from
+        `prefill_slab` makes the zero padding exact: decode overwrites
+        position p before any query attends it. Requires the target
+        rung to cover `pos` (serve sizes the rung from the session's
+        own prompt+budget, which migration preserves)."""
+        import jax
+        import jax.numpy as jnp
+
+        L = len(slab)
+        H, Ts, D = (int(slab[0].shape[2]), int(slab[0].shape[3]),
+                    int(slab[0].shape[4]))
+        t = int(rows.shape[3])
+        if rows.shape[0] != L or rows.shape[2] != H \
+                or rows.shape[4] != D or t > Ts:
+            raise ValueError(
+                f"KV rows {tuple(rows.shape)} do not fit slab "
+                f"[L={L}, H={H}, T={Ts}, D={D}]")
+        cache_dict = self._program_cache()
+        key_ = ("import_slab", tuple(c.shape for c in slab),
+                jnp.asarray(slab[0]).dtype.name)
+        fn = cache_dict.get(key_)
+        if fn is None:
+            fn = jax.jit(lambda sl, r, s: [
+                sl[li].at[:, s, :, :, :].set(r[li]) for li in range(L)])
+            cache_dict[key_] = fn
+        dt = np.asarray(slab[0]).dtype
+        padded = np.zeros((L, 2, H, Ts, D), dt)
+        padded[:, :, :, :t, :] = rows
+        return fn(list(slab), padded, np.int32(slot))
+
     def sample_fn(self, temperature, top_k):
         """The EXACT sampling program generate() compiles (argmax when
         temperature == 0, else temperature-scaled top-k categorical)

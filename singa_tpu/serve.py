@@ -190,6 +190,24 @@ class ServePoisonedError(ServeDispatchError):
     turn, and the bisection work would repeat fleet-wide."""
 
 
+class ServeMigratedError(RuntimeError):
+    """The decode session LEFT this engine mid-stream (ISSUE 17):
+    `export_decode_sessions()` checkpointed it for live migration and
+    failed its local reply with this, carrying the portable checkpoint
+    in `.ckpt` (slot KV rows + generated-token ledger + sampling
+    config + deadline remainder). Deliberately NOT a
+    `ServeDispatchError` subclass — the fleet's failover machinery
+    must not treat a planned hand-off as a replica failure; the
+    session's stream proxy catches this specifically and resumes the
+    checkpoint on another replica (`resume_decode`) with zero token
+    loss. A caller holding the raw engine reply sees it loudly: the
+    continuation lives elsewhere."""
+
+    def __init__(self, msg: str, ckpt=None):
+        super().__init__(msg)
+        self.ckpt = ckpt
+
+
 # ---------------------------------------------------------------------------
 # Process-default knobs (user-facing setter: device.set_serving).
 # ---------------------------------------------------------------------------
@@ -508,6 +526,50 @@ def note_remote_terminal(kind: str, late: bool = False) -> None:
         _STATS.late += 1
 
 
+_DECODE_TERMINALS = ("completed", "failed", "expired", "shed")
+
+
+def note_remote_decode_session(resumed: bool = False) -> None:
+    """Parent-side mirror of ONE decode-session admission on a remote
+    worker (DECODE or RESUME frame ACKed, or refused with overload —
+    the worker counts `sessions` in both cases). The parent's decode
+    books then obey the same 4-equation reconciliation the worker's
+    do, which is what lets `fleet.reconcile` pin it fleet-wide.
+    `resumed` mirrors the worker's resumed counter (observability,
+    not part of the equation)."""
+    dst = stats_mod.decode_stats()
+    dst.sessions += 1
+    if resumed:
+        dst.resumed += 1
+
+
+def note_remote_decode_terminal(kind: str) -> None:
+    """Parent-side mirror of one decode-session terminal: exactly one
+    of completed/failed/expired/shed per mirrored admission."""
+    if kind not in _DECODE_TERMINALS:
+        raise ValueError(f"not a decode terminal bucket: {kind!r}")
+    dst = stats_mod.decode_stats()
+    setattr(dst, kind, getattr(dst, kind) + 1)
+
+
+def note_remote_decode_export() -> None:
+    """Parent-side mirror of one session EXPORTED off a worker by live
+    migration (MIGRATE frame): the worker decremented its `sessions`
+    (the session leaves its books without a terminal — it re-admits,
+    and re-counts, wherever it resumes), so the parent mirror does
+    too."""
+    dst = stats_mod.decode_stats()
+    dst.sessions -= 1
+    dst.migrated += 1
+
+
+def note_remote_decode_tokens(n: int) -> None:
+    """Parent-side mirror of `n` tokens streamed over the wire (TOK
+    frames) — observability only; not part of the reconciliation
+    equation."""
+    stats_mod.decode_stats().tokens_streamed += int(n)
+
+
 # ---------------------------------------------------------------------------
 # Requests / replies
 # ---------------------------------------------------------------------------
@@ -595,6 +657,14 @@ class ServeReply:
 
     def _push_token(self, tok: int) -> None:
         with self._stream_cv:
+            if self._stream_closed:
+                # a hung dispatch completing AFTER the reply went
+                # terminal (stop()/export timeout) must not extend a
+                # stream whose final content is already part of a
+                # delivered result or a shipped migration checkpoint —
+                # a late push here is exactly how a resumed session
+                # would deliver a duplicated token
+                return
             self._stream.append(int(tok))
             self._stream_cv.notify_all()
 
@@ -667,7 +737,7 @@ class _DecodeSession:
     __slots__ = ("prompt", "n_new", "temperature", "top_k", "seed",
                  "reply", "deadline", "trace", "key", "tok", "pos",
                  "left", "slot", "toks", "t_enqueue", "t_last_tok",
-                 "idx")
+                 "idx", "resume_kv", "resumed")
 
     def __init__(self, prompt: np.ndarray, n_new: int,
                  temperature: float, top_k: int, seed: int, reply,
@@ -689,6 +759,15 @@ class _DecodeSession:
         self.toks: List[int] = []       # produced tokens, in order
         self.t_enqueue = time.perf_counter()
         self.t_last_tok: Optional[float] = None  # TPOT span anchor
+        # Migration/resume state (ISSUE 17). `resumed` marks a session
+        # admitted via resume_decode with a non-empty ledger: its toks/
+        # tok/key were restored at admission, and the prefill path must
+        # restore position state instead of sampling a first token.
+        # `resume_kv` holds the exported slab rows [L, 2, H, pos, D]
+        # when the fast (KV-import) path applies; None means replay
+        # (re-prefill prompt + ledger[:-1]).
+        self.resume_kv = None
+        self.resumed = False
 
 
 def _pow2_ceil(n: int) -> int:
@@ -915,6 +994,7 @@ class ServingEngine:
         self._prefill_idx = 0           # admission ordinal (chaos key)
         self._decode_session_idx = 0
         self._ema_decode_step_s = 0.0   # feeds decode retry_after_ms
+        self._decode_tokens_ema = 0.0   # tokens/sec, for health probes
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> "ServingEngine":
@@ -1301,7 +1381,8 @@ class ServingEngine:
         self._decode_have_work.set()
         return reply
 
-    def warm_decode(self, prompt_lens=(), max_new_tokens=None) -> int:
+    def warm_decode(self, prompt_lens=(), max_new_tokens=None,
+                    samplers=()) -> int:
         """Pre-compile (or AOT-load, when the export_cache store is
         armed) every decode-tier executable this engine can dispatch:
         the fused `decode_step`, each pow2 `decode_scan` rung up to
@@ -1313,14 +1394,18 @@ class ServingEngine:
         `prompt_lens` are the raw prompt lengths expected (bucketed
         exactly like submit_decode buckets them); `max_new_tokens`
         sizes the slab's sequence rung (defaults to the engine
-        ceiling). Warm dispatches run real (cheap) programs against
-        the pooled slab and discard the results — pad prefill rows
-        carry an out-of-bounds slot, so nothing is written. Returns
-        the number of executables warmed."""
+        ceiling); `samplers` is the (temperature, top_k) pairs
+        sampled traffic will use — `model.sample_fn` compiles per
+        pair, and an unwarmed pair lands its compile inside the first
+        sampled session's TTFT. Warm dispatches run real (cheap)
+        programs against the pooled slab and discard the results —
+        pad prefill rows carry an out-of-bounds slot, so nothing is
+        written. Returns the number of executables warmed."""
+        import jax
         import jax.numpy as jnp
 
         n_new = int(max_new_tokens if max_new_tokens is not None
-                    else self.max_new_tokens)
+                    else self.decode_max_new)
         pol = self.policy
 
         def bseq(n):
@@ -1348,6 +1433,13 @@ class ServingEngine:
         lg, _ = model.decode_step(params, self._slab, tok, pos)
         np.asarray(lg)
         warmed += 1
+        for t_k in samplers:
+            t, k = float(t_k[0]), int(t_k[1])
+            if t == 0.0:
+                continue  # greedy is an argmax on host, nothing to warm
+            key, sub = jax.random.split(jax.random.PRNGKey(0))
+            np.asarray(model.sample_fn(t, k)(jnp.asarray(lg[0:1]), sub))
+            warmed += 1
         ks = set()
         k = 2
         while k <= self.decode_block:
@@ -1375,6 +1467,204 @@ class ServingEngine:
                 warmed += 1
             bb <<= 1
         return warmed
+
+    # -- decode tier: live migration (ISSUE 17) ---------------------------
+    def export_decode_sessions(self) -> List[Dict]:
+        """Checkpoint every in-flight decode session OFF this engine
+        for live migration. Stops the decode dispatcher (it restarts
+        lazily on the next admission — the forward tier keeps
+        serving), snapshots each queued + live session into a portable
+        checkpoint (prompt, generated-token ledger, sampling config +
+        seed — the PRNG key schedule re-derives from these two —
+        deadline remainder, and the slot's exported KV rows for live
+        sessions), fails the local reply with `ServeMigratedError`
+        carrying the checkpoint, and returns the checkpoints.
+
+        Counters: each exported session decrements `sessions` and
+        counts `migrated` — it left these books without a terminal and
+        will be re-admitted (re-counted) wherever it resumes, so the
+        4-equation reconciliation stays exact on BOTH engines. A
+        session whose deadline already passed is expired here instead
+        of shipped (nobody should pay migration for a dead session).
+
+        If the decode dispatcher is HUNG mid-step past the drain
+        timeout, live sessions export WITHOUT their KV (ledger replay
+        on the target) — the slab may be mid-write and a torn KV row
+        is exactly the corruption migration must never ship;
+        correctness first, the KV transplant is only the fast path.
+        Checkpoint leaves are numpy arrays / scalars / None only, so
+        the dict crosses `fleet_proc.encode_tree` unchanged."""
+        with self._decode_lock:
+            self._decode_running = False
+        self._decode_have_work.set()
+        t, self._decode_thread = self._decode_thread, None
+        hung = False
+        if t is not None:
+            t.join(self.drain_timeout_s)
+            hung = t.is_alive()
+        dst = stats_mod.decode_stats()
+        model = self.model
+        now = time.perf_counter()
+        with self._decode_lock:
+            waiting = list(self._dqueue)
+            self._dqueue.clear()
+            live = sorted(self._decode_live.items())
+            self._decode_live.clear()
+            slab = self._slab
+            if slab is not None:
+                self._slab_free = list(range(int(slab[0].shape[1])))
+            self._decode_reserved = 0
+            dst.slots_in_use = 0
+        out: List[Dict] = []
+        for slot, sess in list(live) + [(-1, s) for s in waiting]:
+            # snapshot the ledger ONCE; position state derives from it
+            # (a hung dispatcher may still be mutating sess.pos)
+            toks = list(sess.toks)
+            had_slot = slot >= 0
+            sess.slot = -1
+            rem = None
+            if sess.deadline is not None:
+                rem = (sess.deadline - now) * 1e3
+                if rem <= 0:
+                    if sess.reply._fail(ServeDeadlineError(
+                            "decode session expired at migration "
+                            f"with {sess.left} of {sess.n_new} "
+                            "tokens left")):
+                        dst.expired += 1
+                    if had_slot:
+                        dst.leaves += 1
+                    continue
+            kv = None
+            if had_slot and toks and not hung and slab is not None:
+                kv = model.export_slab_rows(
+                    slab, slot, int(sess.prompt.shape[1]) + len(toks) - 1)
+            elif sess.resume_kv is not None:
+                kv = sess.resume_kv  # queued resume: pass it through
+            ckpt = {
+                "prompt": sess.prompt,
+                "toks": np.asarray(toks, np.int32),
+                "n_new": sess.n_new,
+                "temperature": sess.temperature,
+                "top_k": sess.top_k,
+                "seed": sess.seed,
+                "deadline_ms_left": rem,
+                "kv": kv,
+            }
+            if sess.reply._fail(ServeMigratedError(
+                    f"decode session migrated mid-stream "
+                    f"({len(toks)} of {sess.n_new} tokens produced); "
+                    "the continuation resumes elsewhere", ckpt=ckpt)):
+                dst.sessions -= 1
+                dst.migrated += 1
+                if had_slot:
+                    dst.leaves += 1
+                out.append(ckpt)
+        return out
+
+    def resume_decode(self, ckpt: Dict) -> ServeReply:
+        """Admit a migrated session's checkpoint mid-stream and return
+        a fresh `ServeReply` whose stream re-plays the ledger prefix
+        first (consumers that dedupe by count — the fleet's stream
+        proxy — see no tear and no duplicate) and then continues
+        bit-identically to the original `generate()`: the PRNG key is
+        re-derived by replaying `len(toks)` splits from the seed, and
+        the KV state either transplants directly (`ckpt["kv"]`, the
+        fast path) or rebuilds by re-prefilling prompt + ledger[:-1]
+        (the replay path — correctness does not depend on the
+        checkpoint's KV). Counts as a NEW admission (`sessions` +
+        `resumed`; overload at admission counts `shed` exactly like
+        `submit_decode`) — the exporter already took the session off
+        its own books."""
+        import jax
+
+        prompt = np.asarray(ckpt["prompt"], np.int32)
+        if prompt.ndim == 1:
+            prompt = prompt[None, :]
+        raw = ckpt.get("toks")
+        toks = ([] if raw is None
+                else [int(x) for x in np.asarray(raw).ravel()])
+        n_new = int(np.asarray(ckpt["n_new"]))
+        temperature = float(np.asarray(ckpt.get("temperature", 0.0)))
+        top_k = int(np.asarray(ckpt.get("top_k", 0)))
+        seed = int(np.asarray(ckpt.get("seed", 0)))
+        rem = ckpt.get("deadline_ms_left")
+        kv = ckpt.get("kv")
+        P = int(prompt.shape[1])
+        k0 = len(toks)
+        if P < 1 or n_new < 1 or k0 > n_new:
+            raise ValueError(
+                f"malformed decode checkpoint: P={P}, n_new={n_new}, "
+                f"ledger={k0}")
+        deadline = (None if rem is None
+                    else time.perf_counter()
+                    + float(np.asarray(rem)) / 1e3)
+        ctx = trace_mod.current_trace()
+        sess_trace = (None if ctx is None else
+                      (ctx["trace_id"],
+                       trace_mod.current_span_id() or ctx["parent"]))
+        dst = stats_mod.decode_stats()
+        if k0 >= n_new:
+            # already complete (defensive: finished sessions retire
+            # before export) — deliver the full sequence immediately
+            reply = ServeReply(1)
+            for t_ in toks:
+                reply._push_token(t_)
+            dst.sessions += 1
+            dst.resumed += 1
+            if reply._deliver(np.concatenate(
+                    [prompt, np.asarray([toks], np.int32)], axis=1)):
+                dst.completed += 1
+            return reply
+        key = None
+        if temperature != 0.0 and k0 > 0:
+            # generate()'s exact schedule: one split per produced
+            # token, next-key half kept — replayed from the seed
+            key = jax.random.PRNGKey(seed)
+            for _ in range(k0):
+                key, _ = jax.random.split(key)
+        # the ledger re-streams through the NEW reply BEFORE the
+        # session can reach the dispatcher: a consumer that skips the
+        # first k0 tokens (the stream proxy) observes one seamless,
+        # gapless stream — ledger first, then live continuation
+        reply = ServeReply(1)
+        for t_ in toks:
+            reply._push_token(t_)
+        with self._decode_lock:
+            if not self._running:
+                raise ServeClosedError(
+                    "engine not running: call start()")
+            dst.sessions += 1
+            dst.slots = self.max_sessions
+            if self._decode_reserved >= self.max_sessions:
+                dst.shed += 1
+                raise ServeOverloadError(
+                    f"decode slot pool exhausted ({self.max_sessions} "
+                    "sessions reserved); resume elsewhere or retry "
+                    "after the hinted backoff",
+                    retry_after_ms=self._estimate_decode_retry_ms())
+            self._decode_reserved += 1
+            self._decode_session_idx += 1
+            sess = _DecodeSession(prompt, n_new, temperature, top_k,
+                                  seed, reply, deadline, sess_trace,
+                                  self._decode_session_idx)
+            if k0:
+                sess.resumed = True
+                sess.toks = list(toks)
+                sess.tok = toks[-1]
+                sess.key = key
+                if kv is not None:
+                    sess.resume_kv = np.asarray(kv)
+            dst.resumed += 1
+            self._dqueue.append(sess)
+            need_thread = self._decode_thread is None
+            if need_thread:
+                self._decode_running = True
+                self._decode_thread = threading.Thread(
+                    target=self._decode_supervised_loop,
+                    name="singa_tpu-serve-decode", daemon=True)
+                self._decode_thread.start()
+        self._decode_have_work.set()
+        return reply
 
     # -- decode tier: the continuous-batching dispatcher ------------------
     def _slab_seq_bucket(self, need_t: int) -> int:
@@ -1558,6 +1848,10 @@ class ServingEngine:
                 self._decode_have_work.clear()
                 continue
             self._decode_expire(dst)
+            # -- resume fast path: transplant migrated KV rows first
+            # (a resumed session re-joins WITHOUT a prefill dispatch)
+            if self._decode_admit_imports(dst):
+                geom = self._decode_geom()
             # -- admit: ONE cohort prefill dispatch, bounded per cycle
             cohort = []
             while len(cohort) < self.prefill_batch:
@@ -1565,13 +1859,18 @@ class ServingEngine:
                     if not self._dqueue:
                         break
                     head = self._dqueue[0]
-                    P_h = int(head.prompt.shape[1])
+                    if head.resume_kv is not None:
+                        # a KV import can't ride the prefill program;
+                        # it waits for the next cycle's import pass
+                        break
+                    P_h = self._prefill_len(head)
                     pol = self.policy
                     Pb_h = (pol.bucket_seq(P_h)
                             if pol.max_seq is not None
                             and P_h <= pol.max_seq
                             else _pow2_ceil(P_h))
-                    need_t = max(P_h + head.n_new, Pb_h)
+                    need_t = max(
+                        int(head.prompt.shape[1]) + head.n_new, Pb_h)
                     if self._slab is None:
                         geom = self._build_slab(need_t)
                     elif need_t > int(self._slab[0].shape[3]):
@@ -1592,7 +1891,84 @@ class ServingEngine:
                 live = sorted(self._decode_live.items())
             if not live:
                 continue
+            if geom is None:
+                geom = self._decode_geom()
             self._decode_fused_step(live, geom, dst)
+
+    @staticmethod
+    def _prefill_len(sess: "_DecodeSession") -> int:
+        """How many token ids this session's prefill runs: the prompt,
+        plus — for a ledger REPLAY resume — every produced token
+        except the last (which is the next step's input, exactly where
+        the original stream stood)."""
+        P = int(sess.prompt.shape[1])
+        if sess.resumed and len(sess.toks) > 1:
+            return P + len(sess.toks) - 1
+        return P
+
+    def _decode_admit_imports(self, dst) -> bool:
+        """Admit queued KV-import resumes (head-of-queue order, like
+        every other admission): size the slab for each, take a free
+        slot, and transplant the exported rows — no prefill dispatch.
+        Returns whether anything joined (the caller refreshes its
+        cached geometry)."""
+        any_in = False
+        while True:
+            with self._decode_lock:
+                if (not self._dqueue
+                        or self._dqueue[0].resume_kv is None):
+                    break
+                head = self._dqueue[0]
+                need_t = max(
+                    int(head.prompt.shape[1]) + head.n_new,
+                    int(head.resume_kv.shape[3]))
+                if self._slab is None:
+                    self._build_slab(need_t)
+                elif need_t > int(self._slab[0].shape[3]):
+                    self._grow_slab(need_t)
+                if not self._slab_free:
+                    break
+                sess = self._dqueue.popleft()
+                slot = self._slab_free.pop(0)
+            if self._decode_import(sess, slot, dst):
+                any_in = True
+        return any_in
+
+    def _decode_import(self, sess: "_DecodeSession", slot: int,
+                       dst) -> bool:
+        """Transplant a migrated session's KV rows into slab row
+        `slot` and join the fused batch directly. Any import failure
+        (geometry drift across replicas, a torn checkpoint) demotes
+        the session to ledger REPLAY instead of failing it —
+        correctness never depends on the fast path."""
+        t0 = time.perf_counter()
+        kv = sess.resume_kv
+        try:
+            self._slab = self.model.import_slab_rows(
+                self._slab, slot, kv)
+        except BaseException:  # noqa: BLE001 — demote to replay
+            sess.resume_kv = None
+            self._release_slot(slot)
+            with self._decode_lock:
+                self._dqueue.appendleft(sess)
+            return False
+        now = time.perf_counter()
+        sess.resume_kv = None
+        P = int(sess.prompt.shape[1])
+        k0 = len(sess.toks)
+        sess.slot = slot
+        sess.pos = P + k0 - 1
+        sess.left = sess.n_new - k0
+        sess.tok = sess.toks[-1]
+        sess.reply.state = "dispatching"
+        sess.t_last_tok = now
+        trace_mod.record_span("resume_import", t0, now,
+                              trace=sess.trace, prompt=P, ledger=k0)
+        dst.joins += 1
+        with self._decode_lock:
+            self._decode_live[slot] = sess
+            dst.slots_in_use = len(self._decode_live)
+        return True
 
     def _release_slot(self, slot: int) -> None:
         """Return a slab row to the free pool (sorted, so admission
@@ -1641,7 +2017,7 @@ class ServingEngine:
         # slot p before any query attends it (see prefill_slab).
         Pb = 1
         for sess, _ in members:
-            P = int(sess.prompt.shape[1])
+            P = self._prefill_len(sess)
             Pb = max(Pb, (pol.bucket_seq(P)
                           if pol.max_seq is not None and P <= pol.max_seq
                           else _pow2_ceil(P)))
@@ -1658,9 +2034,15 @@ class ServingEngine:
         nvec = np.ones(Bb, np.int32)
         slotv = np.full(Bb, n_slots, np.int32)  # OOB => dropped
         for r, (sess, slot) in enumerate(members):
-            P = int(sess.prompt.shape[1])
-            ids[r, :P] = sess.prompt[0]
-            nvec[r] = P
+            # a ledger-REPLAY resume prefills prompt + toks[:-1]: the
+            # rebuilt cache is bit-identical to the one the original
+            # replica held when it produced toks[-1]
+            row = sess.prompt[0]
+            if sess.resumed and len(sess.toks) > 1:
+                row = np.concatenate(
+                    [row, np.asarray(sess.toks[:-1], np.int32)])
+            ids[r, :len(row)] = row
+            nvec[r] = len(row)
             slotv[r] = slot
         t0 = time.perf_counter()
         try:
@@ -1683,6 +2065,27 @@ class ServingEngine:
         trace_mod.record_span("prefill", t0, now, rows=Bp, bucket=Pb)
         for r, (sess, slot) in enumerate(members):
             P = int(sess.prompt.shape[1])
+            if sess.resumed:
+                # replay resume: the prefill rebuilt the KV state; the
+                # ledger already holds every produced token (streamed
+                # at admission) and toks[-1] is the next step's input
+                # — discard this row's logits, restore position state
+                k0 = len(sess.toks)
+                sess.slot = slot
+                sess.pos = P + k0 - 1
+                sess.left = sess.n_new - k0
+                sess.tok = sess.toks[-1]
+                sess.reply.state = "dispatching"
+                sess.t_last_tok = now
+                trace_mod.record_span("resume_replay", t0, now,
+                                      trace=sess.trace, prompt=P,
+                                      ledger=k0)
+                dst.prefills += 1
+                dst.joins += 1
+                with self._decode_lock:
+                    self._decode_live[slot] = sess
+                    dst.slots_in_use = len(self._decode_live)
+                continue
             if sess.temperature == 0.0:
                 # host argmax on identical float bits == the traced
                 # jnp.argmax (both first-max-wins): no extra dispatch
@@ -1812,6 +2215,10 @@ class ServingEngine:
         self._ema_decode_step_s = (
             step_s if not self._ema_decode_step_s
             else 0.8 * self._ema_decode_step_s + 0.2 * step_s)
+        rate = (len(live) * k / block_s) if block_s > 0 else 0.0
+        self._decode_tokens_ema = (
+            rate if not self._decode_tokens_ema
+            else 0.8 * self._decode_tokens_ema + 0.2 * rate)
         dst.decode_steps += k
         trace_mod.record_span("decode_step", t0, t0 + block_s,
                               rows=len(live), slots=Sb, steps=k)
@@ -2323,6 +2730,11 @@ class ServingEngine:
                 reasons.append(
                     f"queue depth {self._depth} at the shed "
                     f"watermark ({wm})")
+        with self._decode_lock:
+            decode_active = (len(self._decode_live)
+                             + len(self._dqueue))
+            decode_free = max(
+                0, self.max_sessions - self._decode_reserved)
         snap = {
             "state": state,
             "reasons": reasons,
@@ -2333,6 +2745,15 @@ class ServingEngine:
             "shed": _STATS.shed,
             "retries": _STATS.retries,
             "failed": _STATS.failed,
+            # decode-tier saturation (ISSUE 17): rides every health
+            # snapshot — and therefore every fleet heartbeat — so
+            # admission-aware placement can see per-replica KV-slot
+            # occupancy without extra wire traffic
+            "decode": {
+                "active_sessions": decode_active,
+                "free_slots": decode_free,
+                "tokens_per_s": round(self._decode_tokens_ema, 3),
+            },
         }
         with self._health_lock:
             if state != self._health_state:

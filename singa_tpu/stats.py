@@ -665,6 +665,15 @@ class _DecodeStats:
         self.tokens_streamed = 0
         self.decode_steps = 0   # fused decode_step dispatches
         self.prefills = 0       # prefill dispatches
+        # KV migration (ISSUE 17). `migrated` counts sessions exported
+        # off this engine's books (each decrements `sessions` too, so
+        # the 4-equation reconciliation stays exact per engine: the
+        # session is re-admitted — and re-counted — wherever it
+        # resumes); `resumed` counts sessions admitted THROUGH
+        # resume_decode (KV import or ledger replay) rather than a
+        # fresh submit.
+        self.migrated = 0
+        self.resumed = 0
 
     def snapshot(self) -> Dict:
         out = self.cache.snapshot()
@@ -680,6 +689,8 @@ class _DecodeStats:
             "tokens_streamed": self.tokens_streamed,
             "decode_steps": self.decode_steps,
             "prefills": self.prefills,
+            "migrated": self.migrated,
+            "resumed": self.resumed,
             "slots": self.slots,
             "slots_in_use": self.slots_in_use,
         })

@@ -967,11 +967,14 @@ def aggregate_fleet(paths=None, spans=None,
                     `spans` (the `merge_chrome_traces` output).
 
     Returns {schema, kind, requests/replies/failed/rejected + routing
-    counters, availability_pct, segments (queue/ipc/dispatch/reply/...
-    p50/p99), events (the ejection/restart/kill state-transition
-    timeline), workers (per-pid dispatch totals), trace_ids}. Every
-    field is always present (None/empty when the inputs don't carry
-    it) — the schema-stable contract every consumer pins on."""
+    counters, availability_pct, segments (queue/ipc/dispatch/reply/
+    ttft/tpot/... p50/p99), events (the ejection/restart/kill
+    state-transition timeline), workers (per-pid dispatch totals),
+    decode (session terminals + migration/replay counts, ISSUE 17),
+    replica_decode (per-replica session occupancy from the router's
+    final record), trace_ids}. Every field is always present
+    (None/empty when the inputs don't carry it) — the schema-stable
+    contract every consumer pins on."""
     import glob as glob_mod
 
     files: List[str] = []
@@ -984,6 +987,7 @@ def aggregate_fleet(paths=None, spans=None,
     counters: Dict[str, int] = {}
     events: List[Dict] = []
     workers: Dict[str, Dict] = {}
+    replica_decode: Dict[str, Dict] = {}
     for f in files:
         for rec in read_metrics(f):
             x = rec.get("extra") or {}
@@ -993,10 +997,19 @@ def aggregate_fleet(paths=None, spans=None,
                 for k in ("fleet_requests", "fleet_replies",
                           "fleet_failed", "routed", "failovers",
                           "refused", "rejected", "ejections",
-                          "rejoins", "restarts", "kills_injected"):
+                          "rejoins", "restarts", "kills_injected",
+                          "decode_requests", "decode_replies",
+                          "decode_failed", "decode_migrations",
+                          "decode_replays"):
                     v = x.get(k)
                     if isinstance(v, (int, float)):
                         counters[k] = max(counters.get(k, 0), int(v))
+                # per-replica decode occupancy (ISSUE 17): the router
+                # attaches a snapshot to its final "stop" record —
+                # last writer wins (the freshest view of each replica)
+                rd = x.get("replica_decode")
+                if isinstance(rd, dict):
+                    replica_decode.update(rd)
                 if x["event"] == "transition":
                     events.append({
                         "t": rec.get("time"),
@@ -1051,6 +1064,16 @@ def aggregate_fleet(paths=None, spans=None,
         "segments": _segment_stats(all_spans),
         "events": events,
         "workers": workers,
+        # decode tier (ISSUE 17) — additive, schema-stable: always
+        # present, None/empty when the inputs carry no decode traffic
+        "decode": {
+            "requests": counters.get("decode_requests"),
+            "replies": counters.get("decode_replies"),
+            "failed": counters.get("decode_failed"),
+            "migrations": counters.get("decode_migrations"),
+            "replays": counters.get("decode_replays"),
+        },
+        "replica_decode": replica_decode,
         "trace_ids": len(trace_ids),
         "span_count": len(all_spans),
     }

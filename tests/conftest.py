@@ -65,3 +65,34 @@ def pytest_collection_modifyitems(config, items):
     if deselected:
         config.hook.pytest_deselected(items=deselected)
         items[:] = selected
+
+
+def pytest_sessionstart(session):
+    session.config._t1_t0 = __import__("time").time()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Tier-1 wall-clock guard (ISSUE 17 satellite): the default
+    (non-slow) run must stay inside the driver's pytest budget —
+    creeping past it fails the WHOLE tier silently at the timeout
+    kill, which reads as a hang, not a regression. Warn LOUDLY past
+    90% of the budget so the session that added the weight sees it;
+    non-fatal because a loaded CI box must not flake the tier.
+    `SINGA_TPU_T1_BUDGET_S` overrides (0 disables)."""
+    import time
+
+    budget = float(os.environ.get("SINGA_TPU_T1_BUDGET_S", "870"))
+    if budget <= 0 or not hasattr(session.config, "_t1_t0"):
+        return
+    took = time.time() - session.config._t1_t0
+    if took > 0.9 * budget:
+        import warnings
+
+        warnings.warn(
+            f"tier-1 wall clock {took:.0f}s is past 90% of the "
+            f"{budget:.0f}s budget (SINGA_TPU_T1_BUDGET_S) — move the "
+            "heaviest new tests behind -m slow before the driver's "
+            "timeout kill turns this into a silent tier failure",
+            stacklevel=0)
+        print(f"\n[t1-budget] WARNING: {took:.0f}s of {budget:.0f}s "
+              "budget used — shed weight to -m slow", flush=True)

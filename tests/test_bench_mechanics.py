@@ -519,6 +519,137 @@ def test_tpu_watch_decode_flavor():
         assert key in block, f"decode watch block missing {key}"
 
 
+def test_fleet_decode_stage_contract_pins():
+    """ISSUE 17: the fleet-decode stage's load-bearing mechanics,
+    pinned at the source level (the full run lives in the slow tier —
+    it needs the box to itself for an honest capacity ratio):
+    dispatch branch + metric name, the >= 1.7x gate computed from the
+    measured ratio, SIGKILLs DISCOVERED from worker exit codes (-9)
+    rather than trusted from the injector, the burst gap sized off
+    the FLEET's drain (replicas x the baseline's), the sampler pair
+    warmed so no compile lands inside a sampled session's TTFT, and
+    the stale-telemetry cleanup before the run."""
+    src = open(os.path.join(_ROOT, "bench.py")).read()
+    assert 'if a.stage == "fleet-decode":' in src
+    assert "def stage_fleet_decode(" in src
+    assert '"metric": "fleet_decode_tokens_per_sec"' in src
+    assert '"speedup_gate_1p7x": bool(speedup >= 1.7)' in src
+    assert 'g.get("exit_code") == -9' in src
+    assert "8.0 * replicas * d_batch" in src
+    assert 'samplers=[(0.7, 8)]' in src
+    assert "bench_fleet_decode.jsonl" in src
+    # the chaos arm waits for the supervisor to FINISH the respawns
+    # before reading counters — stopping mid-respawn under-reports
+    # `restarts` and strands a half-booted worker
+    assert ">= len(kill_at)" in src
+    # driver ramp row next to the serve-decode row it scales out
+    assert 'run_stage("fleet-decode"' in src
+    assert 'result_extra["fleet_decode_tokens_per_sec"]' in src
+
+
+@pytest.mark.slow
+def test_fleet_decode_acceptance_gate():
+    """The ISSUE 17 acceptance at full strength: >= 1.7x aggregate
+    decode tokens/sec over the 1-replica engine at 2 proc replicas
+    under the same burst schedule, every delivered stream
+    bit-identical, the 4-equation + transport reconciliation exact,
+    and the chaos arm with >= 2 REAL SIGKILLs delivering zero torn
+    tokens. Slow-tier: the capacity ratio needs the box to itself."""
+    proc, result = _run_stage(
+        ["--stage", "fleet-decode", "--requests", "48",
+         "--deadline", "500", "--chaos"], timeout=560)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert result is not None, "no JSON result line on stdout"
+    assert result["ok"] is True
+    assert result["metric"] == "fleet_decode_tokens_per_sec"
+    for k in ("fleet_decode_tokens_per_sec", "baseline_tokens_per_sec",
+              "speedup_vs_single_engine", "speedup_gate_1p7x",
+              "streams_match", "counters_reconcile",
+              "transport_reconcile", "ttft_p99_ms", "tpot_p99_ms",
+              "slo_segments", "trace", "chaos"):
+        assert k in result, f"fleet-decode result missing {k}"
+    assert result["speedup_vs_single_engine"] >= 1.7, (
+        f"fleet decode only {result['speedup_vs_single_engine']}x "
+        "vs the single engine")
+    assert result["speedup_gate_1p7x"] is True
+    assert result["streams_match"] is True
+    assert result["counters_reconcile"] is True
+    assert result["transport_reconcile"] is True
+    assert result["slo_segments"]["ttft"]["count"] > 0
+    assert result["slo_segments"]["tpot"]["count"] > 0
+    c = result["chaos"]
+    assert c["sigkills"] >= 2
+    assert c["streams_match"] is True
+    assert c["counters_reconcile"] is True
+    assert c["transport_reconcile"] is True
+
+
+def test_fold_onchip_renders_fleet_decode_stage(tmp_path, capsys,
+                                               monkeypatch):
+    """ISSUE 17: tools/fold_onchip.py renders fleet-decode rows
+    (aggregate tok/s, capacity ratio, TTFT/TPOT SLOs, migrations/
+    replays, chaos SIGKILL evidence) and flags a gate, bit-identity,
+    or reconciliation break loudly; logs without the key fold
+    unchanged."""
+    fold = _load_module("fold_onchip_for_fd_test",
+                        "tools/fold_onchip.py")
+    logs = tmp_path / "onchip_logs"
+    logs.mkdir()
+    row = {"ok": True, "metric": "fleet_decode_tokens_per_sec",
+           "fleet_decode_tokens_per_sec": 86.4,
+           "speedup_vs_single_engine": 1.96, "replicas": 2,
+           "ttft_p50_ms": 40.1, "ttft_p99_ms": 95.2,
+           "tpot_p50_ms": 11.3, "tpot_p99_ms": 31.7,
+           "migrations": 3, "replays": 1,
+           "streams_match": True, "counters_reconcile": True,
+           "transport_reconcile": True, "speedup_gate_1p7x": True,
+           "chaos": {"availability_pct": 62.5, "sigkills": 2,
+                     "replays": 2, "streams_match": True,
+                     "counters_reconcile": True,
+                     "transport_reconcile": True}}
+    (logs / "fleet_decode.out").write_text(json.dumps(row) + "\n")
+    monkeypatch.setattr(fold, "LOGS", str(logs))
+    assert fold.main() == 0
+    out = capsys.readouterr().out
+    assert "86 tok/s" in out
+    assert "x1.96 vs 1 engine" in out
+    assert "2 proc replicas" in out
+    assert "ttft p99 95.2 ms" in out
+    assert "tpot p99 31.7 ms" in out
+    assert "3 migrations" in out and "1 replays" in out
+    assert "chaos: 62.5% avail, 2 SIGKILLs/2 replays" in out
+    assert "MISMATCH" not in out
+    # a failed capacity gate is a loud MISMATCH, not a quiet number
+    row["speedup_gate_1p7x"] = False
+    (logs / "fleet_decode.out").write_text(json.dumps(row) + "\n")
+    assert fold.main() == 0
+    assert "MISMATCH" in capsys.readouterr().out
+
+
+def test_tpu_watch_fleet_decode_flavor():
+    """tools/tpu_watch.sh grows a `fleet-decode` flavor tailing the
+    decode router's control plane (session terminals, migration/
+    replay counters, per-replica KV occupancy, TTFT/TPOT p99). It
+    must sit ABOVE the `fleet` flavor (whose match would swallow the
+    "fleet-decode" argument), and the PR 16 `decode` flavor's glob
+    must now EXCLUDE fleet_decode streams — `bench_fleet_decode
+    .jsonl` matches `*decode*.jsonl` too."""
+    sh = open(os.path.join(_ROOT, "tools", "tpu_watch.sh")).read()
+    fdec = sh.index('"$1" = "fleet-decode"')
+    flt = sh.index('"$1" = "fleet"')
+    dec = sh.index('"$1" = "decode"')
+    assert fdec < flt, "fleet-decode flavor must precede fleet"
+    block = sh[fdec:flt]
+    for key in ("*fleet_decode*.jsonl", "decode_requests",
+                "decode_replies", "decode_failed",
+                "decode_migrations", "decode_replays",
+                "replica_decode", "ttft", "tpot"):
+        assert key in block, f"fleet-decode watch block missing {key}"
+    dec_block = sh[dec:dec + 600]
+    assert "grep -v fleet" in dec_block, (
+        "decode flavor glob must exclude fleet_decode router streams")
+
+
 def test_byte_diet_matrix_flags_validate_in_argparse():
     """An invalid --slot-dtype/--bn-stats-dtype must die in argparse,
     before any jax/tunnel work can measure the wrong thing (the same

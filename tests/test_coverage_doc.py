@@ -273,3 +273,30 @@ def test_decode_serving_row_and_readme_section_present():
     assert "ttft" in readme and "tpot" in readme
     assert "serve_decode_tokens_per_sec" in readme
     assert "set_decode_serving" in readme
+
+
+def test_fleet_decode_row_and_readme_section_present():
+    """ISSUE 17 doc contract: the P25 fleet-wide decode row and the
+    README "Fleet decode serving" section exist (session-affine
+    occupancy routing, live KV-slab migration, resume-vs-replay, the
+    error taxonomy, fleet-wide reconciliation, the 1.7x bench
+    gate)."""
+    cov = open(os.path.join(_ROOT, "COVERAGE.md")).read()
+    assert "| P25 |" in cov
+    assert "tests/test_fleet_decode.py" in cov
+    assert "export_decode_sessions" in cov
+    assert "resume_decode" in cov
+    assert "FleetDecodeReply" in cov
+    assert "fleet-decode" in cov
+    assert "max_failover_hops" in cov
+    readme = open(os.path.join(_ROOT, "README.md")).read()
+    assert "## Fleet decode serving" in readme
+    assert "submit_decode" in readme
+    assert "session_id" in readme
+    assert "export_decode_sessions" in readme
+    assert "resume_decode" in readme
+    assert "ServeMigratedError" in readme
+    assert "fleet_decode_tokens_per_sec" in readme
+    assert "1.7x" in readme
+    assert "decode0=" in readme
+    assert "fleet-decode" in readme

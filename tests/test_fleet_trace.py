@@ -236,6 +236,86 @@ def test_aggregate_fleet_rolls_streams_into_one_record(tmp_path):
     assert empty["availability_pct"] is None
 
 
+def test_aggregate_fleet_decode_block_and_replica_table(tmp_path):
+    """ISSUE 17: records carrying decode-tier counters +
+    `replica_decode` occupancy roll into an additive `decode` block
+    and per-replica table; streams WITHOUT them aggregate exactly as
+    before (decode fields all None, table empty, schema unchanged) —
+    old logs keep parsing to the same shape."""
+    rpath = str(tmp_path / "router_fleet_decode.jsonl")
+    with open(rpath, "w") as f:
+        f.write(json.dumps({
+            "time": 1.0, "step": 1, "extra": {
+                "event": "aggregate", "fleet_requests": 0,
+                "decode_requests": 8, "decode_replies": 6,
+                "decode_failed": 1, "decode_migrations": 2,
+                "decode_replays": 1,
+                "replica_decode": {
+                    "w0": {"active_sessions": 3, "free_slots": 1,
+                           "tokens_per_s": 41.5},
+                    "w1": {"active_sessions": 0, "free_slots": 4,
+                           "tokens_per_s": 0.0}}}}) + "\n")
+    spans = [{"name": "ttft", "ts": 0.0, "dur": 50_000.0,
+              "trace": "t1"},
+             {"name": "tpot", "ts": 1.0, "dur": 9_000.0,
+              "trace": "t1"}]
+    agg = trace.aggregate_fleet(paths=[rpath], spans=spans)
+    assert agg["schema"] == trace.FLEET_AGGREGATE_SCHEMA
+    assert agg["decode"] == {"requests": 8, "replies": 6,
+                             "failed": 1, "migrations": 2,
+                             "replays": 1}
+    assert agg["replica_decode"]["w0"]["free_slots"] == 1
+    assert agg["replica_decode"]["w1"]["active_sessions"] == 0
+    assert agg["segments"]["ttft"]["p50_ms"] == 50.0
+    assert agg["segments"]["tpot"]["p99_ms"] == 9.0
+    # decode-less streams: same schema, decode side empty — not absent
+    empty = trace.aggregate_fleet()
+    assert set(empty) == set(agg)
+    assert empty["decode"] == {"requests": None, "replies": None,
+                               "failed": None, "migrations": None,
+                               "replays": None}
+    assert empty["replica_decode"] == {}
+
+
+def test_fleet_top_renders_decode_block(tmp_path, capsys):
+    """ISSUE 17 satellite: fleet_top shows the decode session
+    terminals + the per-replica occupancy table when present, and
+    renders decode-less aggregates exactly as before (no decode
+    lines)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "fleet_top_for_decode_test", os.path.join(_ROOT, "tools",
+                                                  "fleet_top.py"))
+    ft = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ft)
+    rpath = str(tmp_path / "bench_fleet_decode.jsonl")
+    with open(rpath, "w") as f:
+        f.write(json.dumps({"time": 1.0, "step": 1, "extra": {
+            "event": "aggregate", "fleet_requests": 2,
+            "fleet_replies": 2, "decode_requests": 5,
+            "decode_replies": 4, "decode_failed": 1,
+            "decode_migrations": 1, "decode_replays": 0,
+            "replica_decode": {
+                "w0": {"active_sessions": 2, "free_slots": 2,
+                       "tokens_per_s": 33.3}}}}) + "\n")
+    assert ft.main(["--dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "decode: sessions 5" in out
+    assert "migrations 1" in out
+    assert "w0" in out and "33.3" in out
+    # decode-less stream: the decode lines are simply absent
+    rpath2 = str(tmp_path / "old" / "bench_fleet.jsonl")
+    os.makedirs(os.path.dirname(rpath2))
+    with open(rpath2, "w") as f:
+        f.write(json.dumps({"time": 1.0, "step": 1, "extra": {
+            "event": "route", "fleet_requests": 4,
+            "fleet_replies": 4}}) + "\n")
+    assert ft.main(["--dir", os.path.dirname(rpath2)]) == 0
+    out2 = capsys.readouterr().out
+    assert "decode:" not in out2 and "free_slots" not in out2
+
+
 def test_fleet_top_cli_renders_aggregate(tmp_path, capsys):
     import importlib.util
 

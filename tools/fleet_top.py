@@ -13,6 +13,10 @@ fleet` writes — into ONE aggregated view via
   - the failover / ejection / restart / kill event timeline
   - per-worker dispatch totals (keyed by writer pid, the v2
     MetricsLogger field)
+  - decode tier (ISSUE 17), when the streams carry it: session
+    terminals + migration/replay counts, TTFT/TPOT p50/p99 segments,
+    and per-replica KV-slot occupancy (absent fields render as
+    before)
 
 Usage:
   tools/fleet_top.py [--dir metrics] [--trace metrics/bench_fleet_trace.json]
@@ -50,12 +54,20 @@ def render(agg, events_n):
         f"{_fmt(agg['failovers'])}  refused {_fmt(agg['refused'])}  "
         f"ejections {_fmt(agg['ejections'])}  restarts "
         f"{_fmt(agg['restarts'])}  kills {_fmt(agg['kills'])}")
+    dec = agg.get("decode") or {}
+    if dec.get("requests") is not None:
+        lines.append(
+            f"decode: sessions {_fmt(dec['requests'])}  replies "
+            f"{_fmt(dec['replies'])}  failed {_fmt(dec['failed'])}  "
+            f"migrations {_fmt(dec['migrations'])}  replays "
+            f"{_fmt(dec['replays'])}")
     segs = agg.get("segments") or {}
     if segs:
         lines.append(f"  {'segment':<16} {'count':>7} {'p50_ms':>9} "
                      f"{'p99_ms':>9}")
         for name in ("queue_wait", "ipc", "dispatch", "reply",
-                     "route", "failover", "submit", "batch_assemble"):
+                     "route", "failover", "submit", "batch_assemble",
+                     "ttft", "tpot"):
             s = segs.get(name)
             if s is None:
                 continue
@@ -64,6 +76,16 @@ def render(agg, events_n):
     else:
         lines.append("  (no spans — pass --trace, or run with "
                      "device.set_tracing(True))")
+    rd = agg.get("replica_decode") or {}
+    if rd:
+        lines.append(f"  {'replica':<16} {'sessions':>8} "
+                     f"{'free_slots':>10} {'tok/s':>9}")
+        for name in sorted(rd):
+            d = rd[name]
+            lines.append(
+                f"  {name:<16} {d.get('active_sessions', 0):>8d} "
+                f"{d.get('free_slots', 0):>10d} "
+                f"{d.get('tokens_per_s', 0.0):>9.1f}")
     workers = agg.get("workers") or {}
     if workers:
         lines.append(f"  {'worker':<24} {'dispatches':>10} "

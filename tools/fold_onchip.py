@@ -200,6 +200,41 @@ def main():
                          f"{r.get('p50_ms')} ms/p99 {r.get('p99_ms')} "
                          f"ms{fo}{rst}{bad}{seg}{ch}"
                          + _stage_breakdown(r) + ")" + mark))
+        elif "fleet_decode_tokens_per_sec" in r:
+            # fleet-wide KV-cached decode (ISSUE 17): aggregate
+            # delivered tokens/s over N worker processes vs the
+            # 1-replica engine baseline under the same burst schedule,
+            # with the >=1.7x capacity gate and SIGKILL-proof chaos
+            # evidence. Loud MISMATCH on a bit-identity, gate, or
+            # reconciliation break. Old logs (no key) fold unchanged.
+            bad = ("" if r.get("streams_match", True)
+                   and r.get("counters_reconcile", True)
+                   and r.get("transport_reconcile", True)
+                   and r.get("speedup_gate_1p7x", True)
+                   else " MISMATCH")
+            mig = (f", {r['migrations']} migrations"
+                   if r.get("migrations") else "")
+            rp = (f", {r['replays']} replays"
+                  if r.get("replays") else "")
+            ch = ""
+            if isinstance(r.get("chaos"), dict):
+                c = r["chaos"]
+                cbad = ("" if c.get("streams_match", True)
+                        and c.get("counters_reconcile", True)
+                        and c.get("transport_reconcile", True)
+                        else " MISMATCH")
+                ch = (f", chaos: {c.get('availability_pct')}% avail, "
+                      f"{c.get('sigkills', 0)} SIGKILLs/"
+                      f"{c.get('replays', 0)} replays{cbad}")
+            rows.append((stage,
+                         f"{r['fleet_decode_tokens_per_sec']:.0f} "
+                         f"tok/s  "
+                         f"(x{r.get('speedup_vs_single_engine')} vs "
+                         f"1 engine, {r.get('replicas')} proc "
+                         f"replicas, ttft p99 {r.get('ttft_p99_ms')} "
+                         f"ms, tpot p99 {r.get('tpot_p99_ms')} ms"
+                         f"{mig}{rp}{bad}{ch}"
+                         + _stage_breakdown(r) + ")" + mark))
         elif "serve_requests_per_sec" in r:
             # serving tier (ISSUE 7): throughput + SLO percentiles +
             # coalescing evidence, with the shared stage breakdown

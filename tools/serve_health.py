@@ -32,6 +32,11 @@ report the fleet healthy.
 
 The one-line summary (state + reasons + counters) prints to stdout;
 `--quiet` suppresses it for probe loops that only read the code.
+Engines with a KV-cached decode tier (ISSUE 17) add a
+`decode[sessions=.. free_slots=.. tok/s=..]` block per replica — the
+same occupancy numbers the fleet router's admission-aware placement
+reads from heartbeats — so `--all` doubles as a decode-saturation
+view.
 """
 import argparse
 import glob
@@ -73,6 +78,15 @@ def probe(path: str, max_age_s: float = 0.0):
         line += f"  pid={snap['pid']}"
     if counters:
         line += "  [" + counters + "]"
+    # Decode-tier saturation (ISSUE 17): engines with a KV-cached
+    # decode tier ship per-replica slot occupancy in every snapshot,
+    # so `--all` shows WHERE the fleet's sessions sit. Pre-17
+    # snapshots have no "decode" key and render byte-identically.
+    dec = snap.get("decode")
+    if isinstance(dec, dict):
+        line += (f"  decode[sessions={dec.get('active_sessions', 0)} "
+                 f"free_slots={dec.get('free_slots', 0)} "
+                 f"tok/s={dec.get('tokens_per_s', 0.0)}]")
     return _EXIT[state], line
 
 

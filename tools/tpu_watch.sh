@@ -40,6 +40,15 @@
 #                                      routed/failover/refused counters —
 #                                      the fleet's live control-plane log.
 
+#   tools/tpu_watch.sh fleet-decode [DIR]
+#                                      decode flavor of the fleet tail:
+#                                      newest *fleet_decode*.jsonl, with
+#                                      the session terminals (requests/
+#                                      replies/failed), migration/replay
+#                                      counters, per-replica KV-slot
+#                                      occupancy, and the aggregate
+#                                      record's TTFT/TPOT p99 columns.
+
 #   tools/tpu_watch.sh tune [DIR]      tail the NEWEST autotune search
 #                                      JSONL under DIR (default:
 #                                      ./metrics, where tools/autotune.py
@@ -95,6 +104,64 @@ for line in sys.stdin:
     if not r.get("feasible", True):
         bits.append("INFEASIBLE")
     bits.append(nd or "default")
+    print("  ".join(bits))
+'
+  exit $?
+fi
+
+if [ "$1" = "fleet-decode" ]; then
+  dir=${2:-metrics}
+  # the decode-tier router log (bench.py --stage fleet-decode /
+  # FleetRouter with decode sessions) is tagged *fleet_decode*;
+  # per-WORKER streams (*.worker.jsonl) are data-plane — skip them
+  f=$(ls -t "$dir"/*fleet_decode*.jsonl 2>/dev/null | grep -v '\.worker\.jsonl$' | head -1)
+  [ -z "$f" ] && f=$(ls -t "$dir"/*fleet_decode*.jsonl 2>/dev/null | head -1)
+  if [ -z "$f" ]; then
+    echo "tpu_watch: no fleet-decode metrics JSONL under $dir/ yet" >&2
+    exit 1
+  fi
+  echo "tpu_watch: tailing $f" >&2
+  tail -n +1 -F "$f" | python3 -u -c '
+import json, sys
+
+for line in sys.stdin:
+    line = line.strip()
+    if not line:
+        continue
+    try:
+        r = json.loads(line)
+    except ValueError:
+        continue  # partial trailing line from a killed writer
+    if not isinstance(r, dict):
+        continue
+    x = r.get("extra") or {}
+    if "event" not in x:
+        continue  # not a fleet control-plane record
+    bits = ["ev " + str(r.get("step", "?")).rjust(5),
+            str(x.get("event", "?")).ljust(10)]
+    if x.get("replica") is not None:
+        bits.append("rep " + str(x["replica"]))
+    # session terminals + hand-off counters: the decode router
+    # equation (requests == replies + failed + rejected) moving live
+    for k, tag in (("decode_requests", "sess"),
+                   ("decode_replies", "done"),
+                   ("decode_failed", "fail"),
+                   ("decode_migrations", "mig"),
+                   ("decode_replays", "rpl")):
+        if x.get(k):
+            bits.append(tag + " " + str(x[k]))
+    # per-replica KV-slot occupancy shipped on route/stop records
+    rd = x.get("replica_decode") or {}
+    for name in sorted(rd):
+        d = rd[name] or {}
+        bits.append(f"{name} {d.get('active_sessions', 0)}a/"
+                    f"{d.get('free_slots', 0)}f "
+                    f"{round(d.get('tokens_per_s', 0.0))}tok/s")
+    segs = x.get("segments") or {}
+    for name in ("ttft", "tpot"):
+        s = segs.get(name)
+        if s and s.get("p99_ms") is not None:
+            bits.append(name + " p99 " + str(s["p99_ms"]) + "ms")
     print("  ".join(bits))
 '
   exit $?
@@ -204,7 +271,12 @@ fi
 # *serve*.jsonl glob also matches bench_serve_decode.jsonl.
 if [ "$1" = "decode" ]; then
   dir=${2:-metrics}
-  f=$(ls -t "$dir"/*decode*.jsonl 2>/dev/null | head -1)
+  # *decode*.jsonl also matches the fleet-decode ROUTER streams
+  # (bench_fleet_decode*.jsonl, ISSUE 17) — those are control-plane
+  # records with their own flavor above; keep this tail on the
+  # engine's per-dispatch stream
+  f=$(ls -t "$dir"/*decode*.jsonl 2>/dev/null | grep -v fleet | head -1)
+  [ -z "$f" ] && f=$(ls -t "$dir"/*decode*.jsonl 2>/dev/null | head -1)
   if [ -z "$f" ]; then
     echo "tpu_watch: no decode metrics JSONL under $dir/ yet" >&2
     exit 1
