@@ -1788,7 +1788,7 @@ def stage_serve_decode(sessions, deadline_s, rate=0.0, chaos=False):
 
 def stage_fleet(requests, deadline_s, rate=0.0, replicas=3,
                 max_batch=32, max_wait_ms=1.0, chaos=False,
-                transport="engine"):
+                transport="engine", net_faults=False):
     """Fleet serving (ISSUE 11; proc transport ISSUE 13): drive
     `singa_tpu.fleet.FleetRouter` over N replicas with a seeded
     Poisson OPEN-LOOP generator (retry-after-aware client:
@@ -1803,12 +1803,25 @@ def stage_fleet(requests, deadline_s, rate=0.0, replicas=3,
     join the result; the chaos arm's pinned kills become REAL
     SIGKILLs of worker processes mid-load.
 
+    `--transport tcp` (ISSUE 18) runs the same workers behind
+    listen-mode `ProcReplica`s — a routable TCP socket with
+    generation fencing, per-frame sequence numbers, and a bounded
+    reconnect window instead of a pipe that dies with the child.
+
     `--chaos` adds a second fleet over the SAME arrival schedule with
     per-replica engine injectors (transient dispatch fails/hangs,
     poison, device loss) AND a router-level injector firing hard
     kills mid-load plus hangs/stale snapshots (proc adds pipe stalls
     + torn frames) — reporting availability %, failover/restart/
     ejection counters, and the reconciliation flag under fire.
+    `--net-faults` (tcp only) additionally routes every chaos
+    replica's connection through a seeded `netchaos.ChaosProxy` with
+    a standing asymmetric delay plus per-frame delay/reorder/dup/drip
+    draws, and pins >= 1 REAL partition mid-load through the
+    router-level injector — the acceptance pins are availability
+    >= 95% with an injected frame-fault rate >= 5%, bit-identical
+    replies, exact reconciliation, and sane clock-offset estimates
+    (|offset| <= uncertainty + slack) under the asymmetric delay.
     CPU-runnable by design, like the serve stage: dyadic params make
     replies bit-identical to the unbatched forward by arithmetic,
     across failovers, restarts, and process boundaries.
@@ -1869,7 +1882,7 @@ def stage_fleet(requests, deadline_s, rate=0.0, replicas=3,
             tensor.from_numpy(reqs[i], device=ref_dev)).data).copy()
     if not float(rate):
         rate = 4.0 * seq_est_rps * replicas
-        if transport == "proc":
+        if transport in ("proc", "tcp"):
             # The proc transport's request path is IPC-round-trip
             # bound, not forward bound, and the chaos arm's SIGKILL
             # recovery is a ~1 s respawn: an open-loop schedule that
@@ -1884,9 +1897,15 @@ def stage_fleet(requests, deadline_s, rate=0.0, replicas=3,
     rs_arr = np.random.RandomState(1)
     arrivals = np.cumsum(rs_arr.exponential(1.0 / rate, requests))
 
-    def run_fleet(router, seed):
+    def run_fleet(router, seed, max_attempts=3, max_sleep_s=0.05,
+                  outage_patience_s=0.0):
         """One pass over the arrival schedule; returns (futures,
-        refused, makespan_s)."""
+        refused, makespan_s). `outage_patience_s` > 0 keeps retrying
+        a request through an EMPTY rotation (FleetUnavailableError)
+        for that long before counting it refused — a transport
+        reconnect window or a supervisor restart empties a 2-replica
+        rotation for a few hundred ms, and a real client waits that
+        out rather than dropping traffic on first touch."""
         futures = [None] * requests
         refused = 0
         t0 = time.perf_counter()
@@ -1894,13 +1913,24 @@ def stage_fleet(requests, deadline_s, rate=0.0, replicas=3,
             now = time.perf_counter() - t0
             if now < arrivals[i]:
                 time.sleep(arrivals[i] - now)
-            try:
-                futures[i] = serve.submit_with_backoff(
-                    router.submit, x, seed=seed, max_attempts=3,
-                    max_sleep_s=0.05)
-            except (serve.ServeOverloadError, serve.ServeQueueFullError,
-                    fleet.FleetUnavailableError):
-                refused += 1
+            patience = time.perf_counter() + outage_patience_s
+            while True:
+                try:
+                    futures[i] = serve.submit_with_backoff(
+                        router.submit, x, seed=seed,
+                        max_attempts=max_attempts,
+                        max_sleep_s=max_sleep_s)
+                    break
+                except fleet.FleetUnavailableError:
+                    if time.perf_counter() < patience:
+                        time.sleep(0.05)
+                        continue
+                    refused += 1
+                    break
+                except (serve.ServeOverloadError,
+                        serve.ServeQueueFullError):
+                    refused += 1
+                    break
         return futures, refused, t0
 
     def resolve(futures, collect_latency=True):
@@ -1981,7 +2011,7 @@ def stage_fleet(requests, deadline_s, rate=0.0, replicas=3,
     s1 = stats.cache_stats()
     rec = fleet.reconcile(s0["serve"], s1["serve"],
                           s0["fleet"], s1["fleet"],
-                          replicas=reps if transport == "proc"
+                          replicas=reps if transport in ("proc", "tcp")
                           else None)
     # ONE merged cross-process timeline + the fleet aggregate record
     # (ISSUE 15): router spans + shipped worker spans under their
@@ -2024,6 +2054,13 @@ def stage_fleet(requests, deadline_s, rate=0.0, replicas=3,
     chaos_out = None
     if chaos:
         t_chaos0 = time.time()
+        if transport == "tcp":
+            # tracing ON for the tcp chaos arm: traced ACKs carry the
+            # worker's clock stamp, which is what feeds each
+            # generation's OffsetEstimator — the offset-sanity pin
+            # needs real samples taken THROUGH the chaotic network
+            device.set_tracing(True, ring_capacity=1 << 15)
+            trace_mod.clear()
         c0 = stats.cache_stats()
         engine_inj = {"dispatch_fail": 0.04,
                       "dispatch_hang": 0.02,
@@ -2035,7 +2072,7 @@ def stage_fleet(requests, deadline_s, rate=0.0, replicas=3,
                         "shed_watermark": 512, "max_restarts": 1000}
         creps = []
         for i in range(replicas):
-            if transport == "proc":
+            if transport in ("proc", "tcp"):
                 s = dict(base_spec)
                 s["factory_kwargs"] = dict(s["factory_kwargs"],
                                            device_index=i)
@@ -2045,7 +2082,24 @@ def stage_fleet(requests, deadline_s, rate=0.0, replicas=3,
                                  "hang_s": 0.002}
                 from singa_tpu.fleet_proc import ProcReplica
 
-                creps.append(ProcReplica(f"c{i}", s))
+                pk = {}
+                if transport == "tcp":
+                    pk["mode"] = "listen"
+                    if net_faults:
+                        # the proxy IS the network: deterministic
+                        # per-frame fault draws (>= 5% combined rate
+                        # by construction) + a standing asymmetric
+                        # delay the offset estimator must see through.
+                        # Mostly NON-tearing kinds (delay/drip) — a
+                        # reorder/dup verdict costs a whole reconnect
+                        # round-trip, so they stay rare enough that
+                        # two replicas are never both down for long
+                        pk["net_chaos"] = {
+                            "seed": 11 + i,
+                            "delay_prob": 0.05, "delay_ms": 2.0,
+                            "reorder_prob": 0.01, "dup_prob": 0.01,
+                            "drip_prob": 0.03, "delay_u2c_ms": 0.5}
+                creps.append(ProcReplica(f"c{i}", s, **pk))
             else:
                 inj = resilience.FaultInjector(
                     seed=3 + i, schedule=engine_inj, hang_s=0.002)
@@ -2059,7 +2113,7 @@ def stage_fleet(requests, deadline_s, rate=0.0, replicas=3,
         # probabilistic hangs/stale snapshots; the proc transport's
         # pinned kills are REAL SIGKILLs of worker processes, and it
         # adds pipe stalls + torn frames (the CRC/fail-closed path)
-        kill_kind = ("proc_sigkill" if transport == "proc"
+        kill_kind = ("proc_sigkill" if transport in ("proc", "tcp")
                      else "replica_kill")
         sched = {
             kill_kind: {max(2, requests // 3),
@@ -2067,9 +2121,23 @@ def stage_fleet(requests, deadline_s, rate=0.0, replicas=3,
             "replica_hang": 0.01,
             "stale_health": 0.01,
         }
-        if transport == "proc":
+        if transport in ("proc", "tcp"):
             sched["pipe_stall"] = 0.01
             sched["torn_frame"] = 0.005
+        if transport == "tcp" and net_faults:
+            # >= 1 REAL partition pinned mid-load (the acceptance
+            # scenario) at SEVERAL steps — a set-scheduled step only
+            # fires on a request that actually routes, so one step
+            # could be unlucky — plus probabilistic one-shot net
+            # faults the proxy's own per-frame draws ride on top of
+            sched["net_partition"] = {max(2, requests // 4),
+                                      max(3, requests // 2),
+                                      max(4, (3 * requests) // 4)}
+            sched["net_delay"] = 0.02
+            sched["net_reorder"] = 0.02
+            sched["net_dup"] = 0.02
+            sched["net_drip"] = 0.01
+            sched["net_half_open"] = 0.005
         finj = resilience.FaultInjector(seed=7, schedule=sched,
                                         hang_s=0.02)
         crouter = fleet.FleetRouter(
@@ -2078,10 +2146,20 @@ def stage_fleet(requests, deadline_s, rate=0.0, replicas=3,
             probe_backoff_ms=20.0,
             max_restarts=100, max_failover_hops=3, seed=7).start()
         crouter.warmup(reqs[0])
-        cfutures, crefused, _ = run_fleet(crouter, seed=7)
+        # under injected NET faults the client needs reconnect-window
+        # patience: a shed during a 2-replica dual outage resolves in
+        # a few hundred ms (redial + resume), so availability is
+        # measured over retried outcomes, not first-touch sheds
+        cfutures, crefused, _ = run_fleet(
+            crouter, seed=7,
+            max_attempts=10 if net_faults else 3,
+            max_sleep_s=0.2 if net_faults else 0.05,
+            outage_patience_s=3.0 if net_faults else 0.0)
         cres = resolve(cfutures)
         if cres is None:
             crouter.stop()
+            if transport == "tcp":
+                device.set_tracing(False)
             mlog.close()
             print(json.dumps({"ok": False,
                               "error": "deadline inside fleet chaos "
@@ -2089,10 +2167,13 @@ def stage_fleet(requests, deadline_s, rate=0.0, replicas=3,
             return
         cdelivered, cfailed, cmatch, clats, _ = cres
         crouter.stop()
+        if transport == "tcp":
+            device.set_tracing(False)
         c1 = stats.cache_stats()
         crec = fleet.reconcile(c0["serve"], c1["serve"],
                                c0["fleet"], c1["fleet"],
-                               replicas=creps if transport == "proc"
+                               replicas=creps
+                               if transport in ("proc", "tcp")
                                else None)
         cd = {k: c1["fleet"][k] - c0["fleet"][k] for k in
               ("failovers", "restarts", "ejections", "rejoins",
@@ -2117,7 +2198,7 @@ def stage_fleet(requests, deadline_s, rate=0.0, replicas=3,
             "counters_reconcile": bool(crec["ok"]),
             "seconds": round(time.time() - t_chaos0, 2),
         }
-        if transport == "proc":
+        if transport in ("proc", "tcp"):
             chaos_out["transport_reconcile"] = bool(
                 crec.get("transport", True))
             chaos_out["pipe_stalls"] = (
@@ -2126,6 +2207,58 @@ def stage_fleet(requests, deadline_s, rate=0.0, replicas=3,
             chaos_out["torn_frames"] = (
                 c1["fleet"]["torn_frames_injected"]
                 - c0["fleet"]["torn_frames_injected"])
+        if transport == "tcp":
+            # net-fault evidence is DISCOVERED, never trusted from
+            # the injector: the proxies count what they actually did
+            # to frames, the parents count what they detected and
+            # how they recovered, and the offset-sanity pin checks
+            # each generation's estimate against its own uncertainty
+            psnaps = [s for s in (r.net_chaos_snapshot()
+                                  for r in creps) if s]
+            frames = sum(s["frames"] for s in psnaps)
+            faulted = sum(s["delays"] + s["reorders"] + s["dups"]
+                          + s["drips"] for s in psnaps)
+            tsnaps = [r.transport_snapshot() for r in creps]
+            offs = [(g.get("clock_offset_us"),
+                     g.get("clock_uncertainty_us"))
+                    for t in tsnaps
+                    for g in t["generations"].values()
+                    if g.get("clock_offset_us") is not None]
+            chaos_out["net"] = {
+                "proxy_frames": frames,
+                "frame_fault_rate_pct": round(
+                    100.0 * faulted / max(frames, 1), 2),
+                "partitions": sum(s["partitions"] for s in psnaps),
+                "half_opens": sum(s["half_opens"] for s in psnaps),
+                "delays": sum(s["delays"] for s in psnaps),
+                "reorders": sum(s["reorders"] for s in psnaps),
+                "dups": sum(s["dups"] for s in psnaps),
+                "drips": sum(s["drips"] for s in psnaps),
+                "net_faults_injected": (
+                    c1["fleet"]["net_faults_injected"]
+                    - c0["fleet"]["net_faults_injected"]),
+                "net_partitions_injected": (
+                    c1["fleet"]["net_partitions_injected"]
+                    - c0["fleet"]["net_partitions_injected"]),
+                "replay_frames_detected": sum(
+                    t["replay_frames_detected"] for t in tsnaps),
+                "gap_frames_detected": sum(
+                    t["gap_frames_detected"] for t in tsnaps),
+                "reconnects": sum(t["reconnects"] for t in tsnaps),
+                "reconnect_windows": sum(
+                    t["reconnect_windows"] for t in tsnaps),
+                "stale_reconnects_refused": sum(
+                    t["stale_reconnects_refused"] for t in tsnaps),
+                "offset_samples": len(offs),
+                "offset_max_abs_us": (round(max(
+                    abs(o) for o, _ in offs), 1) if offs else None),
+                # loopback ground truth is 0 (one machine, one
+                # monotonic clock): every estimate must sit inside
+                # its own uncertainty bound (+2ms scheduling slack)
+                "offset_sane": bool(all(
+                    abs(o) <= (u or 0.0) + 2000.0
+                    for o, u in offs)) if offs else None,
+            }
         log(f"fleet chaos arm: availability "
             f"{chaos_out['availability_pct']}% p99 "
             f"{chaos_out['p99_ms']} ms ({cd['kills_injected']} kills, "
@@ -2157,7 +2290,7 @@ def stage_fleet(requests, deadline_s, rate=0.0, replicas=3,
         "restarts": fsnap["restarts"] - s0["fleet"]["restarts"],
         "counters_reconcile": bool(rec["ok"]),
         **({"transport_reconcile": bool(rec.get("transport", True))}
-           if transport == "proc" else {}),
+           if transport in ("proc", "tcp") else {}),
         "latency_breakdown": latency_breakdown,
         "trace": trace_block,
         "max_batch": max_batch,
@@ -2172,7 +2305,8 @@ def stage_fleet(requests, deadline_s, rate=0.0, replicas=3,
     print(json.dumps(out), flush=True)
 
 
-def stage_fleet_decode(sessions, deadline_s, replicas=2, chaos=False):
+def stage_fleet_decode(sessions, deadline_s, replicas=2, chaos=False,
+                       transport="proc"):
     """Fleet-wide KV-cached decode serving (ISSUE 17): drive
     `fleet.FleetRouter.submit_decode` over N REAL worker subprocesses
     (`fleet_proc.ProcReplica`) with a seeded compound-Poisson session
@@ -2421,14 +2555,16 @@ def stage_fleet_decode(sessions, deadline_s, replicas=2, chaos=False):
     s0 = stats.cache_stats()
     f0 = stats.decode_stats().snapshot()
     wspec = dict(base_spec, metrics_dir=os.path.join(HERE, "metrics"))
-    reps = fleet.make_replicas(replicas, wspec, transport="proc",
+    if transport == "engine":
+        transport = "proc"  # decode tier is proc/tcp only
+    reps = fleet.make_replicas(replicas, wspec, transport=transport,
                                name_prefix="bench_fleet_decode_w")
     router = fleet.FleetRouter(reps, metrics=mlog,
                                supervise_interval_s=0.01).start()
     warmed = router.warm_decode(sorted(set(PLENS)), NEW,
                                 samplers=[(0.7, 8)])
     log(f"fleet decode warmup: {warmed} executables over {replicas} "
-        f"proc replicas")
+        f"{transport} replicas")
     fleet_best = None
     for _ in range(FLEET_PASSES):
         replies, refused, t0p = run_schedule(router.submit_decode, "f")
@@ -2484,7 +2620,8 @@ def stage_fleet_decode(sessions, deadline_s, replicas=2, chaos=False):
             s = dict(base_spec)
             s["factory_kwargs"] = dict(base_spec["factory_kwargs"],
                                        device_index=i)
-            creps.append(ProcReplica(f"bench_fdc{i}", s))
+            pk = {"mode": "listen"} if transport == "tcp" else {}
+            creps.append(ProcReplica(f"bench_fdc{i}", s, **pk))
         # >= 2 REAL SIGKILLs pinned by ADMITTED-session count (submit
         # count won't do: refusals consume indices, and once capacity
         # halves after kill #1 the second scheduled step lands on a
@@ -2584,7 +2721,7 @@ def stage_fleet_decode(sessions, deadline_s, replicas=2, chaos=False):
                    f"burst{burst} bursts{B}"),
         "sessions": n_sessions,
         "replicas": replicas,
-        "transport": "proc",
+        "transport": transport,
         "new_tokens": NEW,
         "slots_per_replica": M,
         "burst_size": burst,
@@ -2730,13 +2867,23 @@ def main():
     p.add_argument("--replicas", type=int, default=None,
                    help="fleet stages: serving replicas behind the "
                    "router (default: fleet 3, fleet-decode 2)")
-    p.add_argument("--transport", choices=["engine", "proc"],
+    p.add_argument("--transport", choices=["engine", "proc", "tcp"],
                    default="engine",
                    help="fleet stage replica transport: 'engine' = "
                    "in-process replicas (PR 11), 'proc' = one REAL "
                    "worker subprocess per replica over the framed "
                    "IPC protocol (heartbeats, IPC deadlines; chaos "
-                   "kills become real SIGKILLs)")
+                   "kills become real SIGKILLs), 'tcp' = listen-mode "
+                   "workers over a routable TCP socket (ISSUE 18: "
+                   "generation fencing, per-frame sequence numbers, "
+                   "bounded reconnect window)")
+    p.add_argument("--net-faults", action="store_true",
+                   help="fleet stage, tcp + --chaos only: route every "
+                   "chaos replica through a seeded netchaos.ChaosProxy "
+                   "(per-frame delay/reorder/dup/drip draws + standing "
+                   "asymmetric delay) and pin >= 1 real partition "
+                   "mid-load; reports detected replay/gap counts, the "
+                   "injected frame-fault rate, and offset sanity")
     p.add_argument("--pipe", type=int, default=4,
                    help="parallel stage: pipeline depth (stages = "
                    "pipe; mesh is data=8/pipe x pipe)")
@@ -2779,7 +2926,8 @@ def main():
                            replicas=a.replicas or 3,
                            max_batch=min(a.serve_max_batch, 32),
                            max_wait_ms=a.max_wait_ms, chaos=a.chaos,
-                           transport=a.transport)
+                           transport=a.transport,
+                           net_faults=a.net_faults)
     if a.stage == "parallel":
         return stage_parallel(a.steps, a.deadline, pipe=a.pipe,
                               microbatches=a.microbatches,
@@ -2795,7 +2943,9 @@ def main():
     if a.stage == "fleet-decode":
         return stage_fleet_decode(a.requests, a.deadline,
                                   replicas=a.replicas or 2,
-                                  chaos=a.chaos)
+                                  chaos=a.chaos,
+                                  transport=("tcp" if a.transport ==
+                                             "tcp" else "proc"))
     if a.stage == "parity":
         return stage_parity(a.steps, a.deadline)
     if a.stage:

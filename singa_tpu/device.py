@@ -810,9 +810,12 @@ def set_fleet(**kw) -> None:
 
     Multi-process transport keys (ISSUE 13; `singa_tpu.fleet_proc`):
 
-      transport             "engine" (in-process replicas) or "proc"
+      transport             "engine" (in-process replicas), "proc"
                             (worker subprocesses behind the same
-                            Replica protocol) — what
+                            Replica protocol), or "tcp" (ISSUE 18:
+                            listen-mode workers over a routable TCP
+                            socket with generation fencing +
+                            per-frame sequence numbers) — what
                             `fleet.make_replicas` builds.
       ipc_deadline_ms       per-message IPC bound: a missing admission
                             ACK (or a reply this far past the
@@ -830,6 +833,21 @@ def set_fleet(**kw) -> None:
       max_inflight          in-flight requests per worker before the
                             parent sheds with `retry_after_ms`
                             instead of ballooning the pipe.
+
+    TCP transport keys (ISSUE 18; modes listen/connect):
+
+      reconnect_window_s    after a socket EOF/corruption in a TCP
+                            mode, how long the parent holds the
+                            worker's generation open for a
+                            fence-checked reconnect before declaring
+                            it dead (in-flight requests fail over
+                            immediately; new submits shed with
+                            `retry_after_ms` during the window).
+      max_frame_bytes       reader-side bound on one frame's payload
+                            (>= 1024): a hostile/corrupt length
+                            prefix fails the connection with
+                            `FrameCorruptError` instead of ballooning
+                            RSS.
 
     Counters: `cache_stats()["fleet"]` (routed/failovers/refused/
     rejected, ejections/rejoins/restarts, per-replica state incl.

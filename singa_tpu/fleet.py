@@ -179,6 +179,17 @@ _CONFIG: Dict = {
     # Max in-flight requests per worker before the parent sheds with
     # retry_after_ms instead of ballooning the pipe.
     "max_inflight": 256,
+    # --- TCP transport (ISSUE 18; transport="tcp") ---
+    # How long a lost connection keeps its worker generation ALIVE
+    # awaiting an authenticated same-fence reconnect before the
+    # supervisor declares it dead and restarts. In-flight requests
+    # fail over immediately either way — the window trades restart
+    # churn against blips, never availability.
+    "reconnect_window_s": 10.0,
+    # Reader-side bound on a single frame's claimed payload size: a
+    # corrupt/hostile length prefix fails the connection loudly
+    # (FrameCorruptError) instead of ballooning RSS.
+    "max_frame_bytes": 256 * 1024 * 1024,
 }
 
 
@@ -191,9 +202,14 @@ def configure(**kw) -> Dict:
                            f"{sorted(_CONFIG)}")
         if k == "transport":
             v = str(v)
-            if v not in ("engine", "proc"):
+            if v not in ("engine", "proc", "tcp"):
                 raise ValueError(
-                    f"transport must be 'engine' or 'proc', got {v!r}")
+                    "transport must be 'engine', 'proc', or 'tcp', "
+                    f"got {v!r}")
+        elif k == "max_frame_bytes":
+            v = int(v)
+            if v < 1024:
+                raise ValueError("max_frame_bytes must be >= 1024")
         elif k in ("max_failover_hops", "max_shed_retries",
                    "max_restarts", "metrics_every"):
             v = int(v)
@@ -274,6 +290,10 @@ class _FleetStats:
         self.stale_injected = 0
         self.pipe_stalls_injected = 0
         self.torn_frames_injected = 0
+        # network-fault injections (ISSUE 18): tcp transport only —
+        # faults that fired through a replica's ChaosProxy
+        self.net_faults_injected = 0
+        self.net_partitions_injected = 0
 
     def snapshot(self) -> Dict:
         per: Dict[str, Dict] = {}
@@ -307,6 +327,8 @@ class _FleetStats:
             "stale_injected": self.stale_injected,
             "pipe_stalls_injected": self.pipe_stalls_injected,
             "torn_frames_injected": self.torn_frames_injected,
+            "net_faults_injected": self.net_faults_injected,
+            "net_partitions_injected": self.net_partitions_injected,
             "per_replica": per,
         }
 
@@ -515,18 +537,33 @@ def make_replicas(n: int, spec: Dict, transport: Optional[str] = None,
             ekw["health_file"] = _os.path.join(
                 s.pop("health_dir"), f"{name}.health.json")
             s["engine"] = ekw
-        if transport == "proc":
+        if transport in ("proc", "tcp"):
             from .fleet_proc import ProcReplica
 
             if engine_kwargs:
                 ekw = dict(s.get("engine") or {})
                 ekw.update(engine_kwargs)
                 s["engine"] = ekw
-            out.append(ProcReplica(name, s, **proc_kwargs))
+            pk = dict(proc_kwargs)
+            if transport == "tcp":
+                # listen mode: the parent binds a routable host:port
+                # (ephemeral loopback by default — hermetic) and the
+                # worker is launched with ONLY the remote-recipe CLI
+                # (--connect host:port --token). A "net_chaos" spec
+                # entry arms the deterministic ChaosProxy between
+                # them (singa_tpu.netchaos).
+                pk.setdefault("mode", "listen")
+                if s.get("net_chaos") is not None:
+                    pk.setdefault("net_chaos",
+                                  dict(s.pop("net_chaos")))
+                else:
+                    s.pop("net_chaos", None)
+            out.append(ProcReplica(name, s, **pk))
             continue
         if transport != "engine":
             raise ValueError(
-                f"unknown fleet transport {transport!r} (engine|proc)")
+                f"unknown fleet transport {transport!r} "
+                "(engine|proc|tcp)")
         from .fleet_proc import resolve_factory
 
         fn = resolve_factory(s)
@@ -1793,6 +1830,19 @@ class FleetRouter:
                 # exit code), not be told about it
                 fn()
                 _STATS.kills_injected += 1
+        # Network-fault kinds (ISSUE 18): real bytes mangled by the
+        # replica's ChaosProxy. Only a tcp-transport handle with an
+        # armed proxy exposes the hook-with-effect; everything else
+        # no-ops rather than mis-simulating a network it doesn't have.
+        nf = getattr(slot.handle, "net_fault", None)
+        if nf is not None:
+            for kind in ("net_partition", "net_delay", "net_reorder",
+                         "net_dup", "net_drip", "net_half_open"):
+                if inj.should(kind, idx):
+                    nf(kind)
+                    _STATS.net_faults_injected += 1
+                    if kind == "net_partition":
+                        _STATS.net_partitions_injected += 1
 
     # -- fleet operations -------------------------------------------------
     def kill(self, name: str) -> None:
@@ -1997,6 +2047,8 @@ class FleetRouter:
                 kills_injected=_STATS.kills_injected,
                 pipe_stalls_injected=_STATS.pipe_stalls_injected,
                 torn_frames_injected=_STATS.torn_frames_injected,
+                net_faults_injected=_STATS.net_faults_injected,
+                net_partitions_injected=_STATS.net_partitions_injected,
                 **extra)
         except Exception:
             pass  # a closed metrics stream must not break routing

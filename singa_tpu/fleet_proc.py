@@ -108,7 +108,10 @@ __all__ = [
     "ProcReplica",
     "ProcTransportError",
     "FrameCorruptError",
+    "FrameReplayError",
+    "FrameGapError",
     "encode_frame",
+    "send_frame",
     "FrameReader",
     "encode_tree",
     "decode_tree",
@@ -142,17 +145,43 @@ class FrameCorruptError(RuntimeError):
     past this point. The reader fails in-flight futures loudly and
     the worker is killed/respawned — a truncated reply must never be
     delivered as data, and resyncing a corrupt byte stream would be a
-    guess."""
+    guess. On a TCP transport (ISSUE 18) the connection is torn down
+    instead and the worker gets its bounded reconnect window — the
+    STREAM is untrusted, not necessarily the process."""
+
+
+class FrameReplayError(FrameCorruptError):
+    """A frame arrived carrying a per-direction sequence number the
+    receiver has ALREADY consumed: a middlebox duplicated it, or a
+    stale connection replayed old bytes. Counted
+    (`replay_frames_detected`) and treated as stream corruption —
+    delivering it would double-deliver data, which the transport
+    contract forbids."""
+
+
+class FrameGapError(FrameCorruptError):
+    """A frame arrived with a sequence number PAST the next expected
+    ordinal: frames were reordered or silently dropped in transit
+    (TCP itself never does this — a proxy, middlebox, or reconnect
+    race did). Counted (`gap_frames_detected`) and treated as stream
+    corruption: delivering out-of-order frames would reorder replies
+    against their ACKs."""
 
 
 # ---------------------------------------------------------------------------
-# Wire format: 20-byte header + payload.
+# Wire format v2: 24-byte header + payload.
 #   magic "SF" | version u8 | type u8 | payload_len u32 | req_id u64
-#   | crc32(payload) u32
+#   | seq u32 | crc32(payload) u32
+# `seq` is a per-connection, per-direction monotonic counter starting
+# at 0 (ISSUE 18): a duplicated frame replays a seq the receiver has
+# already consumed (`FrameReplayError`), a reordered or dropped frame
+# leaves a gap (`FrameGapError`) — either way the stream is declared
+# corrupt LOUDLY instead of delivering data twice or out of order. A
+# reconnect is a fresh connection, so both directions restart at 0.
 # ---------------------------------------------------------------------------
 _MAGIC = b"SF"
-_VERSION = 1
-_HDR = struct.Struct(">2sBBIQI")
+_VERSION = 2
+_HDR = struct.Struct(">2sBBIQII")
 _MAX_PAYLOAD = 256 * 1024 * 1024  # structural sanity bound, not a knob
 # Parent-side shipped-span buffer bound (per replica) + the per-frame
 # piggyback bounds the worker drains into REP/HB/BYE frames. REPLY
@@ -189,61 +218,151 @@ MIGRATE = 13 # worker -> parent: the session's live-migration
              # session has no local terminal, it re-admits elsewhere
 RESUME = 14  # parent -> worker: checkpoint admission (encoded ckpt
              # tree + optional trace suffix) — ACKed like DECODE
+# TCP transport handshake frames (ISSUE 18). Spawn mode never puts
+# these on the wire.
+WELCOME = 15 # parent -> worker: JSON {fence, gen, spec?} — the
+             # parent accepted this connection's HELLO; `fence` is the
+             # generation-fence epoch the worker must echo on every
+             # reconnect, `spec` ships only when the HELLO asked
+             # (need_spec: a remotely launched worker has no env spec)
+FENCED = 16  # parent -> worker: JSON {reason} — the connection's
+             # HELLO carried a stale (or missing) fence: this worker
+             # generation is superseded and must NOT serve; the parent
+             # closes after sending. Counted stale_reconnects_refused.
+
+
+def send_frame(sock, frame: bytes, deadline_s: float = 10.0) -> None:
+    """Write one frame to `sock` COMPLETELY or fail — never leave a
+    partial frame on the wire and return control (satellite: partial-
+    write hardening). `sock.sendall` under a socket timeout can write
+    a PREFIX of the frame and then raise `socket.timeout`; a retry of
+    the next frame would interleave bytes mid-frame and corrupt the
+    stream unrecoverably. This loop retries short writes on the SAME
+    frame until `deadline_s` expires; on expiry (or any socket error
+    mid-frame) it raises OSError — callers must treat the connection
+    as broken, because bytes of a half-frame may already be out."""
+    view = memoryview(frame)
+    deadline = time.perf_counter() + deadline_s
+    while view:
+        try:
+            sent = sock.send(view)
+        except socket.timeout:
+            if time.perf_counter() >= deadline:
+                raise OSError(
+                    f"send deadline ({deadline_s}s) expired with "
+                    f"{len(view)}/{len(frame)} frame bytes unwritten: "
+                    "connection is congested past tolerance") from None
+            continue
+        except InterruptedError:
+            continue
+        if sent == 0:
+            raise OSError("socket connection broken mid-frame")
+        view = view[sent:]
 
 
 def encode_frame(ftype: int, req_id: int, payload: bytes,
-                 corrupt: bool = False) -> bytes:
+                 corrupt: bool = False, seq: int = 0) -> bytes:
     """One wire frame. `corrupt=True` (the `torn_frame` chaos hook)
     flips payload bytes AFTER the CRC is computed — the receiver's
-    checksum must catch it, which is the point."""
+    checksum must catch it, which is the point. `seq` is the sender's
+    per-connection monotonic ordinal for this direction."""
     crc = zlib.crc32(payload) & 0xFFFFFFFF
     if corrupt and payload:
         payload = bytes([payload[0] ^ 0xFF]) + payload[1:]
     elif corrupt:
         crc ^= 0xDEADBEEF
     return _HDR.pack(_MAGIC, _VERSION, ftype, len(payload),
-                     req_id, crc) + payload
+                     req_id, seq & 0xFFFFFFFF, crc) + payload
+
+
+# Amortized-compaction tuning for FrameReader: the consumed prefix is
+# only sliced off once it dominates the buffer (and is big enough to
+# matter), so a slow-drip byte stream costs O(total_bytes) instead of
+# the old per-frame `del buf[:k]` O(n^2) re-copy.
+_COMPACT_MIN = 1 << 16
 
 
 class FrameReader:
     """Incremental frame parser over a byte stream. `feed(chunk)`
     returns every COMPLETE frame the buffer now holds; a partial
     frame waits for more bytes (a short read is normal, not an
-    error), but structural damage — bad magic/version, an insane
-    length, a CRC mismatch — raises `FrameCorruptError`
-    immediately."""
+    error), but structural damage — bad magic/version, a length past
+    `max_frame_bytes`, a CRC mismatch — raises `FrameCorruptError`
+    immediately. With `check_seq=True` (the live transport) every
+    frame's header seq must be EXACTLY the next expected ordinal:
+    a replayed/duplicated frame raises `FrameReplayError`, a gap
+    (reorder or loss) raises `FrameGapError` — both subclass
+    `FrameCorruptError`, so every existing fail-closed path applies.
 
-    def __init__(self):
+    Parsing keeps a read cursor (`_off`) into one growing buffer and
+    compacts the consumed prefix AMORTIZED (only once it exceeds both
+    `_COMPACT_MIN` and half the buffer): under 1-byte slow-drip
+    arrival the old per-frame front-slice was quadratic in stream
+    length."""
+
+    def __init__(self, max_frame_bytes: Optional[int] = None,
+                 check_seq: bool = False):
         self._buf = bytearray()
+        self._off = 0
+        cap = _MAX_PAYLOAD if max_frame_bytes is None \
+            else int(max_frame_bytes)
+        self.max_frame_bytes = min(max(cap, 1), _MAX_PAYLOAD)
+        self._check_seq = bool(check_seq)
+        self._expect_seq = 0
 
     def feed(self, chunk: bytes) -> List[Tuple[int, int, bytes]]:
         self._buf.extend(chunk)
         out: List[Tuple[int, int, bytes]] = []
-        while len(self._buf) >= _HDR.size:
-            magic, ver, ftype, n, rid, crc = _HDR.unpack_from(
-                self._buf, 0)
-            if magic != _MAGIC or ver != _VERSION:
-                raise FrameCorruptError(
-                    f"bad frame header (magic {magic!r}, version "
-                    f"{ver}): stream corrupt")
-            if n > _MAX_PAYLOAD:
-                raise FrameCorruptError(
-                    f"frame claims {n} payload bytes (cap "
-                    f"{_MAX_PAYLOAD}): stream corrupt")
-            if len(self._buf) < _HDR.size + n:
-                break  # torn so far — wait for the rest
-            payload = bytes(self._buf[_HDR.size:_HDR.size + n])
-            del self._buf[:_HDR.size + n]
-            if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
-                raise FrameCorruptError(
-                    f"frame {rid} type {ftype} failed its CRC32: a "
-                    "torn/corrupt reply must never be delivered as "
-                    "data")
-            out.append((ftype, rid, payload))
+        buf = self._buf
+        off = self._off
+        try:
+            while len(buf) - off >= _HDR.size:
+                magic, ver, ftype, n, rid, seq, crc = _HDR.unpack_from(
+                    buf, off)
+                if magic != _MAGIC or ver != _VERSION:
+                    raise FrameCorruptError(
+                        f"bad frame header (magic {magic!r}, version "
+                        f"{ver}): stream corrupt")
+                if n > self.max_frame_bytes:
+                    raise FrameCorruptError(
+                        f"frame claims {n} payload bytes (cap "
+                        f"{self.max_frame_bytes}): refusing to buffer "
+                        "it — stream corrupt")
+                if len(buf) - off < _HDR.size + n:
+                    break  # torn so far — wait for the rest
+                payload = bytes(buf[off + _HDR.size:
+                                    off + _HDR.size + n])
+                off += _HDR.size + n
+                if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+                    raise FrameCorruptError(
+                        f"frame {rid} type {ftype} failed its CRC32: "
+                        "a torn/corrupt reply must never be delivered "
+                        "as data")
+                if self._check_seq:
+                    want = self._expect_seq & 0xFFFFFFFF
+                    if seq != want:
+                        if ((want - seq) & 0xFFFFFFFF) <= 0x7FFFFFFF:
+                            raise FrameReplayError(
+                                f"frame {rid} type {ftype} replays "
+                                f"seq {seq} (expected {want}): a "
+                                "duplicated frame must never be "
+                                "delivered twice")
+                        raise FrameGapError(
+                            f"frame {rid} type {ftype} arrives at seq "
+                            f"{seq} (expected {want}): frames were "
+                            "reordered or lost in transit")
+                    self._expect_seq += 1
+                out.append((ftype, rid, payload))
+        finally:
+            self._off = off
+            if off and (off == len(buf)
+                        or (off > _COMPACT_MIN and off > len(buf) // 2)):
+                del buf[:off]
+                self._off = 0
         return out
 
     def pending_bytes(self) -> int:
-        return len(self._buf)
+        return len(self._buf) - self._off
 
 
 # ---------------------------------------------------------------------------
@@ -586,7 +705,7 @@ class _Gen:
     is exactly why the parent-side ledger is the authoritative one."""
 
     __slots__ = ("admitted", "frames", "swept", "migrated", "ack_errs",
-                 "handshake", "clean", "exit_code", "pid",
+                 "handshake", "clean", "exit_code", "pid", "clock",
                  "clock_offset_us", "clock_rtt_s", "clock_wall_us")
 
     def __init__(self, pid: int):
@@ -599,12 +718,15 @@ class _Gen:
         self.clean = False
         self.exit_code: Optional[int] = None
         self.pid = pid
-        # monotonic-clock alignment (ISSUE 15): worker perf_counter +
-        # offset = parent perf_counter. Primary estimate from the
-        # REQ->ACK handshake (midpoint minus the worker's ACK stamp;
-        # the smallest-RTT sample wins — classic NTP discipline);
-        # fallback from the heartbeat's (wall, mono) pair when no
-        # traced request has round-tripped this generation yet.
+        # monotonic-clock alignment (ISSUE 15/18): worker
+        # perf_counter + offset = parent perf_counter. Primary
+        # estimate from the REQ->ACK handshake via
+        # `trace.OffsetEstimator` (median over the smallest-RTT
+        # samples, so network jitter and injected asymmetric delay
+        # are filtered, not averaged in); fallback from the
+        # heartbeat's (wall, mono) pair when no traced request has
+        # round-tripped this generation yet.
+        self.clock = trace_mod.OffsetEstimator()
         self.clock_offset_us: Optional[float] = None
         self.clock_rtt_s: Optional[float] = None
         self.clock_wall_us: Optional[float] = None
@@ -682,21 +804,80 @@ class ProcReplica:
                       with `trace.read_metrics`; flush-per-record, so
                       a SIGKILLed worker leaves a parseable log)
 
+    Transport modes (ISSUE 18) — the same `Replica` protocol over
+    three launch/dial topologies:
+
+      spawn    (default) today's behavior, unchanged: the parent binds
+               an ephemeral loopback listener, spawns the worker with
+               the spec in its env, and the connection IS the process
+               — EOF means child death.
+      listen   the parent binds a routable `host:port` and keeps
+               accepting; the worker is launched ANYWHERE via
+               `python -m singa_tpu.fleet_worker --connect host:port
+               --token ...` (`launch="local"` makes the parent launch
+               it locally — the hermetic test/bench arrangement;
+               `launch="none"` waits for an external one). The spec
+               ships over the wire in the WELCOME frame when the
+               worker's HELLO asks (`need_spec`).
+      connect  the parent DIALS an already-running worker started
+               with `--listen host:port`.
+
+    In the TCP modes socket EOF no longer implies child death: the
+    generation gets a bounded `reconnect_window_s` during which
+    in-flight requests fail over (PR 11 machinery — never hang, never
+    double-deliver) and a reconnect carrying the current generation
+    FENCE resumes the same generation with fresh per-direction frame
+    sequence numbers; a stale fence is refused loudly (FENCED frame,
+    `stale_reconnects_refused`). Window expiry flips `killed` and the
+    supervisor's restart story takes over.
+
     Transport knobs (constructor kwargs, defaulting to the
     `device.set_fleet` process config): `ipc_deadline_ms`,
-    `heartbeat_interval_s`, `spawn_timeout_s`, `max_inflight`."""
+    `heartbeat_interval_s`, `spawn_timeout_s`, `max_inflight`,
+    `reconnect_window_s`, `max_frame_bytes`."""
 
     def __init__(self, name: str, spec: Dict, *,
                  ipc_deadline_ms: Optional[float] = None,
                  heartbeat_interval_s: Optional[float] = None,
                  spawn_timeout_s: Optional[float] = None,
                  max_inflight: Optional[int] = None,
-                 python: Optional[str] = None):
+                 python: Optional[str] = None,
+                 mode: str = "spawn",
+                 host: str = "127.0.0.1",
+                 port: int = 0,
+                 launch: str = "local",
+                 reconnect_window_s: Optional[float] = None,
+                 max_frame_bytes: Optional[int] = None,
+                 net_chaos: Optional[Dict] = None):
         from . import fleet
 
         cfg = fleet.get_config()
         self.name = str(name)
         self.spec = dict(spec)
+        if mode not in ("spawn", "listen", "connect"):
+            raise ValueError(
+                f"unknown ProcReplica mode {mode!r} "
+                "(spawn|listen|connect)")
+        if launch not in ("local", "none"):
+            raise ValueError(
+                f"unknown ProcReplica launch {launch!r} (local|none)")
+        self._mode = mode
+        self._host = str(host)
+        self._port = int(port)
+        self._launch = launch if mode == "listen" else "none"
+        if mode == "spawn":
+            self._launch = "local"
+        self.reconnect_window_s = float(
+            reconnect_window_s if reconnect_window_s is not None
+            else cfg.get("reconnect_window_s", 10.0))
+        self.max_frame_bytes = int(
+            max_frame_bytes if max_frame_bytes is not None
+            else cfg.get("max_frame_bytes", _MAX_PAYLOAD))
+        self._net_chaos = dict(net_chaos) if net_chaos else None
+        if self._net_chaos is not None and mode != "listen":
+            raise ValueError(
+                "net_chaos needs mode='listen' (the proxy fronts the "
+                "parent's listener)")
         if "factory" not in self.spec:
             raise ValueError(
                 "ProcReplica spec needs a 'factory' (module:callable) "
@@ -734,14 +915,42 @@ class ProcReplica:
         self._frozen_until = 0.0
         self._stall_s = 0.0
         self._draining = False
+        # TCP transport state (ISSUE 18). The fence is the parent's
+        # generation-epoch counter: bumped on every FRESH adoption, it
+        # is handed to the worker in WELCOME and must be echoed by
+        # every reconnect HELLO — a stale/replayed connection carries
+        # yesterday's fence and is refused, so a superseded worker can
+        # never resurrect its generation. The token is stable for the
+        # replica's lifetime in TCP modes (a remotely launched worker
+        # cannot learn a fresh one per spawn).
+        import secrets
+
+        self._fence = 0
+        self._tx_seq = 0
+        self._token = str(self.spec.get("token")
+                          or secrets.token_hex(16))
+        self._lsock: Optional[socket.socket] = None
+        self._listen_addr: Optional[Tuple[str, int]] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._proxy = None  # netchaos.ChaosProxy when net_chaos armed
+        self._proxy_final = None  # last snapshot, kept across stop()
+        self._superseded: set = set()  # old socks a reconnect replaced
+        self._reconnecting = False
+        self._reconnect_deadline = 0.0
+        self._established = threading.Event()
         # lifetime transport counters (reconcile_transport reads them)
         self.sent = 0
         self.delivered = 0
         self.err_replies = 0
         self.transport_failed = 0
         self.torn_frames_detected = 0
+        self.replay_frames_detected = 0
+        self.gap_frames_detected = 0
         self.ipc_timeouts = 0
         self.hb_received = 0
+        self.reconnects = 0
+        self.reconnect_windows = 0
+        self.stale_reconnects_refused = 0
         # decode-tier lane (ISSUE 17): its own sent/terminal counters
         # so the forward parent-terminals equation is untouched; at
         # quiescence decode_sent == decode_delivered +
@@ -766,7 +975,18 @@ class ProcReplica:
         self.spans_dropped = 0
 
     # -- lifecycle --------------------------------------------------------
+    @property
+    def _tcp(self) -> bool:
+        return self._mode != "spawn"
+
     def start(self) -> "ProcReplica":
+        if self._mode == "listen":
+            return self._start_listen()
+        if self._mode == "connect":
+            return self._start_connect()
+        return self._start_spawn()
+
+    def _start_spawn(self) -> "ProcReplica":
         if self._proc is not None and self._proc.poll() is None:
             self.killed = False
             return self
@@ -832,7 +1052,8 @@ class ProcReplica:
             lsock.close()
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         conn.settimeout(self.spawn_timeout_s)
-        reader = FrameReader()
+        reader = FrameReader(max_frame_bytes=self.max_frame_bytes,
+                             check_seq=True)
         hello = None
         stashed: List[Tuple[int, int, bytes]] = []
         deadline = time.perf_counter() + self.spawn_timeout_s
@@ -862,6 +1083,8 @@ class ProcReplica:
         self._gen += 1
         gen = self._gen
         self._gens[gen] = _Gen(pid=int(hello.get("pid", -1)))
+        with self._wlock:
+            self._tx_seq = 0  # fresh connection: both directions at 0
         self._sock = conn
         self.killed = False
         self._draining = False
@@ -884,9 +1107,316 @@ class ProcReplica:
             time.sleep(0.002)
         return self
 
+    # -- TCP transport modes (ISSUE 18) -----------------------------------
+    def listen_addr(self) -> Tuple[str, int]:
+        """The address a worker must `--connect` to: the ChaosProxy's
+        front door when net chaos is armed, else the raw listener."""
+        if self._proxy is not None:
+            return self._proxy.addr
+        if self._listen_addr is None:
+            raise RuntimeError(f"replica {self.name} is not listening")
+        return self._listen_addr
+
+    def net_chaos_snapshot(self) -> Optional[Dict]:
+        """The armed `ChaosProxy`'s counter snapshot (frames seen,
+        partitions/delays/reorders/dups/drips injected); None when no
+        net chaos is armed. Bench reads this to prove the injected
+        frame-fault RATE, not just that faults were scheduled. After
+        `stop(final=True)` tears the proxy down, the LAST snapshot
+        stays readable — evidence survives shutdown."""
+        px = self._proxy
+        return self._proxy_final if px is None else px.snapshot()
+
+    def _ensure_listener(self) -> None:
+        if self._lsock is not None:
+            return
+        lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        lsock.bind((self._host, self._port))
+        lsock.listen(4)
+        self._lsock = lsock
+        self._listen_addr = lsock.getsockname()[:2]
+        if self._net_chaos is not None and self._proxy is None:
+            from . import netchaos
+
+            # the proxy IS the network between parent and worker: it
+            # persists across worker generations and reconnects
+            self._proxy = netchaos.ChaosProxy(
+                upstream=self._listen_addr, **self._net_chaos).start()
+        t = threading.Thread(target=self._accept_loop, args=(lsock,),
+                             name=f"singa_tpu-accept-{self.name}",
+                             daemon=True)
+        self._accept_thread = t
+        t.start()
+
+    def _start_listen(self) -> "ProcReplica":
+        if self._sock is not None and not self.killed:
+            return self
+        self._ensure_listener()
+        self._established.clear()
+        with self._plock:
+            self._reconnecting = False
+        self.killed = False
+        self._draining = False
+        if self._launch == "local" and (
+                self._proc is None or self._proc.poll() is not None):
+            self._launch_local_worker()
+        if not self._established.wait(self.spawn_timeout_s):
+            code = None if self._proc is None else self._proc.poll()
+            raise ProcTransportError(
+                f"worker {self.name}: no authenticated connection on "
+                f"{self.listen_addr()} within {self.spawn_timeout_s}s "
+                f"(local worker exit code {code})")
+        deadline = time.perf_counter() + min(5.0, self.spawn_timeout_s)
+        while self._hb is None and time.perf_counter() < deadline:
+            time.sleep(0.002)
+        return self
+
+    def _start_connect(self) -> "ProcReplica":
+        if self._sock is not None and not self.killed:
+            return self
+        self._established.clear()
+        with self._plock:
+            self._reconnecting = False
+        self.killed = False
+        self._draining = False
+        try:
+            conn = socket.create_connection(
+                (self._host, self._port), timeout=self.spawn_timeout_s)
+        except OSError as e:
+            raise ProcTransportError(
+                f"replica {self.name}: cannot dial worker at "
+                f"{self._host}:{self._port} ({e})")
+        try:
+            self._tcp_handshake(conn)
+        except Exception:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            raise
+        deadline = time.perf_counter() + min(5.0, self.spawn_timeout_s)
+        while self._hb is None and time.perf_counter() < deadline:
+            time.sleep(0.002)
+        return self
+
+    def _launch_local_worker(self) -> None:
+        """The `listen`-mode local launch: the worker gets ONLY the
+        CLI a remote host would get (`--connect host:port --token`) —
+        no spec in its env, so the WELCOME spec-shipping path is
+        exercised on every hermetic run — plus the env hygiene any
+        launch recipe needs (PYTHONPATH, backend pin, store dir)."""
+        env = dict(os.environ)
+        root = _repo_root()
+        env["PYTHONPATH"] = (root + os.pathsep
+                             + env.get("PYTHONPATH", ""))
+        if not env.get("JAX_PLATFORMS"):
+            try:
+                import jax
+
+                env["JAX_PLATFORMS"] = jax.default_backend()
+            except Exception:
+                pass
+        store = self.spec.get("export_cache") or export_cache.directory()
+        if store:
+            env["SINGA_TPU_EXPORT_CACHE"] = store
+        env.pop("SINGA_TPU_FLEET_SPEC", None)
+        host, port = self.listen_addr()
+        self._proc = subprocess.Popen(
+            [self._python, "-m", "singa_tpu.fleet_worker",
+             "--connect", f"{host}:{port}", "--token", self._token,
+             "--name", self.name],
+            env=env, cwd=root, stdout=subprocess.DEVNULL)
+
+    def _accept_loop(self, lsock: socket.socket) -> None:
+        while self._lsock is lsock:
+            try:
+                conn, _ = lsock.accept()
+            except OSError:
+                return  # listener closed: replica stopped
+            try:
+                self._tcp_handshake(conn)
+            except Exception:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    def _tcp_handshake(self, conn: socket.socket) -> None:
+        """Authenticate + fence one inbound/dialed connection. The
+        worker speaks first (HELLO {token, fence, need_spec, ...});
+        the parent answers WELCOME (adopt or resume) or FENCED
+        (refuse) and only then puts the connection in service."""
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        conn.settimeout(min(10.0, self.spawn_timeout_s))
+        reader = FrameReader(max_frame_bytes=self.max_frame_bytes,
+                             check_seq=True)
+        hello = None
+        stashed: List[Tuple[int, int, bytes]] = []
+        deadline = time.perf_counter() + self.spawn_timeout_s
+        while hello is None:
+            if time.perf_counter() > deadline:
+                raise ProcTransportError(
+                    f"worker {self.name}: no HELLO within "
+                    f"{self.spawn_timeout_s}s")
+            try:
+                chunk = conn.recv(65536)
+            except socket.timeout:
+                continue
+            if not chunk:
+                raise ProcTransportError(
+                    f"worker {self.name}: connection closed before "
+                    "HELLO")
+            for ftype, rid, payload in reader.feed(chunk):
+                if ftype == HELLO and hello is None:
+                    hello = json.loads(payload.decode("utf-8"))
+                else:
+                    stashed.append((ftype, rid, payload))
+        if hello.get("token") != self._token:
+            self._refuse(conn, "auth token mismatch")
+            raise ProcTransportError(
+                f"worker {self.name}: HELLO token mismatch")
+        fence = hello.get("fence")
+        with self._plock:
+            live = self._sock is not None
+            resumable = (self._reconnecting and not self.killed
+                         and time.perf_counter()
+                         < self._reconnect_deadline)
+        if fence is None:
+            # fresh adoption: a brand-new worker generation
+            if live:
+                self._refuse(conn, "a live connection already serves "
+                                   "the current generation")
+                raise ProcTransportError(
+                    f"worker {self.name}: second fresh HELLO while a "
+                    "connection is live")
+            with self._plock:
+                self._fence += 1
+                self._gen += 1
+                gen = self._gen
+                self._gens[gen] = _Gen(pid=int(hello.get("pid", -1)))
+                self._reconnecting = False
+            welcome = {"fence": self._fence, "gen": gen,
+                       "reconnect_window_s": self.reconnect_window_s}
+            if hello.get("need_spec"):
+                spec = _jsonable_spec(self.spec)
+                spec.setdefault("name", self.name)
+                spec["heartbeat_interval_s"] = self.heartbeat_interval_s
+                if trace_mod.enabled():
+                    spec.setdefault("trace", {
+                        "enabled": True, "ship_capacity": 2048,
+                        "ring_capacity":
+                            trace_mod.get_config()["ring_capacity"]})
+                if "export_cache" not in spec:
+                    spec["export_cache"] = export_cache.directory()
+                spec.pop("token", None)
+                spec.pop("port", None)
+                welcome["spec"] = spec
+            self._wire_up(conn, reader, gen, welcome, stashed)
+            return
+        if int(fence) == self._fence and not self.killed:
+            # Same-generation reconnect: the fence (token-authed) is
+            # the authority, not the parent's view of the old socket —
+            # the worker sees an inbound fault FIRST and redials
+            # before the parent has noticed anything wrong. The newer
+            # connection supersedes the old one: its in-flight
+            # requests fail over NOW (PR 11 machinery; replies the
+            # worker resends for them dedup by rid, so nothing
+            # double-delivers) and the old reader's eventual
+            # conn-lost is a recorded no-op.
+            with self._plock:
+                gen = self._gen
+                self._reconnecting = False
+                old, self._sock = self._sock, None
+                if old is not None:
+                    self._superseded.add(old)
+            if old is not None:
+                try:
+                    old.close()
+                except OSError:
+                    pass
+                self._fail_all_pending(ProcTransportError(
+                    f"worker {self.name} (gen {gen}): connection "
+                    "superseded by a same-generation reconnect; "
+                    "in-flight requests fail over"))
+            self.reconnects += 1
+            self._wire_up(conn, reader, gen,
+                          {"fence": self._fence, "gen": gen,
+                           "reconnect_window_s":
+                               self.reconnect_window_s,
+                           "resumed": True}, stashed)
+            return
+        # stale (or out-of-window) generation fence: refuse LOUDLY —
+        # a replayed/superseded connection must never resurrect a
+        # generation the supervisor has moved past
+        self.stale_reconnects_refused += 1
+        self._refuse(conn, f"stale generation fence {fence} "
+                           f"(current {self._fence}, "
+                           f"window={'open' if resumable else 'closed'})")
+        raise ProcTransportError(
+            f"worker {self.name}: stale-generation reconnect refused "
+            f"(fence {fence}, current {self._fence})")
+
+    def _refuse(self, conn: socket.socket, reason: str) -> None:
+        try:
+            send_frame(conn, encode_frame(
+                FENCED, 0,
+                json.dumps({"reason": reason}).encode("utf-8"),
+                seq=0), deadline_s=2.0)
+        except OSError:
+            pass
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    def _wire_up(self, conn: socket.socket, reader: FrameReader,
+                 gen: int, welcome: Dict, stashed) -> None:
+        with self._wlock:
+            self._tx_seq = 0  # fresh connection: both directions at 0
+        self._sock = conn
+        self.killed = False
+        conn.settimeout(0.05)
+        self._send(WELCOME, 0,
+                   json.dumps(welcome).encode("utf-8"))
+        for ftype, rid, payload in stashed:
+            try:
+                self._handle_frame(ftype, rid, payload, gen)
+            except Exception:
+                pass
+        self._reader = threading.Thread(
+            target=self._read_loop, args=(conn, reader, gen),
+            name=f"singa_tpu-proc-{self.name}", daemon=True)
+        self._reader.start()
+        self._established.set()
+
+    def _reconnect_active(self) -> bool:
+        """True while the bounded reconnect window is open. On expiry
+        the generation is DECLARED dead (killed=True) — the supervisor
+        restart story takes over — and a lingering local worker is
+        reaped so a later respawn cannot race two workers onto one
+        device."""
+        with self._plock:
+            if not self._reconnecting:
+                return False
+            if time.perf_counter() < self._reconnect_deadline:
+                return True
+            self._reconnecting = False
+        self.killed = True
+        self.sigkill()  # no-op for an external worker (no local proc)
+        return False
+
     def _alive(self) -> bool:
-        return (self._proc is not None and self._proc.poll() is None
-                and not self.killed)
+        if self.killed:
+            return False
+        if self._tcp:
+            p = self._proc
+            if p is not None and p.poll() is not None:
+                return False  # local worker observably dead
+            if self._sock is not None:
+                return True
+            return self._reconnect_active()
+        return self._proc is not None and self._proc.poll() is None
 
     def kill(self) -> None:
         """Hard replica death: SIGKILL the worker. In-flight futures
@@ -911,32 +1441,65 @@ class ProcReplica:
     def drain_stop(self) -> None:
         """Router drain semantics: the worker stops admitting, fails
         its queued futures (`ServeClosedError` frames => the router
-        reroutes them), ships its final counters (BYE), and exits 0."""
+        reroutes them), ships its final counters (BYE), and exits 0.
+        TCP listener/proxy stay up — a restart() re-adopts through
+        them."""
         self._shutdown(drain=False, timeout=10.0)
 
     def stop(self, drain: bool = True) -> None:
         self._shutdown(drain=drain, timeout=max(
-            10.0, self.spawn_timeout_s / 2))
+            10.0, self.spawn_timeout_s / 2), final=True)
 
-    def _shutdown(self, drain: bool, timeout: float) -> None:
+    def _shutdown(self, drain: bool, timeout: float,
+                  final: bool = False) -> None:
         p = self._proc
-        if p is None:
+        if p is None and self._sock is None and not final:
             return
         self._draining = True
-        if p.poll() is None and self._sock is not None:
+        alive = (p is not None and p.poll() is None) \
+            or (p is None and self._sock is not None)
+        if alive and self._sock is not None:
             try:
                 self._send(CTRL, 0, json.dumps(
                     {"op": "drain", "drain": bool(drain)}
                 ).encode("utf-8"))
             except Exception:
                 pass
-            try:
-                p.wait(timeout)
-            except subprocess.TimeoutExpired:
-                # a hung dispatch must not block stop forever: kill,
-                # sweep, respawn is the supervisor's problem
-                self.sigkill()
+            if p is not None:
+                try:
+                    p.wait(timeout)
+                except subprocess.TimeoutExpired:
+                    # a hung dispatch must not block stop forever:
+                    # kill, sweep, respawn is the supervisor's problem
+                    self.sigkill()
+            else:
+                # external worker (connect / listen+launch=none): wait
+                # for its BYE handshake or EOF, bounded — the parent
+                # cannot reap a process it never owned
+                dl = time.perf_counter() + timeout
+                while time.perf_counter() < dl:
+                    g = self._gens.get(self._gen)
+                    if self._sock is None or (g is not None and g.clean):
+                        break
+                    time.sleep(0.02)
         self._reap(expected=True)
+        if final:
+            self._close_tcp()
+
+    def _close_tcp(self) -> None:
+        ls, self._lsock = self._lsock, None
+        if ls is not None:
+            try:
+                ls.close()
+            except OSError:
+                pass
+        self._listen_addr = None
+        px, self._proxy = self._proxy, None
+        if px is not None:
+            # keep the final fault evidence readable after shutdown —
+            # the bench reconciles proxy counters at quiescence
+            self._proxy_final = px.snapshot()
+            px.stop()
 
     def _reap(self, expected: bool) -> None:
         p, self._proc = self._proc, None
@@ -969,31 +1532,67 @@ class ProcReplica:
         """Respawn a fresh worker from the same deterministic spec.
         With the shared store prewarmed the new generation's first
         dispatch of every bucket is a store LOAD — deserialize-only,
-        provable from the heartbeat's export counters."""
-        if self._proc is not None:
+        provable from the heartbeat's export counters. TCP modes:
+        `listen`+local relaunches the worker through the persistent
+        listener (new generation, new fence); `connect` re-dials the
+        external worker — which can only be re-adopted FRESH, its old
+        fence is dead."""
+        if self._proc is not None or self._sock is not None:
             self.sigkill()
             self._reap(expected=True)
         self.restarts += 1
         self._frozen_snap = None
         self._hb = None
+        with self._plock:
+            self._reconnecting = False
         return self.start()
 
     # -- request path -----------------------------------------------------
     def _send(self, ftype: int, rid: int, payload: bytes) -> None:
+        """Serialize one frame onto the wire UNDER the write lock with
+        the partial-write-hardened `send_frame` loop: the socket
+        carries a short `settimeout`, and a bare `sendall` under one
+        can write a PREFIX of a frame, raise `socket.timeout`, and let
+        the next caller interleave its frame mid-frame — permanent
+        stream corruption. `send_frame` retries short writes on the
+        SAME frame to a deadline; if it still fails, bytes may be out,
+        so the connection is poisoned (closed — the reader path then
+        fails in-flight requests and, on TCP, opens the reconnect
+        window) rather than reused."""
         sock = self._sock
         if sock is None:
             raise ServeClosedError(f"replica {self.name} is dead")
         with self._wlock:
+            if self._sock is not sock:
+                sock = self._sock  # reconnected under our feet
+                if sock is None:
+                    raise ServeClosedError(
+                        f"replica {self.name} is dead")
             stall, self._stall_s = self._stall_s, 0.0
             if stall > 0:
                 time.sleep(stall)  # injected pipe_stall: the write
                 # path wedges while holding the pipe, exactly what a
                 # full socket buffer looks like from the caller side
+            frame = encode_frame(ftype, rid, payload,
+                                 seq=self._tx_seq)
             try:
-                sock.sendall(encode_frame(ftype, rid, payload))
+                send_frame(sock, frame,
+                           deadline_s=min(self.ipc_deadline_s, 10.0))
             except OSError as e:
+                self._poison_conn(sock)
                 raise ServeClosedError(
                     f"replica {self.name}: pipe write failed ({e})")
+            self._tx_seq += 1
+
+    def _poison_conn(self, sock: socket.socket) -> None:
+        """A frame may be HALF-written on this connection: it can
+        never carry another frame. Shut it down so the reader thread
+        observes the loss and runs the death/reconnect machinery."""
+        if self._sock is sock:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
 
     def submit(self, *arrays, deadline_ms: Optional[float] = None
                ) -> ServeReply:
@@ -1004,6 +1603,17 @@ class ProcReplica:
         request — the `fleet.reconcile` equations hold unchanged."""
         if not self._alive():
             raise ServeClosedError(f"replica {self.name} is dead")
+        if self._tcp and self._sock is None:
+            # reconnect window open: there is no pipe to put the
+            # request on. Shed LOUDLY (mirrored requests+shed keeps
+            # the engine equation exact) with a retry hint sized to
+            # the window — the router's shed-aware retry lands it on
+            # a healthy replica instead of stranding the caller here.
+            note_remote_request()
+            note_remote_terminal("shed")
+            raise ServeOverloadError(
+                f"replica {self.name}: transport reconnecting — "
+                "no connection to admit on", retry_after_ms=50.0)
         batch = ServingEngine._as_batch(arrays)
         if not batch:
             raise ValueError("serve request needs at least one input")
@@ -1157,6 +1767,16 @@ class ProcReplica:
         unless the session itself has one."""
         if not self._alive():
             raise ServeClosedError(f"replica {self.name} is dead")
+        if self._tcp and self._sock is None:
+            # reconnect window open: shed the session loudly, exactly
+            # like the worker's own slot-pool refusal (sessions+shed
+            # keeps the decode equation exact)
+            note_remote_decode_session(resumed=(ftype == RESUME))
+            note_remote_decode_terminal("shed")
+            raise ServeOverloadError(
+                f"replica {self.name}: transport reconnecting — "
+                "no connection to admit the session on",
+                retry_after_ms=50.0)
         reply = ServeReply(1)
         with self._plock:
             self._next_id += 1
@@ -1271,6 +1891,14 @@ class ProcReplica:
                     "reasons": [f"worker {self.name} dead (exit code "
                                 f"{code})"],
                     "time": round(time.time(), 3), "name": self.name}
+        if self._tcp and self._sock is None:
+            # reconnect window open: fail closed NOW (the router
+            # ejects and routes around) — an unstamped snapshot also
+            # reads as stale, so both freshness paths agree
+            return {"state": "unhealthy",
+                    "reasons": ["connection lost; reconnect window "
+                                "open"],
+                    "name": self.name}
         hb = self._hb
         if hb is None:
             # spawned but no heartbeat yet: an unstamped snapshot
@@ -1307,7 +1935,8 @@ class ProcReplica:
                     "handshake": gen.handshake,
                     "pid": gen.pid,
                     "clock_offset_us": gen.clock_offset_us,
-                    "clock_rtt_s": gen.clock_rtt_s}
+                    "clock_rtt_s": gen.clock_rtt_s,
+                    "clock_uncertainty_us": gen.clock.uncertainty_us()}
                 for g, gen in self._gens.items()}
             return {
                 "sent": self.sent,
@@ -1316,8 +1945,16 @@ class ProcReplica:
                 "transport_failed": self.transport_failed,
                 "ipc_timeouts": self.ipc_timeouts,
                 "torn_frames_detected": self.torn_frames_detected,
+                "replay_frames_detected": self.replay_frames_detected,
+                "gap_frames_detected": self.gap_frames_detected,
                 "pending": len(self._pending),
                 "heartbeats": self.hb_received,
+                "mode": self._mode,
+                "fence": self._fence,
+                "reconnects": self.reconnects,
+                "reconnect_windows": self.reconnect_windows,
+                "stale_reconnects_refused":
+                    self.stale_reconnects_refused,
                 "spans_received": self.spans_received,
                 "spans_dropped": self.spans_dropped,
                 "decode": {
@@ -1366,6 +2003,29 @@ class ProcReplica:
         except ServeClosedError:
             pass
 
+    def net_fault(self, kind: str, **kw) -> None:
+        """Route a `net_*` chaos kind into the replica's armed
+        `ChaosProxy` (no-op without one — the router's chaos layer
+        probes via getattr, same as the other proc-only kinds):
+        partition/half_open are timed both/one-direction stalls, the
+        rest arm the proxy's next-frame one-shots."""
+        px = self._proxy
+        if px is None:
+            return
+        if kind == "net_partition":
+            px.partition(float(kw.get("t_s", 0.4)))
+        elif kind == "net_half_open":
+            px.half_open(float(kw.get("t_s", 0.3)),
+                         direction=kw.get("direction", "u2c"))
+        elif kind == "net_delay":
+            px.delay_next(float(kw.get("ms", 5.0)))
+        elif kind == "net_reorder":
+            px.reorder_next()
+        elif kind == "net_dup":
+            px.duplicate_next()
+        elif kind == "net_drip":
+            px.drip_next()
+
     # -- reader thread -----------------------------------------------------
     def _read_loop(self, sock: socket.socket, reader: FrameReader,
                    gen: int) -> None:
@@ -1377,16 +2037,17 @@ class ProcReplica:
             except socket.timeout:
                 self._sweep_deadlines()
                 p = self._proc
-                if (p is None or p.poll() is not None) \
-                        and reader.pending_bytes() == 0:
+                dead = (p.poll() is not None if p is not None
+                        else not self._tcp)
+                if dead and reader.pending_bytes() == 0:
                     self._on_dead(gen, sock)
                     return
                 continue
             except OSError:
-                self._on_dead(gen, sock)
+                self._on_conn_lost(gen, sock)
                 return
             if not chunk:
-                self._on_dead(gen, sock)
+                self._on_conn_lost(gen, sock)
                 return
             try:
                 frames = reader.feed(chunk)
@@ -1419,11 +2080,9 @@ class ProcReplica:
                 # midpoint-minus-stamp is the clock offset, and the
                 # smallest-RTT handshake gives the tightest estimate
                 (t_w,) = struct.unpack(">d", payload)
-                rtt = t_recv - ent.t_send
-                if g.clock_rtt_s is None or rtt < g.clock_rtt_s:
-                    g.clock_rtt_s = rtt
-                    g.clock_offset_us = (
-                        (ent.t_send + t_recv) / 2.0 - t_w) * 1e6
+                g.clock.add(ent.t_send, t_recv, t_w)
+                g.clock_rtt_s = g.clock.rtt_s()
+                g.clock_offset_us = g.clock.offset_us()
                 if ent.trace is not None:
                     # the IPC transit leg of this request's timeline
                     trace_mod.record_span(
@@ -1693,6 +2352,93 @@ class ProcReplica:
         for waiter in ctrl:
             waiter["ev"].set()
 
+    def _on_conn_lost(self, gen: int, sock: socket.socket) -> None:
+        """Socket EOF/error. Spawn mode: the connection IS the process
+        — child death. TCP modes: the connection is only the NETWORK;
+        unless the (local) worker is observably dead or the stop path
+        asked for this, the generation gets its bounded reconnect
+        window: in-flight requests fail over NOW (PR 11 machinery —
+        never hang), health reads unhealthy so the router ejects, and
+        a reconnect HELLO carrying the current fence resumes the same
+        generation. Window expiry (checked by the health/liveness
+        probes) declares the generation dead."""
+        with self._plock:
+            if sock in self._superseded:
+                # a same-fence reconnect already replaced this
+                # connection — its loss is old news, not a new window
+                self._superseded.discard(sock)
+                return
+        if not self._tcp:
+            self._on_dead(gen, sock)
+            return
+        g = self._gens.get(gen)
+        p = self._proc
+        if (self._draining or self.killed
+                or (g is not None and g.clean)
+                or (p is not None and p.poll() is not None)):
+            self._on_dead(gen, sock)
+            return
+        fresh = False
+        with self._plock:
+            if self._sock is sock:
+                self._sock = None
+            if not self._reconnecting:
+                self._reconnecting = True
+                fresh = True
+            self._reconnect_deadline = (time.perf_counter()
+                                        + self.reconnect_window_s)
+        try:
+            sock.close()
+        except OSError:
+            pass
+        if fresh:
+            self.reconnect_windows += 1
+        self._fail_all_pending(ProcTransportError(
+            f"worker {self.name} (gen {gen}) connection lost; "
+            "in-flight requests fail over while the worker gets a "
+            f"{self.reconnect_window_s:g}s reconnect window"))
+        if self._mode == "connect":
+            t = threading.Thread(target=self._redial_loop,
+                                 name=f"singa_tpu-redial-{self.name}",
+                                 daemon=True)
+            t.start()
+
+    def _redial_loop(self) -> None:
+        """`connect` mode owns re-establishment from the parent side:
+        seeded-backoff redials of the worker's listen address until
+        the handshake resumes the generation or the window expires."""
+        from . import resilience
+
+        attempt = 0
+        while True:
+            with self._plock:
+                if (not self._reconnecting or self._sock is not None
+                        or self.killed):
+                    return
+                deadline = self._reconnect_deadline
+            attempt += 1
+            delay = resilience.backoff_delay_s(
+                attempt, 0.05, seed=hash(self.name) & 0x7FFFFFFF,
+                salt="redial")
+            if time.perf_counter() + delay >= deadline:
+                time.sleep(max(0.0, deadline - time.perf_counter()))
+                self._reconnect_active()  # flips killed on expiry
+                return
+            time.sleep(delay)
+            try:
+                conn = socket.create_connection(
+                    (self._host, self._port), timeout=5.0)
+            except OSError:
+                continue
+            try:
+                self._tcp_handshake(conn)
+                return
+            except Exception:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
     def _on_dead(self, gen: int, sock: socket.socket) -> None:
         p = self._proc
         code = None
@@ -1722,15 +2468,29 @@ class ProcReplica:
     def _on_corrupt(self, gen: int, sock: socket.socket,
                     e: FrameCorruptError) -> None:
         """Fail closed on stream corruption: every in-flight future
-        fails LOUDLY, the worker is killed (the stream cannot be
-        resynced by guessing), and the supervisor respawns it from
-        the store."""
+        fails LOUDLY — a corrupt stream cannot be resynced by
+        guessing. Spawn mode kills the worker for respawn (the
+        connection is the process). TCP modes tear down only the
+        CONNECTION: corruption there indicts the network (duplicated,
+        reordered, torn frames), not the process, so the worker gets
+        its reconnect window and a FRESH stream (sequence numbers
+        restart) — replay/gap damage is counted per taxonomy either
+        way and never delivered as data."""
         self.torn_frames_detected += 1
+        if isinstance(e, FrameReplayError):
+            self.replay_frames_detected += 1
+        elif isinstance(e, FrameGapError):
+            self.gap_frames_detected += 1
         import sys as _sys
 
         print(f"singa_tpu: replica {self.name} frame stream corrupt "
-              f"({e}); failing in-flight requests and killing the "
-              "worker for respawn", file=_sys.stderr)
+              f"({e}); failing in-flight requests and "
+              + ("dropping the connection for reconnect"
+                 if self._tcp else "killing the worker for respawn"),
+              file=_sys.stderr)
+        if self._tcp and not self._draining:
+            self._on_conn_lost(gen, sock)
+            return
         self.killed = True
         self.sigkill()
         self._on_dead(gen, sock)

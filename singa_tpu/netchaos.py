@@ -1,0 +1,375 @@
+"""Deterministic network-fault injection for the TCP fleet transport
+(ISSUE 18): an in-process TCP proxy that sits between a
+`ProcReplica(mode="listen")` parent and its worker and injects the
+faults a real network produces — so the chaos soak can PROVE the
+transport's detection and recovery story instead of asserting it.
+
+    ChaosProxy(upstream=(host, port), seed=7, dup_prob=0.02).start()
+
+The proxy binds its own ephemeral front door (`.addr`); the worker
+dials THAT (the parent hands it out as `listen_addr()`), and every
+accepted connection is pumped to the upstream listener through two
+relay threads (one per direction: `c2u` = client->upstream, i.e.
+worker->parent in listen mode; `u2c` the reverse). Each direction is
+split into frames structurally — the 24-byte wire-v2 header carries
+the payload length at offset 4 — so faults land on FRAME boundaries,
+which is what makes the receiver's verdicts typed (`FrameReplayError`
+for a duplicate, `FrameGapError` for a reorder) rather than CRC
+noise. A stream that stops parsing (bad magic, absurd length) drops
+to raw passthrough for that connection: the proxy never invents
+bytes and never eats them.
+
+Fault kinds (the fleet chaos vocabulary `net_*` maps 1:1):
+
+  * ``partition(t_s)``     — stall BOTH directions for t seconds
+                             (buffered, heals: the classic partition)
+  * ``half_open(t_s, direction)`` — stall ONE direction only
+  * ``delay_next(ms)``     — one-shot added latency on the next frame
+  * ``reorder_next()``     — swap the next two frames (=> gap at the
+                             receiver, detected, never delivered)
+  * ``duplicate_next()``   — send the next frame twice (=> replay)
+  * ``drip_next()``        — write the next frame 1 byte at a time
+                             (the reader-compaction worst case)
+
+Determinism: probabilistic per-frame draws (``dup_prob`` etc.) are
+seed-keyed on ``(seed, connection ordinal, direction, kind, frame
+ordinal)`` via sha256 — the same run injects the same faults, no RNG
+state, no wall clock. Standing per-direction delays
+(``delay_c2u_ms``/``delay_u2c_ms``) model asymmetric paths for the
+clock-offset sanity pins.
+
+Loopback-only by construction (the front door binds 127.0.0.1): this
+is a test/bench instrument, not a network service."""
+from __future__ import annotations
+
+import hashlib
+import socket
+import struct
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["ChaosProxy"]
+
+_HDR_LEN = 24          # wire v2: >2sBBIQII
+_MAGIC = b"SF"
+_MAX_SANE = 1 << 30    # a "length" past this is not a frame header
+
+
+def _u01(seed: int, conn: int, direction: str, kind: str,
+         ordinal: int) -> float:
+    """Deterministic uniform draw in [0, 1): same (seed, conn,
+    direction, kind, ordinal) => same verdict, forever."""
+    h = hashlib.sha256(
+        f"{seed}/{conn}/{direction}/{kind}/{ordinal}"
+        .encode("utf-8")).digest()
+    return int.from_bytes(h[:8], "big") / float(1 << 64)
+
+
+class ChaosProxy:
+    """See module docstring. Lifecycle: ``start()`` -> (faults at
+    will, from any thread) -> ``stop()``."""
+
+    def __init__(self, upstream: Tuple[str, int], *, seed: int = 0,
+                 delay_prob: float = 0.0, delay_ms: float = 2.0,
+                 reorder_prob: float = 0.0, dup_prob: float = 0.0,
+                 drip_prob: float = 0.0,
+                 delay_c2u_ms: float = 0.0,
+                 delay_u2c_ms: float = 0.0,
+                 host: str = "127.0.0.1"):
+        self.upstream = (str(upstream[0]), int(upstream[1]))
+        self.seed = int(seed)
+        self.delay_prob = float(delay_prob)
+        self.delay_ms = float(delay_ms)
+        self.reorder_prob = float(reorder_prob)
+        self.dup_prob = float(dup_prob)
+        self.drip_prob = float(drip_prob)
+        self._standing = {"c2u": float(delay_c2u_ms),
+                          "u2c": float(delay_u2c_ms)}
+        self._host = host
+        self._lsock: Optional[socket.socket] = None
+        self._addr: Optional[Tuple[str, int]] = None
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        # stall deadlines (perf_counter) per direction: partition
+        # sets both, half_open one
+        self._until = {"c2u": 0.0, "u2c": 0.0}
+        # one-shot fault queue per direction: [(kind, arg)]
+        self._next: Dict[str, List] = {"c2u": [], "u2c": []}
+        self._conn_ord = 0
+        self._threads: List[threading.Thread] = []
+        self._conn_socks: List[socket.socket] = []
+        self.counters = {"conns": 0, "frames": 0, "raw_chunks": 0,
+                         "partitions": 0, "half_opens": 0,
+                         "delays": 0, "reorders": 0, "dups": 0,
+                         "drips": 0}
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "ChaosProxy":
+        ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        ls.bind((self._host, 0))
+        ls.listen(8)
+        ls.settimeout(0.2)
+        self._lsock = ls
+        self._addr = ls.getsockname()[:2]
+        t = threading.Thread(target=self._accept_loop,
+                             name="netchaos-accept", daemon=True)
+        self._threads.append(t)
+        t.start()
+        return self
+
+    @property
+    def addr(self) -> Tuple[str, int]:
+        if self._addr is None:
+            raise RuntimeError("ChaosProxy is not started")
+        return self._addr
+
+    def stop(self) -> None:
+        self._stop.set()
+        ls, self._lsock = self._lsock, None
+        if ls is not None:
+            try:
+                ls.close()
+            except OSError:
+                pass
+        with self._lock:
+            socks = list(self._conn_socks)
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+        for t in self._threads:
+            if t is not threading.current_thread():
+                t.join(2.0)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self.counters)
+
+    # -- fault commands (any thread) --------------------------------------
+    def partition(self, t_s: float = 0.5) -> None:
+        until = time.perf_counter() + float(t_s)
+        with self._lock:
+            self._until["c2u"] = max(self._until["c2u"], until)
+            self._until["u2c"] = max(self._until["u2c"], until)
+            self.counters["partitions"] += 1
+
+    def half_open(self, t_s: float = 0.5,
+                  direction: str = "u2c") -> None:
+        until = time.perf_counter() + float(t_s)
+        with self._lock:
+            self._until[direction] = max(self._until[direction],
+                                         until)
+            self.counters["half_opens"] += 1
+
+    def delay_next(self, ms: float = 5.0,
+                   direction: str = "c2u") -> None:
+        with self._lock:
+            self._next[direction].append(("delay", float(ms)))
+
+    def reorder_next(self, direction: str = "c2u") -> None:
+        with self._lock:
+            self._next[direction].append(("reorder", None))
+
+    def duplicate_next(self, direction: str = "c2u") -> None:
+        with self._lock:
+            self._next[direction].append(("dup", None))
+
+    def drip_next(self, direction: str = "c2u") -> None:
+        with self._lock:
+            self._next[direction].append(("drip", None))
+
+    # -- relay ------------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            ls = self._lsock
+            if ls is None:
+                return
+            try:
+                client, _ = ls.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                up = socket.create_connection(self.upstream,
+                                              timeout=5.0)
+            except OSError:
+                try:
+                    client.close()
+                except OSError:
+                    pass
+                continue
+            for s in (client, up):
+                try:
+                    s.setsockopt(socket.IPPROTO_TCP,
+                                 socket.TCP_NODELAY, 1)
+                except OSError:
+                    pass
+            with self._lock:
+                conn = self._conn_ord
+                self._conn_ord += 1
+                self._conn_socks.extend((client, up))
+                self.counters["conns"] += 1
+            for src, dst, direction in ((client, up, "c2u"),
+                                        (up, client, "u2c")):
+                t = threading.Thread(
+                    target=self._pump,
+                    args=(src, dst, direction, conn),
+                    name=f"netchaos-{direction}-{conn}", daemon=True)
+                self._threads.append(t)
+                t.start()
+
+    def _wait_clear(self, direction: str) -> None:
+        """Block while this direction is stalled (partition /
+        half-open). Bytes already read are BUFFERED, not dropped —
+        the stall heals and the stream resumes intact, which is what
+        distinguishes a partition from corruption."""
+        while not self._stop.is_set():
+            with self._lock:
+                until = self._until[direction]
+            now = time.perf_counter()
+            if now >= until:
+                return
+            time.sleep(min(0.01, until - now))
+
+    def _ship(self, dst: socket.socket, direction: str, data: bytes,
+              drip: bool = False) -> None:
+        self._wait_clear(direction)
+        if drip:
+            mv = memoryview(data)
+            for i in range(len(mv)):
+                dst.sendall(mv[i:i + 1])
+        else:
+            dst.sendall(data)
+
+    def _pump(self, src: socket.socket, dst: socket.socket,
+              direction: str, conn: int) -> None:
+        buf = bytearray()
+        raw = False          # structural parse failed: passthrough
+        stash: Optional[bytes] = None  # reorder: held frame
+        stash_t = 0.0
+        ford = 0             # frame ordinal (this conn+direction)
+        try:
+            src.settimeout(0.05)
+        except OSError:
+            return
+        try:
+            while not self._stop.is_set():
+                try:
+                    chunk = src.recv(1 << 16)
+                except socket.timeout:
+                    if stash is not None and \
+                            time.perf_counter() - stash_t > 0.25:
+                        # nothing arrived to swap with: release the
+                        # held frame (the fault degrades to a delay
+                        # rather than wedging the stream)
+                        self._ship(dst, direction, stash)
+                        stash = None
+                    continue
+                except OSError:
+                    return
+                if not chunk:
+                    return
+                if raw:
+                    with self._lock:
+                        self.counters["raw_chunks"] += 1
+                    self._ship(dst, direction, chunk)
+                    continue
+                buf += chunk
+                while len(buf) >= _HDR_LEN:
+                    if bytes(buf[:2]) != _MAGIC:
+                        raw = True
+                    else:
+                        (n,) = struct.unpack_from(">I", buf, 4)
+                        if n > _MAX_SANE:
+                            raw = True
+                    if raw:
+                        # not a frame stream (or we desynced): relay
+                        # everything buffered verbatim and stop
+                        # pretending to understand it
+                        data, buf = bytes(buf), bytearray()
+                        with self._lock:
+                            self.counters["raw_chunks"] += 1
+                        self._ship(dst, direction, data)
+                        break
+                    total = _HDR_LEN + n
+                    if len(buf) < total:
+                        break
+                    frame = bytes(buf[:total])
+                    del buf[:total]
+                    with self._lock:
+                        self.counters["frames"] += 1
+                        cmd = (self._next[direction].pop(0)
+                               if self._next[direction] else None)
+                    kind, arg = cmd if cmd else (None, None)
+                    # the standing per-direction delay models the
+                    # PATH (asymmetric latency for the offset pins);
+                    # only injected delays count as frame faults
+                    delay_ms = self._standing[direction]
+                    fault_delay = False
+                    if kind == "delay":
+                        delay_ms += arg
+                        fault_delay = True
+                        kind = None
+                    if kind is None:
+                        # deterministic per-frame draws
+                        if self.dup_prob and _u01(
+                                self.seed, conn, direction, "dup",
+                                ford) < self.dup_prob:
+                            kind = "dup"
+                        elif self.reorder_prob and _u01(
+                                self.seed, conn, direction,
+                                "reorder", ford) < self.reorder_prob:
+                            kind = "reorder"
+                        elif self.drip_prob and _u01(
+                                self.seed, conn, direction, "drip",
+                                ford) < self.drip_prob:
+                            kind = "drip"
+                        if self.delay_prob and _u01(
+                                self.seed, conn, direction, "delay",
+                                ford) < self.delay_prob:
+                            delay_ms += self.delay_ms
+                            fault_delay = True
+                    ford += 1
+                    if delay_ms > 0:
+                        if fault_delay:
+                            with self._lock:
+                                self.counters["delays"] += 1
+                        time.sleep(delay_ms / 1000.0)
+                    if kind == "reorder" and stash is None:
+                        stash = frame
+                        stash_t = time.perf_counter()
+                        with self._lock:
+                            self.counters["reorders"] += 1
+                        continue
+                    if stash is not None:
+                        # swapped order: this frame first, then the
+                        # held one => the receiver sees a seq gap,
+                        # detects it, and never consumes either as
+                        # data
+                        self._ship(dst, direction, frame)
+                        self._ship(dst, direction, stash)
+                        stash = None
+                        continue
+                    self._ship(dst, direction, frame,
+                               drip=(kind == "drip"))
+                    if kind == "drip":
+                        with self._lock:
+                            self.counters["drips"] += 1
+                    elif kind == "dup":
+                        self._ship(dst, direction, frame)
+                        with self._lock:
+                            self.counters["dups"] += 1
+        except OSError:
+            pass
+        finally:
+            # one direction down => the connection is done; closing
+            # both sockets pokes the sibling pump out of recv
+            for s in (src, dst):
+                try:
+                    s.close()
+                except OSError:
+                    pass

@@ -176,7 +176,20 @@ class ServeDispatchError(RuntimeError):
     """A fused dispatch failed after exhausting `max_retries` retries
     (and, for the isolated requests of a bisected group, failed alone
     too). Wraps the final underlying error; the per-request future
-    re-raises this."""
+    re-raises this.
+
+    Taxonomy note (ISSUE 13/18): the proc/tcp transport's
+    `fleet_proc.ProcTransportError` subclasses this, so a dead worker,
+    a missed IPC deadline, or a corrupt frame stream rides the same
+    failover path as a local dispatch failure. Frame-level verdicts
+    stay on the transport side — `FrameCorruptError` (bad
+    magic/version/length/CRC) and its sequence-check refinements
+    `FrameReplayError` (duplicated/replayed frame) and `FrameGapError`
+    (frames missing/reordered) fail the CONNECTION, and only then
+    surface per-request as `ProcTransportError`. During a TCP
+    reconnect window the replica sheds with `ServeOverloadError`
+    (retry_after_ms) instead: the worker may be coming back, so
+    callers back off rather than fail over."""
 
 
 class ServePoisonedError(ServeDispatchError):

@@ -39,6 +39,7 @@ executable caches keeping their entries).
 """
 from __future__ import annotations
 
+import bisect
 import itertools
 import json
 import os
@@ -72,6 +73,7 @@ __all__ = [
     "current_trace",
     "current_span_id",
     "drain_shipped",
+    "OffsetEstimator",
     "MetricsLogger",
     "read_metrics",
     "default_metrics_path",
@@ -532,6 +534,68 @@ def last_step_timings() -> Optional[Dict]:
     a step span closes with tracing enabled."""
     with _LOCK:
         return dict(_LAST_STEP) if _LAST_STEP else None
+
+
+class OffsetEstimator:
+    """Remote-monotonic-clock offset from request/reply round trips
+    (ISSUE 18): `remote perf_counter + offset_us()/1e6 == local
+    perf_counter`, within `uncertainty_us()`.
+
+    Each `add(t_send, t_recv, t_remote)` is one round trip: the local
+    send/receive stamps bracket the remote stamp, so the midpoint
+    minus the remote stamp estimates the offset with error bounded by
+    RTT/2 (classic NTP discipline). Over a real network the error is
+    dominated by QUEUEING, not the path: a frame delayed in ONE
+    direction biases its midpoint by delay/2 but also inflates its
+    RTT — so the estimator keeps only the `k` smallest-RTT samples
+    and reports the MEDIAN of their offsets. Clean round trips sink
+    to the front and injected asymmetric delay is filtered out rather
+    than averaged in; the median guards the case where every sample
+    is jittered. `uncertainty_us()` is the best RTT's half-width —
+    the bound the transport's offset-sanity pin checks against."""
+
+    __slots__ = ("k", "_best")
+
+    def __init__(self, k: int = 5):
+        if k < 1:
+            raise ValueError("k must be >= 1")
+        self.k = int(k)
+        self._best: List[tuple] = []  # (rtt_s, offset_us), rtt-sorted
+
+    def add(self, t_send: float, t_recv: float,
+            t_remote: float) -> None:
+        rtt = float(t_recv) - float(t_send)
+        if rtt < 0.0:
+            return  # caller bug or clock step; never poison the pool
+        off = ((float(t_send) + float(t_recv)) / 2.0
+               - float(t_remote)) * 1e6
+        bisect.insort(self._best, (rtt, off))
+        del self._best[self.k:]
+
+    @property
+    def n(self) -> int:
+        return len(self._best)
+
+    def rtt_s(self) -> Optional[float]:
+        """Smallest RTT seen (seconds); None before any sample."""
+        return self._best[0][0] if self._best else None
+
+    def offset_us(self) -> Optional[float]:
+        """Median offset over the k smallest-RTT samples (µs)."""
+        if not self._best:
+            return None
+        offs = sorted(o for _, o in self._best)
+        m = len(offs) // 2
+        if len(offs) % 2:
+            return offs[m]
+        return (offs[m - 1] + offs[m]) / 2.0
+
+    def uncertainty_us(self) -> Optional[float]:
+        """Half the best RTT (µs) — the midpoint estimate's error
+        bound; None before any sample."""
+        if not self._best:
+            return None
+        return self._best[0][0] * 1e6 / 2.0
 
 
 # ---------------------------------------------------------------------------

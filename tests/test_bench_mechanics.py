@@ -1041,6 +1041,62 @@ def test_fleet_stage_proc_transport_wiring(tmp_path, capsys,
     assert "MISMATCH" in capsys.readouterr().out
 
 
+def test_fleet_stage_tcp_net_chaos_wiring(tmp_path, capsys,
+                                          monkeypatch):
+    """ISSUE 18: the fleet stage grows `--transport tcp` +
+    `--net-faults` (listen-mode workers behind a deterministic
+    ChaosProxy; net-fault evidence DISCOVERED from proxy + parent
+    counters) and tools/fold_onchip.py renders the net block —
+    frame-fault rate, partitions, reconnects, replay/gap counts, and
+    a loud OFFSET-INSANE flag. A tcp chaos row WITHOUT the net block
+    (and every older log) renders exactly as before."""
+    src = open(os.path.join(_ROOT, "bench.py")).read()
+    assert '"tcp"' in src and '"--net-faults"' in src
+    assert "net_faults=a.net_faults" in src
+    assert "net_chaos_snapshot" in src, (
+        "net evidence must be discovered from the proxy counters")
+    assert "net_partition" in src, (
+        "the chaos schedule must pin at least one real partition")
+    fold = _load_module("fold_onchip_tcp_test", "tools/fold_onchip.py")
+    logs = tmp_path / "onchip_logs"
+    logs.mkdir()
+    row = {"ok": True, "metric": "fleet_requests_per_sec",
+           "fleet_requests_per_sec": 41.1, "replicas": 2,
+           "transport": "tcp", "p50_ms": 3.4, "p99_ms": 11.2,
+           "replies_match": True, "counters_reconcile": True,
+           "transport_reconcile": True,
+           "chaos": {"availability_pct": 97.5, "p99_ms": 1201.0,
+                     "kills": 2, "failovers": 2, "restarts": 2,
+                     "replies_match": True, "counters_reconcile": True,
+                     "transport_reconcile": True,
+                     "net": {"frame_fault_rate_pct": 7.3,
+                             "partitions": 2, "reconnects": 3,
+                             "replay_frames_detected": 1,
+                             "gap_frames_detected": 1,
+                             "offset_sane": True}}}
+    (logs / "fleet.out").write_text(json.dumps(row) + "\n")
+    monkeypatch.setattr(fold, "LOGS", str(logs))
+    assert fold.main() == 0
+    out = capsys.readouterr().out
+    assert "transport=tcp" in out
+    assert "2 SIGKILLs" in out  # tcp kills are real SIGKILLs too
+    assert "net: 7.3% frames faulted" in out
+    assert "2 partitions" in out and "3 reconnects" in out
+    assert "replay/gap 1/1" in out
+    assert "MISMATCH" not in out and "OFFSET-INSANE" not in out
+    # an insane clock-offset estimate is loud
+    row["chaos"]["net"]["offset_sane"] = False
+    (logs / "fleet.out").write_text(json.dumps(row) + "\n")
+    assert fold.main() == 0
+    assert "OFFSET-INSANE" in capsys.readouterr().out
+    # a tcp chaos row WITHOUT the net block renders the ISSUE 13 way
+    del row["chaos"]["net"]
+    (logs / "fleet.out").write_text(json.dumps(row) + "\n")
+    assert fold.main() == 0
+    out = capsys.readouterr().out
+    assert "net:" not in out and "OFFSET-INSANE" not in out
+
+
 def test_checked_in_metrics_cache_buckets_match_live_stats():
     """ISSUE 15 satellite (fixture audit): every cache bucket a
     checked-in bench JSONL record carries must exist in the LIVE

@@ -300,3 +300,35 @@ def test_fleet_decode_row_and_readme_section_present():
     assert "1.7x" in readme
     assert "decode0=" in readme
     assert "fleet-decode" in readme
+
+
+def test_tcp_transport_row_and_readme_section_present():
+    """ISSUE 18 doc contract: the P26 multi-host TCP transport row
+    and the README "Multi-host fleet" section exist (the three
+    transport modes, the remote launch recipe with the
+    `--verify-store` boot gate, generation fencing, the net-chaos
+    kinds, and the knob table)."""
+    cov = open(os.path.join(_ROOT, "COVERAGE.md")).read()
+    assert "| P26 |" in cov
+    assert "generation fence" in cov
+    assert "FrameReplayError" in cov
+    assert "FrameGapError" in cov
+    assert "singa_tpu/netchaos.py" in cov
+    assert "reconnect_window_s" in cov
+    assert "max_frame_bytes" in cov
+    assert "tests/test_netchaos.py" in cov
+    assert "tests/test_fleet_tcp.py" in cov
+    assert "--net-faults" in cov
+    readme = open(os.path.join(_ROOT, "README.md")).read()
+    assert "## Multi-host fleet" in readme
+    assert "-m singa_tpu.fleet_worker" in readme
+    assert "--connect" in readme
+    assert "--verify-store" in readme
+    assert "generation fence" in readme
+    assert "FrameReplayError" in readme
+    assert "FrameGapError" in readme
+    assert "net_partition" in readme
+    assert "reconnect_window_s" in readme
+    assert "max_frame_bytes" in readme
+    assert "ChaosProxy" in readme
+    assert "--net-faults" in readme

@@ -1,0 +1,236 @@
+"""Multi-host TCP fleet transport (ISSUE 18): generation fencing,
+reconnect-with-resume, and the net-chaos path — `ProcReplica` in
+`listen` mode. Hermetic by construction: ephemeral loopback ports
+only, and the protocol pins drive the parent with a FAKE worker (raw
+sockets, no engine) so they cost milliseconds.
+
+Acceptance pins here:
+  - a stale-generation reconnect is PROVABLY refused: a HELLO
+    carrying yesterday's fence gets a FENCED verdict + a closed
+    connection + a `stale_reconnects_refused` count — it can never
+    resurrect a superseded generation;
+  - a second fresh HELLO while a connection is live is refused, as
+    is a bad auth token — and the in-service connection survives all
+    three refusals untouched;
+  - end to end (ONE real worker over loopback, launched via
+    `python -m singa_tpu.fleet_worker --connect host:port --token`):
+    replies are bit-identical through a ChaosProxy, ACROSS a real
+    partition mid-load (buffered, heals) and across a
+    duplicate-frame attack (detected as `FrameReplayError`, counted,
+    connection torn down, worker redials, SAME generation resumes) —
+    and `fleet.reconcile_transport` is exact at quiescence.
+"""
+import json
+import os
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from singa_tpu import fleet, fleet_proc
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+FEATS, HIDDEN, CLASSES, CBATCH = 8, 16, 4, 8
+
+
+@pytest.fixture(autouse=True)
+def _clean_fleet_config():
+    saved = fleet.get_config()
+    yield
+    fleet._CONFIG.update(saved)
+
+
+def _spec(**over):
+    s = {"factory": "benchmarks.fleet_factory:create",
+         "factory_kwargs": {"feats": FEATS, "hidden": HIDDEN,
+                            "classes": CLASSES,
+                            "compile_batch": CBATCH},
+         "sys_path": [_ROOT],
+         "engine": {"max_batch": CBATCH, "max_wait_ms": 1.0}}
+    s.update(over)
+    return s
+
+
+def _recv_one(sock, reader, timeout_s=5.0):
+    sock.settimeout(0.1)
+    deadline = time.perf_counter() + timeout_s
+    while time.perf_counter() < deadline:
+        try:
+            chunk = sock.recv(1 << 16)
+        except socket.timeout:
+            continue
+        if not chunk:
+            raise AssertionError("peer closed before a frame arrived")
+        frames = reader.feed(chunk)
+        if frames:
+            return frames[0]
+    raise AssertionError("no frame within deadline")
+
+
+def _hello(sock, token, fence, need_spec=False, name="fw"):
+    payload = json.dumps({"token": token, "pid": 4242, "name": name,
+                          "fence": fence,
+                          "need_spec": need_spec}).encode("utf-8")
+    sock.sendall(fleet_proc.encode_frame(fleet_proc.HELLO, 0, payload,
+                                         seq=0))
+
+
+# ---------------------------------------------------------------------------
+# Protocol pins: fake worker, no engine, milliseconds
+# ---------------------------------------------------------------------------
+def test_stale_generation_reconnect_is_provably_refused():
+    r = fleet_proc.ProcReplica(
+        "fw", _spec(token="sekrit"), mode="listen", launch="none",
+        spawn_timeout_s=5.0, heartbeat_interval_s=0.1)
+    r._ensure_listener()
+    addr = r.listen_addr()
+    s1 = s2 = s3 = s4 = None
+    try:
+        # fresh adoption: fence None -> WELCOME carrying fence 1
+        s1 = socket.create_connection(addr, timeout=5.0)
+        _hello(s1, "sekrit", fence=None)
+        ftype, _, payload = _recv_one(
+            s1, fleet_proc.FrameReader(check_seq=True))
+        assert ftype == fleet_proc.WELCOME
+        w = json.loads(payload.decode("utf-8"))
+        assert w["fence"] == 1 and w["gen"] == 1
+        assert w["reconnect_window_s"] == pytest.approx(
+            r.reconnect_window_s)
+
+        # stale fence (yesterday's 0): FENCED + closed, counted —
+        # THE acceptance pin: a superseded connection can never
+        # resurrect its generation
+        s2 = socket.create_connection(addr, timeout=5.0)
+        _hello(s2, "sekrit", fence=0)
+        ftype, _, payload = _recv_one(
+            s2, fleet_proc.FrameReader(check_seq=True))
+        assert ftype == fleet_proc.FENCED
+        assert "stale generation fence" in \
+            json.loads(payload.decode("utf-8"))["reason"]
+        s2.settimeout(2.0)
+        assert s2.recv(1) == b""  # parent hung up after the verdict
+
+        # a SECOND fresh HELLO while the real connection is live is
+        # refused too (a hijacker cannot steal the generation)
+        s3 = socket.create_connection(addr, timeout=5.0)
+        _hello(s3, "sekrit", fence=None)
+        ftype, _, payload = _recv_one(
+            s3, fleet_proc.FrameReader(check_seq=True))
+        assert ftype == fleet_proc.FENCED
+
+        # wrong token: refused before any fence logic
+        s4 = socket.create_connection(addr, timeout=5.0)
+        _hello(s4, "wrong-token", fence=None)
+        ftype, _, payload = _recv_one(
+            s4, fleet_proc.FrameReader(check_seq=True))
+        assert ftype == fleet_proc.FENCED
+        assert "token" in json.loads(payload.decode("utf-8"))["reason"]
+
+        snap = r.transport_snapshot()
+        assert snap["stale_reconnects_refused"] == 1
+        assert snap["fence"] == 1
+        assert snap["mode"] == "listen"
+        # the in-service connection survived all three refusals
+        assert r._sock is not None and not r.killed
+    finally:
+        for s in (s1, s2, s3, s4):
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+        time.sleep(0.1)  # let the reader observe the EOF
+        r.stop()
+
+
+# ---------------------------------------------------------------------------
+# End to end: one REAL worker over loopback through a ChaosProxy
+# ---------------------------------------------------------------------------
+def test_tcp_listen_chaos_partition_and_replay_reconnect():
+    from benchmarks import fleet_factory
+
+    ref = fleet_factory.create(
+        feats=FEATS, hidden=HIDDEN, classes=CLASSES,
+        compile_batch=CBATCH, device_index=7)
+    from singa_tpu import tensor
+
+    rs = np.random.RandomState(0)
+    x = (rs.randint(-16, 16, (2, FEATS)) / 8.0).astype(np.float32)
+    dev = ref.param_tensors()[0].device
+    want = np.asarray(ref.forward_graph(
+        tensor.from_numpy(x, device=dev)).data).copy()
+
+    r = fleet_proc.ProcReplica(
+        "tw0", _spec(), mode="listen", heartbeat_interval_s=0.1,
+        spawn_timeout_s=120.0,
+        net_chaos={"seed": 5, "delay_prob": 0.05, "delay_ms": 1.0})
+    try:
+        r.start()
+
+        from singa_tpu import serve
+
+        def submit_ok(deadline_s=60.0):
+            t_end = time.perf_counter() + deadline_s
+            while True:
+                try:
+                    return np.asarray(
+                        r.submit(x).result(deadline_s))
+                except (fleet_proc.ProcTransportError,
+                        serve.ServeOverloadError):
+                    # reconnect-window shed or the teardown race: a
+                    # single replica has no router to fail over to,
+                    # so the caller retries (which is the router's
+                    # policy too) until the window resolves
+                    if time.perf_counter() > t_end:
+                        raise
+                    time.sleep(0.05)
+
+        # bit-identical THROUGH the proxy (per-frame delay draws on)
+        got = submit_ok()
+        assert np.array_equal(got, want)
+
+        # a REAL partition mid-load: the reply is buffered behind the
+        # stall and arrives intact after it heals — never corrupted,
+        # never lost
+        r.net_fault("net_partition", t_s=0.4)
+        t0 = time.perf_counter()
+        got = submit_ok()
+        stalled = time.perf_counter() - t0
+        assert np.array_equal(got, want)
+        assert stalled >= 0.25, \
+            f"partition did not stall the reply ({stalled:.3f}s)"
+        assert r.net_chaos_snapshot()["partitions"] == 1
+
+        # duplicate the worker's next frame: the parent must refuse
+        # it as a REPLAY (typed + counted), tear the connection down,
+        # and re-adopt the SAME generation when the worker redials
+        snap0 = r.transport_snapshot()
+        r.net_fault("net_dup")
+        deadline = time.perf_counter() + 20.0
+        while time.perf_counter() < deadline:
+            snap = r.transport_snapshot()
+            if snap["replay_frames_detected"] > \
+                    snap0["replay_frames_detected"] \
+                    and snap["reconnects"] > snap0["reconnects"]:
+                break
+            time.sleep(0.05)
+        snap = r.transport_snapshot()
+        assert snap["replay_frames_detected"] >= 1
+        assert snap["reconnects"] >= 1
+        assert snap["fence"] == 1, "reconnect must NOT bump the fence"
+        assert snap["stale_reconnects_refused"] == 0
+
+        # still bit-identical after the reconnect
+        got = submit_ok()
+        assert np.array_equal(got, want)
+
+        # exact books at quiescence, replay teardown and all
+        rec = fleet.reconcile_transport([r])
+        assert rec["ok"], rec
+    finally:
+        r.stop()
+    # clean drain: the final generation's handshake arrived (BYE)
+    gens = r.transport_snapshot()["generations"]
+    assert any(g["clean"] for g in gens.values())

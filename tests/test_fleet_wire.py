@@ -73,10 +73,197 @@ def test_corrupt_frame_is_refused_never_delivered():
     # insane claimed length fails closed immediately (no 256 MB wait)
     import struct
 
-    hdr = struct.pack(">2sBBIQI", b"SF", 1, fleet_proc.REP,
-                      2 ** 31, 7, 0)
+    hdr = struct.pack(">2sBBIQII", b"SF", 2, fleet_proc.REP,
+                      2 ** 31, 7, 0, 0)
     with pytest.raises(fleet_proc.FrameCorruptError, match="cap"):
         fleet_proc.FrameReader().feed(hdr)
+    # a v1 (or future-version) header is refused, not misparsed
+    hdr = struct.pack(">2sBBIQII", b"SF", 1, fleet_proc.REP,
+                      0, 7, 0, 0)
+    with pytest.raises(fleet_proc.FrameCorruptError, match="version"):
+        fleet_proc.FrameReader().feed(hdr)
+
+
+def test_max_frame_bytes_knob_bounds_reader_memory():
+    """Satellite (ISSUE 18): a hostile/corrupt length prefix must be
+    refused at the READER under the `max_frame_bytes` knob instead of
+    ballooning RSS while 'waiting' for bytes that never come."""
+    r = fleet_proc.FrameReader(max_frame_bytes=1024)
+    assert r.max_frame_bytes == 1024
+    ok = fleet_proc.encode_frame(fleet_proc.REP, 1, b"x" * 1024)
+    assert r.feed(ok) == [(fleet_proc.REP, 1, b"x" * 1024)]
+    big = fleet_proc.encode_frame(fleet_proc.REP, 2, b"y" * 1025)
+    with pytest.raises(fleet_proc.FrameCorruptError, match="cap"):
+        r.feed(big)
+    # the knob can only tighten the structural sanity bound
+    r2 = fleet_proc.FrameReader(max_frame_bytes=1 << 62)
+    assert r2.max_frame_bytes == fleet_proc._MAX_PAYLOAD
+
+
+def test_seq_replay_and_gap_are_typed_never_data():
+    """Wire v2 (ISSUE 18): per-direction monotonic seq numbers. A
+    duplicated frame is a `FrameReplayError`, a reordered/skipped one
+    a `FrameGapError` — both `FrameCorruptError` subclasses so every
+    fail-closed path (kill, reconnect-window teardown) applies — and
+    in NEITHER case is the offending frame returned as data."""
+    f = [fleet_proc.encode_frame(fleet_proc.HB, i, b"h%d" % i, seq=i)
+         for i in range(4)]
+    # in-order stream decodes exactly
+    r = fleet_proc.FrameReader(check_seq=True)
+    assert [rid for _, rid, _ in r.feed(b"".join(f))] == [0, 1, 2, 3]
+    # duplication => replay, loud
+    r = fleet_proc.FrameReader(check_seq=True)
+    assert len(r.feed(f[0] + f[1])) == 2
+    with pytest.raises(fleet_proc.FrameReplayError):
+        r.feed(f[1])
+    # reorder => the early frame leaves a gap, loud
+    r = fleet_proc.FrameReader(check_seq=True)
+    assert len(r.feed(f[0])) == 1
+    with pytest.raises(fleet_proc.FrameGapError):
+        r.feed(f[2] + f[1])
+    # a seq-blind reader (handshake scanning) ignores the field
+    r = fleet_proc.FrameReader()
+    assert len(r.feed(f[2] + f[0])) == 2
+    assert issubclass(fleet_proc.FrameReplayError,
+                      fleet_proc.FrameCorruptError)
+    assert issubclass(fleet_proc.FrameGapError,
+                      fleet_proc.FrameCorruptError)
+
+
+def test_adversarial_chunking_every_split_boundary():
+    """Satellite (ISSUE 18): property-style — a valid multi-frame
+    stream split at EVERY byte boundary decodes to exactly the same
+    frames; truncation yields exactly the complete prefix (the tail
+    waits, silently-skipped frames don't exist); injected duplication
+    and reordering raise typed errors."""
+    frames = [
+        fleet_proc.encode_frame(fleet_proc.REQ, 10, b"", seq=0),
+        fleet_proc.encode_frame(fleet_proc.REP, 11, b"a" * 37, seq=1),
+        fleet_proc.encode_frame(fleet_proc.HB, 0, b"{}", seq=2),
+        fleet_proc.encode_frame(fleet_proc.TOK, 12, b"\x00\x00\x00\x07",
+                                seq=3),
+    ]
+    stream = b"".join(frames)
+    want = [(t, r, p) for t, r, p in (
+        fleet_proc.FrameReader(check_seq=True).feed(stream))]
+    assert len(want) == 4
+    for cut in range(len(stream) + 1):
+        r = fleet_proc.FrameReader(check_seq=True)
+        out = r.feed(stream[:cut]) + r.feed(stream[cut:])
+        assert out == want, f"split at {cut} changed the decode"
+        assert r.pending_bytes() == 0
+    # truncation at every boundary: exactly the complete frames, the
+    # torn tail pends — never a silent skip, never a phantom frame
+    bounds = []
+    acc = 0
+    for fr in frames:
+        acc += len(fr)
+        bounds.append(acc)
+    for cut in range(len(stream)):
+        r = fleet_proc.FrameReader(check_seq=True)
+        out = r.feed(stream[:cut])
+        n_complete = sum(1 for b in bounds if b <= cut)
+        assert len(out) == n_complete, f"truncation at {cut}"
+        assert out == want[:n_complete]
+        assert r.pending_bytes() == cut - (bounds[n_complete - 1]
+                                           if n_complete else 0)
+    # duplicating any one frame => FrameReplayError, reordering any
+    # adjacent pair => FrameGapError; either way NOTHING past the
+    # fault is delivered as data
+    for i in range(len(frames)):
+        r = fleet_proc.FrameReader(check_seq=True)
+        mutated = frames[:i + 1] + [frames[i]] + frames[i + 1:]
+        with pytest.raises(fleet_proc.FrameReplayError):
+            r.feed(b"".join(mutated))
+    for i in range(len(frames) - 1):
+        r = fleet_proc.FrameReader(check_seq=True)
+        mutated = list(frames)
+        mutated[i], mutated[i + 1] = mutated[i + 1], mutated[i]
+        with pytest.raises(fleet_proc.FrameGapError):
+            r.feed(b"".join(mutated))
+
+
+def test_reader_compaction_amortized_under_slow_drip():
+    """Satellite (ISSUE 18): byte-at-a-time arrival (the net-chaos
+    slow-drip kind) must not re-copy the whole buffer per frame. The
+    consumed prefix is compacted amortized; this pins the observable
+    invariants — the internal buffer never retains the full stream,
+    and a fully-consumed reader is empty."""
+    frames = b"".join(
+        fleet_proc.encode_frame(fleet_proc.HB, i, b"p" * 2048, seq=i)
+        for i in range(96))
+    r = fleet_proc.FrameReader(check_seq=True)
+    got = 0
+    high_water = 0
+    step = 7  # drip in tiny uneven chunks
+    for i in range(0, len(frames), step):
+        got += len(r.feed(frames[i:i + step]))
+        high_water = max(high_water, len(r._buf))
+    assert got == 96
+    assert r.pending_bytes() == 0
+    assert len(r._buf) == 0, "fully-consumed reader must be compacted"
+    # the buffer high-water mark stays near one compaction quantum,
+    # nowhere near the ~200 KB stream
+    assert high_water < 2 * fleet_proc._COMPACT_MIN + 4096, high_water
+
+
+def test_send_frame_partial_write_hardening():
+    """Satellite (ISSUE 18): `send_frame` under a short socket timeout
+    retries short writes on the SAME frame — a stalled receiver (full
+    socket buffer mid-frame) delays the stream but can never tear or
+    interleave it. Two writer threads sharing the lock discipline of
+    `ProcReplica._send` produce a byte stream that decodes exactly."""
+    import socket as socket_mod
+    import threading
+
+    a, b = socket_mod.socketpair()
+    try:
+        # tiny buffers + a short send timeout: sendall would tear here
+        a.setsockopt(socket_mod.SOL_SOCKET, socket_mod.SO_SNDBUF,
+                     4096)
+        a.settimeout(0.02)
+        payloads = [bytes([i]) * 200_000 for i in range(2)]
+        wlock = threading.Lock()
+        seq = [0]
+        errs = []
+
+        def write(i):
+            try:
+                with wlock:
+                    frame = fleet_proc.encode_frame(
+                        fleet_proc.REP, i, payloads[i], seq=seq[0])
+                    fleet_proc.send_frame(a, frame, deadline_s=10.0)
+                    seq[0] += 1
+            except BaseException as e:  # noqa: BLE001
+                errs.append(e)
+
+        ts = [threading.Thread(target=write, args=(i,))
+              for i in range(2)]
+        for t in ts:
+            t.start()
+        # drain slowly AFTER the writers are already stalled mid-frame
+        time.sleep(0.05)
+        reader = fleet_proc.FrameReader(check_seq=True)
+        out = []
+        b.settimeout(2.0)
+        while len(out) < 2:
+            out.extend(reader.feed(b.recv(8192)))
+        for t in ts:
+            t.join(5.0)
+        assert not errs, errs
+        assert sorted(rid for _, rid, _ in out) == [0, 1]
+        for _, rid, payload in out:
+            assert payload == payloads[rid], "frame bytes interleaved"
+        # and a receiver that NEVER drains trips the deadline as a
+        # loud OSError instead of wedging the writer forever
+        with pytest.raises(OSError):
+            fleet_proc.send_frame(
+                a, fleet_proc.encode_frame(fleet_proc.REP, 9,
+                                           b"z" * 400_000, seq=2),
+                deadline_s=0.15)
+    finally:
+        a.close()
+        b.close()
 
 
 def test_flipped_payload_byte_caught_by_crc():

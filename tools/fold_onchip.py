@@ -171,13 +171,28 @@ def main():
                         and c.get("transport_reconcile", True)
                         else " MISMATCH")
                 kills = (f"{c.get('kills', 0)} SIGKILLs"
-                         if r.get("transport") == "proc"
+                         if r.get("transport") in ("proc", "tcp")
                          else f"{c.get('kills', 0)} kills")
                 ch = (f", chaos: {c.get('availability_pct')}% avail, "
                       f"p99 {c.get('p99_ms')} ms, "
                       f"{kills}/"
                       f"{c.get('failovers', 0)} failovers/"
                       f"{c.get('restarts', 0)} restarts{cbad}")
+                # net-fault evidence (ISSUE 18): rendered ONLY when
+                # the record carries the tcp chaos block — every
+                # older log folds byte-identically
+                net = c.get("net")
+                if isinstance(net, dict):
+                    nbad = ("" if net.get("offset_sane", True)
+                            in (True, None) else " OFFSET-INSANE")
+                    ch += (f", net: {net.get('frame_fault_rate_pct')}%"
+                           f" frames faulted, "
+                           f"{net.get('partitions', 0)} partitions, "
+                           f"{net.get('reconnects', 0)} reconnects, "
+                           f"replay/gap "
+                           f"{net.get('replay_frames_detected', 0)}/"
+                           f"{net.get('gap_frames_detected', 0)}"
+                           f"{nbad}")
             # distributed tracing (ISSUE 15): the per-segment latency
             # decomposition + merged-timeline evidence — rendered only
             # when the result carries the new blocks (old logs fold
@@ -230,7 +245,8 @@ def main():
                          f"{r['fleet_decode_tokens_per_sec']:.0f} "
                          f"tok/s  "
                          f"(x{r.get('speedup_vs_single_engine')} vs "
-                         f"1 engine, {r.get('replicas')} proc "
+                         f"1 engine, {r.get('replicas')} "
+                         f"{r.get('transport', 'proc')} "
                          f"replicas, ttft p99 {r.get('ttft_p99_ms')} "
                          f"ms, tpot p99 {r.get('tpot_p99_ms')} ms"
                          f"{mig}{rp}{bad}{ch}"

@@ -207,9 +207,13 @@ for line in sys.stdin:
                     + (" (" + str(x.get("reason", "")) + ")"
                        if x.get("reason") else ""))
     bits.append("[" + census + "]")
+    # net-fault columns (ISSUE 18) render ONLY when the record
+    # carries them (tcp transport + --net-faults); older records
+    # print exactly as before
     for k in ("routed", "failovers", "refused", "rejected",
               "ejections", "rejoins", "restarts", "kills_injected",
-              "pipe_stalls_injected", "torn_frames_injected"):
+              "pipe_stalls_injected", "torn_frames_injected",
+              "net_faults_injected", "net_partitions_injected"):
         if x.get(k):
             bits.append(k + " " + str(x[k]))
     # per-segment latency columns (ISSUE 15): rendered ONLY when the
