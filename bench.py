@@ -1455,7 +1455,8 @@ def stage_serve(requests, deadline_s, rate=0.0, max_batch=64,
     print(json.dumps(out), flush=True)
 
 
-def stage_serve_decode(sessions, deadline_s, rate=0.0, chaos=False):
+def stage_serve_decode(sessions, deadline_s, rate=0.0, chaos=False,
+                       quant="off"):
     """Token-granularity continuous batching over the KV-cached
     decode tier (ISSUE 16): drive `ServingEngine.submit_decode` with a
     seeded Poisson OPEN-LOOP session generator and report
@@ -1486,7 +1487,22 @@ def stage_serve_decode(sessions, deadline_s, rate=0.0, chaos=False):
     `FaultInjector` raising prefill/decode failures and hangs:
     delivered streams must STILL be bit-identical (a retried block
     recomputes from the unchanged slab — never torn, never
-    duplicated), and the reconciliation must still balance."""
+    duplicated), and the reconciliation must still balance.
+
+    `quant="int8"` (ISSUE 19) arms `device.set_inference_quant` before
+    the engine builds: int8 decode params + per-slot-scaled int8 KV
+    slab. generate() stays fp32-only, so the bit-identity reference
+    switches from generate() streams to the quantized engine's OWN
+    first pass — every later pass (and the chaos arm) must reproduce
+    it bit-for-bit. The sequential fp32 generate() baseline is
+    unchanged: the headline ratio is quantized-serve vs fp32
+    sequential, the deployment comparison that matters. The quant arm
+    additionally reports the `hlo_profile.bytes_accessed` byte meter
+    (int8 vs fp32 decode step at the SAME slab geometry; see the
+    meter block below for why it is reported, not gated, here) and
+    both arms report an export/resume migration probe with
+    per-session checkpoint bytes (the int8 slab ships ~4x fewer KV
+    bytes per migration)."""
     import numpy as np
 
     t_stage0 = time.time()
@@ -1507,6 +1523,11 @@ def stage_serve_decode(sessions, deadline_s, rate=0.0, chaos=False):
     x = tensor.from_numpy(np.zeros((1, 4), np.int32), device=dev)
     m.compile([x], is_train=False, use_graph=False)
     m.eval()
+    if quant != "off":
+        # armed BEFORE the engine builds: the slab form freezes at
+        # _build_slab time, and the knob is in knob_fingerprint() so
+        # AOT artifacts can never cross modes
+        device.set_inference_quant(quant)
     rs = np.random.RandomState(0)
     prompts = [rs.randint(0, V, (1, PLENS[i % len(PLENS)]))
                .astype(np.int32) for i in range(sessions)]
@@ -1525,7 +1546,11 @@ def stage_serve_decode(sessions, deadline_s, rate=0.0, chaos=False):
     log(f"calibrated sequential ~{1.0 / per_sess:.0f} sessions/s; "
         f"poisson rate {rate:.0f} sessions/s")
     # the bit-identity reference: the sequential program's exact
-    # streams, computed once (greedy => seed-independent)
+    # streams, computed once (greedy => seed-independent). Under
+    # --quant the fp32 generate() program is NOT the reference (the
+    # quantized tier decodes a different numeric program); the
+    # reference is captured from the quantized engine's own first
+    # warm pass below — self-consistency across every pass.
     want = [np.asarray(m.generate(prompts[i], NEW))
             for i in range(sessions)]
     compile_s = time.time() - t0
@@ -1607,13 +1632,19 @@ def stage_serve_decode(sessions, deadline_s, rate=0.0, chaos=False):
     # two warm passes: the first run through the schedule pays the
     # allocator's first-touch page faults for every slab-sized buffer
     # the steady state recycles (the decode stage's warmup idiom)
-    for _ in range(2):
+    for wi in range(2):
         mk, err = one_pass()
         if mk is None:
             engine.stop()
             mlog.close()
             print(json.dumps({"ok": False, "error": err}), flush=True)
             return
+        if quant != "off" and wi == 1:
+            # quantized reference streams: the engine's own program,
+            # captured once warm — every timed pass must reproduce
+            # these bit-for-bit (the fused-ladder self-consistency
+            # gate the fp32 arm gets from generate())
+            want = [np.asarray(r.result()) for r in err]
     d_warm = stats.decode_stats().snapshot()
 
     device.set_tracing(True, ring_capacity=1 << 15)
@@ -1657,6 +1688,88 @@ def stage_serve_decode(sessions, deadline_s, rate=0.0, chaos=False):
           if isinstance(d1.get(k), (int, float))}
     seg = trace_mod._segment_stats(best_spans)
     steady_s = time.time() - t_steady0
+
+    # -- migration probe: export/resume round-trip + bytes ------------
+    # Both arms ship it: the per-session checkpoint byte count is the
+    # number PR 17 live migration actually moves over the wire, and
+    # the int8 slab packs ~4x fewer KV bytes (ISSUE 19). The resumed
+    # stream must continue bit-identically (KV transplant path).
+    mig = None
+    if time.time() < hard_stop - 20:
+        K = min(4, sessions)
+        a_eng = serve.ServingEngine(m, max_sessions=K,
+                                    max_new_tokens=NEW).start()
+        mreplies = [a_eng.submit_decode(prompts[i], NEW)
+                    for i in range(K)]
+        t_w = time.perf_counter() + 30
+        while (time.perf_counter() < t_w
+               and not all(len(r._stream) >= 2 for r in mreplies)):
+            time.sleep(0.002)
+        ckpts = a_eng.export_decode_sessions()
+        a_eng.stop()
+        per_sess = []
+        for c in ckpts:
+            n = 0
+            for k in ("kv", "kv_scale"):
+                if c.get(k) is not None:
+                    n += np.asarray(c[k]).nbytes
+            per_sess.append(int(n))
+        b_eng = serve.ServingEngine(m, max_sessions=K,
+                                    max_new_tokens=NEW).start()
+        resumed = [b_eng.resume_decode(c) for c in ckpts]
+        mig_match = True
+        for r, c in zip(resumed, ckpts):
+            got = np.asarray(r.result(timeout=60))
+            p = np.asarray(c["prompt"])
+            ref_i = next((j for j in range(K)
+                          if np.array_equal(prompts[j], p)), None)
+            mig_match = mig_match and (
+                ref_i is not None
+                and np.array_equal(got, want[ref_i]))
+        b_eng.stop()
+        mig = {
+            "sessions": len(ckpts),
+            "bytes_per_session": per_sess,
+            "bytes_total": int(sum(per_sess)),
+            "resumed_match": bool(mig_match),
+        }
+        log(f"migration probe: {len(ckpts)} sessions, "
+            f"{sum(per_sess)} ckpt bytes, match={mig_match}")
+
+    # -- byte meter (--quant): int8 vs fp32 decode step ---------------
+    # hlo_profile.bytes_accessed over the OPTIMIZED decode-step HLO at
+    # the same slab geometry. REPORTED, not gated: this stage's
+    # geometry is deliberately weight-bound (params dominate a step),
+    # and on backends without a native int8 GEMM the weight dequant
+    # materializes an fp32 copy — more bytes, honestly reported. The
+    # strict lower-bytes gate lives in tier-1 at the KV-bound serving
+    # geometry (long slab, small heads), where the int8 slab carry
+    # wins outright; the migration probe above shows the other
+    # unconditional win (checkpoint bytes).
+    qbytes = None
+    if quant != "off":
+        import jax.numpy as jnp
+
+        from singa_tpu import hlo_profile
+
+        Dh, Tq = D // H, 16
+        tokq = jnp.zeros((MAXS,), jnp.int32)
+        posq = jnp.zeros((MAXS,), jnp.int32)
+        cache_fp = [jnp.zeros((2, MAXS, H, Tq, Dh), jnp.float32)
+                    for _ in range(L)]
+        cache_q = [(jnp.zeros((2, MAXS, H, Tq, Dh), jnp.int8),
+                    jnp.zeros((2, MAXS, Tq), jnp.float32))
+                   for _ in range(L)]
+        b_fp = hlo_profile.bytes_accessed(m.decode_step_hlo(
+            m._decode_params(), cache_fp, tokq, posq))["total"]
+        b_q = hlo_profile.bytes_accessed(m.decode_step_hlo(
+            m._decode_params_quant(), cache_q, tokq, posq))["total"]
+        qbytes = {"fp32": int(b_fp), "int8": int(b_q),
+                  "ratio": round(b_q / b_fp, 4) if b_fp else None,
+                  "strictly_lower": bool(b_q < b_fp)}
+        log(f"byte meter: int8 {b_q:.3e} vs fp32 {b_fp:.3e} "
+            f"({qbytes['ratio']}x, strictly_lower="
+            f"{qbytes['strictly_lower']})")
 
     # -- injected-fault arm (--chaos): same schedule ------------------
     chaos_out = None
@@ -1776,10 +1889,15 @@ def stage_serve_decode(sessions, deadline_s, rate=0.0, chaos=False):
         "slots": MAXS,
         "decode_block": BLOCK,
         "warmed_executables": warmed,
+        "quant": quant,
         "stage_seconds": stage_secs,
         "export_cache": export_info,
         "metrics_jsonl": os.path.relpath(mpath, HERE),
     }
+    if mig is not None:
+        out["migration"] = mig
+    if qbytes is not None:
+        out["decode_step_bytes"] = qbytes
     if chaos_out is not None:
         out["chaos"] = chaos_out
     log(f"RESULT {out}")
@@ -2306,7 +2424,7 @@ def stage_fleet(requests, deadline_s, rate=0.0, replicas=3,
 
 
 def stage_fleet_decode(sessions, deadline_s, replicas=2, chaos=False,
-                       transport="proc"):
+                       transport="proc", quant="off"):
     """Fleet-wide KV-cached decode serving (ISSUE 17): drive
     `fleet.FleetRouter.submit_decode` over N REAL worker subprocesses
     (`fleet_proc.ProcReplica`) with a seeded compound-Poisson session
@@ -2342,7 +2460,17 @@ def stage_fleet_decode(sessions, deadline_s, replicas=2, chaos=False,
     processes mid-generation: delivered streams must STILL be
     bit-identical (a replayed session re-prefills from its delivered
     ledger — never torn, never duplicated) and the books must still
-    balance."""
+    balance.
+
+    `quant="int8"` (ISSUE 19) arms the knob locally (baseline engine
+    + oracle) AND ships it in every worker spec — the whole fleet
+    must share one mode, or a migrated int8 slab would land on an
+    fp32 replica (import_slab_rows refuses that loudly). generate()
+    stays fp32-only, so the oracle streams come from the quantized
+    baseline engine itself, one session at a time (decode
+    bit-identity is batch-composition independent, so the serial
+    stream IS the fleet stream — including across migrations and
+    SIGKILL replays)."""
     import numpy as np
 
     t_stage0 = time.time()
@@ -2379,6 +2507,11 @@ def stage_fleet_decode(sessions, deadline_s, replicas=2, chaos=False,
                         "max_new_tokens": NEW,
                         "samplers": [[0.7, 8]]},
     }
+    if quant != "off":
+        # every replica (and every chaos-arm respawn) arms the knob
+        # BEFORE its engine builds; the local oracle/baseline arms too
+        base_spec["quant"] = quant
+        device.set_inference_quant(quant)
 
     # off-fleet reference model (device_index past every replica's):
     # the bit-identity oracle AND the 1-replica baseline's model
@@ -2396,15 +2529,22 @@ def stage_fleet_decode(sessions, deadline_s, replicas=2, chaos=False,
     setup_s = time.time() - t_stage0
 
     t0 = time.time()
-    for P in sorted(set(PLENS)):
-        ref.generate(np.zeros((1, P), np.int32), NEW)
-    want = [np.asarray(ref.generate(prompts[i], NEW, **cfgs[i]))
-            for i in range(n_sessions)]
+    if quant == "off":
+        for P in sorted(set(PLENS)):
+            ref.generate(np.zeros((1, P), np.int32), NEW)
+        want = [np.asarray(ref.generate(prompts[i], NEW, **cfgs[i]))
+                for i in range(n_sessions)]
 
     # -- calibrate one burst's decode-drain time on the baseline ------
     eng = serve.ServingEngine(ref, max_sessions=M, max_new_tokens=NEW,
                               prefill_batch=M).start()
     eng.warm_decode(sorted(set(PLENS)), NEW, samplers=[(0.7, 8)])
+    if quant != "off":
+        # quantized oracle: the engine's own serial streams (see
+        # docstring) — computed warm, before any timed window opens
+        want = [np.asarray(eng.submit_decode(
+                    prompts[i], NEW, **cfgs[i]).result(timeout=120))
+                for i in range(n_sessions)]
     d_batch = None
     for _ in range(2):
         t_cal = time.perf_counter()
@@ -2722,6 +2862,7 @@ def stage_fleet_decode(sessions, deadline_s, replicas=2, chaos=False,
         "sessions": n_sessions,
         "replicas": replicas,
         "transport": transport,
+        "quant": quant,
         "new_tokens": NEW,
         "slots_per_replica": M,
         "burst_size": burst,
@@ -2857,6 +2998,13 @@ def main():
     p.add_argument("--serve-max-batch", type=int, default=64,
                    help="serve stage: rows per fused dispatch "
                    "(pow2; also the bucket ceiling)")
+    p.add_argument("--quant", choices=["off", "int8"], default="off",
+                   help="serve-decode/fleet-decode stages: arm int8 "
+                        "quantized inference (weights + KV slab) for "
+                        "the decode tier — adds the bytes_accessed "
+                        "meter and switches the bit-identity "
+                        "reference to the quantized engine's own "
+                        "first pass (ISSUE 19)")
     p.add_argument("--chaos", action="store_true",
                    help="serve/serve-decode/fleet stages: add an "
                    "injected-fault "
@@ -2939,13 +3087,14 @@ def main():
         return stage_decode(a.batch, a.prompt, a.new, a.deadline)
     if a.stage == "serve-decode":
         return stage_serve_decode(a.requests, a.deadline, rate=a.rate,
-                                  chaos=a.chaos)
+                                  chaos=a.chaos, quant=a.quant)
     if a.stage == "fleet-decode":
         return stage_fleet_decode(a.requests, a.deadline,
                                   replicas=a.replicas or 2,
                                   chaos=a.chaos,
                                   transport=("tcp" if a.transport ==
-                                             "tcp" else "proc"))
+                                             "tcp" else "proc"),
+                                  quant=a.quant)
     if a.stage == "parity":
         return stage_parity(a.steps, a.deadline)
     if a.stage:

@@ -60,6 +60,9 @@ __all__ = [
     "set_dag_auto_flops_per_op",
     "set_xla_profile",
     "get_xla_profile",
+    # Int8 quantized inference (ISSUE 19): the byte-diet on the
+    # decode/forward path (singa_tpu.quant reads it).
+    "set_inference_quant",
     # Resilience knobs (ISSUE 3): step guard + dynamic loss scaling
     # (singa_tpu.resilience owns the state/counters).
     "set_step_guard",
@@ -455,6 +458,25 @@ def set_bn_stats_dtype(dt) -> None:
     from . import stats
 
     stats.configure(bn_stats_dtype=dt)
+
+
+def set_inference_quant(mode: str) -> None:
+    """Post-training quantization for the INFERENCE stack (ISSUE 19).
+
+    "off" (default): fp32 decode/forward. "int8": decode-tier params
+    become symmetric per-channel int8 with dequant-at-use and fp32
+    accumulation, the serving KV slab becomes int8 payload + separate
+    f32 scale planes, and forward executables stream int8 param
+    payloads (singa_tpu.quant). Training paths ignore the knob;
+    `generate()` stays fp32 — quant covers `decode_step`/`decode_scan`
+    /`prefill_slab` and the ServingEngine forward path. Read at
+    decode-program build time and part of
+    `export_cache.knob_fingerprint()`: flipping it is an AOT-store
+    miss, never a stale load. Serving engines size their slab at
+    `warm_decode()` — arm the knob BEFORE building the engine."""
+    from . import stats
+
+    stats.configure(inference_quant=mode)
 
 
 def set_step_guard(flag: bool) -> None:

@@ -381,6 +381,11 @@ def knob_fingerprint() -> Dict:
         "parallel_plan": _scalarize(_process_plan_fp()),
         "pipeline_microbatches": cfg.get("pipeline_microbatches"),
         "moe_capacity_factor": cfg.get("moe_capacity_factor"),
+        # int8 quantized inference (ISSUE 19): int8 params + packed
+        # KV slab trace a DIFFERENT decode/forward program — flipping
+        # the knob must orphan fp32 artifacts (and vice versa), never
+        # load them stale.
+        "inference_quant": cfg.get("inference_quant", "off"),
     }
 
 

@@ -2009,6 +2009,11 @@ class FleetRouter:
                     "free_slots": int(d.get("free_slots", 0)),
                     "tokens_per_s": float(d.get("tokens_per_s",
                                                 0.0))}
+                # quant mode (ISSUE 19) rides the same surface; key
+                # present only when armed so pre-19 snapshots (and
+                # fp32 fleets) serialize byte-identically
+                if d.get("quant") and d["quant"] != "off":
+                    out[slot.name]["decode"]["quant"] = str(d["quant"])
         return out
 
     def _log_metrics(self, event: str, **extra) -> None:

@@ -800,6 +800,10 @@ class ProcReplica:
       export_cache    store dir (default: the parent's armed store —
                       the populate-once-start-N contract)
       buckets         device.set_shape_buckets kwargs for the worker
+      quant           inference quant mode ("int8") armed at worker
+                      boot BEFORE the engine builds (ISSUE 19) —
+                      every replica of a fleet must share it so
+                      MIGRATE/RESUME KV stays one form
       metrics_path    worker-side serving metrics JSONL (read it back
                       with `trace.read_metrics`; flush-per-record, so
                       a SIGKILLed worker leaves a parseable log)

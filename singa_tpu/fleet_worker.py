@@ -357,6 +357,13 @@ def main(argv=None) -> int:
         device.set_export_cache(spec["export_cache"])
     if spec.get("buckets"):
         device.set_shape_buckets(**spec["buckets"])
+    if spec.get("quant"):
+        # int8 inference (ISSUE 19): armed BEFORE the model/engine
+        # build so the slab, the warmed ladder, and the AOT keys all
+        # agree — every replica of a fleet must share the mode or
+        # MIGRATE frames would cross quant forms (import_slab_rows
+        # refuses loudly and the session demotes to replay)
+        device.set_inference_quant(spec["quant"])
 
     def arm_tracing(ship_capacity=2048, ring_capacity=None):
         """Worker tracer + span ship-back: completed spans carrying a

@@ -844,6 +844,13 @@ class _JitForward:
         model, params, states = self.model, self.params, self.states
 
         def fwd_fn(pvals, svals, key, batch):
+            # int8 forward (ISSUE 19): quantized param leaves ride
+            # the stream as (payload int8, scale f32) pairs —
+            # dequantized once at program entry (fp32 accumulation
+            # downstream). tuple-ness is the dispatch; the pytree
+            # structure change retraces/orphans fp32 programs.
+            pvals = [p[0].astype(p[1].dtype) * p[1]
+                     if isinstance(p, tuple) else p for p in pvals]
             dev = self._device()
             with _bound_model(params, states, dev, pvals, svals, key):
                 args = [None] * nargs
@@ -858,6 +865,33 @@ class _JitForward:
                 return out_arrays, new_s, dev._rng_key
 
         return jax.jit(fwd_fn)
+
+    def _quant_pvals(self, pvals):
+        """Swap eligible param leaves for (payload, scale) pairs when
+        int8 inference is armed (eval mode, single device). Host-side
+        quantization is memoized per param buffer identity — a
+        training step swaps the buffer and invalidates the entry.
+        Small leaves (LN gammas, biases) stay fp32: no byte win, real
+        precision cost."""
+        from . import quant as quant_mod
+
+        if (not quant_mod.enabled() or self.model.training
+                or getattr(self.model, "_mesh", None) is not None):
+            return pvals
+        memo = getattr(self, "_quant_memo", None)
+        if memo is None:
+            memo = self._quant_memo = {}
+        out = []
+        for i, p in enumerate(pvals):
+            if not quant_mod.forward_eligible(p):
+                out.append(p)
+                continue
+            hit = memo.get(i)
+            if hit is None or hit[0] is not p:
+                memo[i] = (p, quant_mod.quantize_forward_leaf(p))
+                quant_mod.stats_counters()["weights_quantized"] += 1
+            out.append(memo[i][1])
+        return out
 
     def _place_inputs(self, pvals, svals, key, batch_arrays):
         """Mesh-mode placement (single-device: identity)."""
@@ -932,7 +966,7 @@ class _JitForward:
             batch_arrays = tuple(batch_arrays)
         dev = self._device()
         pvals, svals, key, batch_arrays = self._place_inputs(
-            [p.data for p in self.params],
+            self._quant_pvals([p.data for p in self.params]),
             [s.data for s in self.states],
             dev._rng_key, batch_arrays,
         )
@@ -987,7 +1021,10 @@ class _JitForward:
                     bucket_info["seq_real"]):
                 bucket_info = None  # on bucket edges: nothing to slice
         try:
-            cache_key = (self.model.training, tensor_pos, statics)
+            from . import quant as _quant_mod
+
+            cache_key = (self.model.training, tensor_pos, statics,
+                         _quant_mod.mode())
             if export_cache.active():
                 # serialized artifacts are shape-specialized: key the
                 # executable cache per abstract batch signature
@@ -999,7 +1036,7 @@ class _JitForward:
             cache_key, fn = None, None
         dev = self._device()
         pvals, svals, key, batch_arrays = self._place_inputs(
-            [p.data for p in self.params],
+            self._quant_pvals([p.data for p in self.params]),
             [s.data for s in self.states],
             dev._rng_key, batch_arrays,
         )

@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from .. import autograd, layer, model, tensor
+from .. import autograd, layer, model, quant as quant_mod, tensor
 
 
 _NORM_CLS = {"layer": layer.LayerNorm, "rms": layer.RMSNorm}
@@ -158,6 +158,59 @@ class TransformerLM(model.Model):
             "head": head,
         }
 
+    def _decode_params_quant(self):
+        """Int8 view of `_decode_params()` (ISSUE 19): linear entries
+        become length-3 (payload, scale, bias) tuples — tuple LENGTH
+        is the dispatch, the `_ln` idiom — and embed/pos/head become
+        (payload, scale) pairs with broadcast-shaped scales. Memoized
+        on the fp32 leaf identities (the `_gen_shard_cache` contract):
+        a training step between decodes invalidates the copy."""
+        import jax
+        import jax.numpy as jnp
+
+        base = self._decode_params()
+        leaf_ids = tuple(id(l) for l in
+                         jax.tree_util.tree_leaves(base))
+        cached = getattr(self, "_quant_params_cache", None)
+        if cached is not None and cached[0] == leaf_ids:
+            return cached[1]
+        qp = quant_mod.quantize_decode_params(base)
+
+        def pair(t):  # device-put payload/scale once, not per step
+            return ((jnp.asarray(t[0]), jnp.asarray(t[1])) + t[2:]
+                    if isinstance(t, tuple) else t)
+
+        qp["embed"] = pair(qp["embed"])
+        qp["pos"] = pair(qp["pos"])
+        qp["head"] = pair(qp["head"])
+        for blk in qp["blocks"]:
+            for k in ("q", "k", "v", "o", "fc1", "fc2"):
+                blk[k] = pair(blk[k])
+        self._quant_params_cache = (leaf_ids, qp)
+        return qp
+
+    @staticmethod
+    def _table(spec, idx):
+        """Embedding-style lookup for either param form: a plain
+        array, or a quantized (payload, scale) pair with per-row
+        scales — gather both planes, dequantize in fp32."""
+        import jax.numpy as jnp
+
+        if isinstance(spec, tuple):
+            q, s = spec
+            return q[idx].astype(s.dtype) * s[idx]
+        return spec[idx]
+
+    @staticmethod
+    def _head_matmul(last, head, prec):
+        import jax.numpy as jnp
+
+        if isinstance(head, tuple):
+            q, s = head
+            return jnp.matmul(last, q.astype(last.dtype),
+                              precision=prec) * s
+        return jnp.matmul(last, head, precision=prec)
+
     @staticmethod
     def _ln(x, spec):
         import jax.numpy as jnp
@@ -185,21 +238,38 @@ class TransformerLM(model.Model):
 
         H = self.blocks._seq[0].attn.num_heads
         B, S = ids.shape
-        maxT = cache.shape[-2]
-        h = params["embed"][ids] + params["pos"][pos0 + jnp.arange(S)]
+        # quantized stacked cache (ISSUE 19): (payload int8
+        # [L,2,B,H,T,D], scale f32 [L,2,B,T]) instead of one fp32
+        # array — tuple-ness is the dispatch, like the _ln specs
+        qcache = isinstance(cache, tuple)
+        if qcache:
+            new_pay, new_sc = cache
+            maxT = new_pay.shape[-2]
+        else:
+            new_cache = cache
+            maxT = cache.shape[-2]
+        h = self._table(params["embed"], ids) \
+            + self._table(params["pos"], pos0 + jnp.arange(S))
         E = h.shape[-1]
         D = E // H
         scale = 1.0 / float(np.sqrt(D))
         # query i (absolute pos0+i) may attend cache slot j <= pos0+i
         mask = (pos0 + jnp.arange(S))[:, None] >= jnp.arange(maxT)[None, :]
         neg = jnp.asarray(jnp.finfo(h.dtype).min / 2, h.dtype)
-        new_cache = cache
 
         prec = tensor.get_matmul_precision()
 
         def lin(x, wb):
-            w, b = wb
-            y = jnp.matmul(x, w, precision=prec)
+            if len(wb) == 3:  # quantized: (payload, scale, bias) —
+                # dequant COMMUTES through the matmul (per-output-
+                # channel scale), so accumulation is fp32 and the
+                # fp32 weight copy is never materialised
+                qw, ws, b = wb
+                y = jnp.matmul(x, qw.astype(x.dtype),
+                               precision=prec) * ws
+            else:
+                w, b = wb
+                y = jnp.matmul(x, w, precision=prec)
             return y if b is None else y + b
 
         for li, blk in enumerate(params["blocks"]):
@@ -211,13 +281,29 @@ class TransformerLM(model.Model):
             q = split(lin(x, blk["q"]))
             kk = split(lin(x, blk["k"]))
             vv = split(lin(x, blk["v"]))
-            new_cache = lax.dynamic_update_slice(
-                new_cache,
-                jnp.stack([kk, vv])[None], (li, 0, 0, 0, pos0, 0))
-            k_all = lax.dynamic_index_in_dim(new_cache, li, 0,
-                                             keepdims=False)[0]
-            v_all = lax.dynamic_index_in_dim(new_cache, li, 0,
-                                             keepdims=False)[1]
+            kv = jnp.stack([kk, vv])
+            if qcache:
+                # per-position scales (reduce over H, D ONLY — the
+                # same extent the S=1 step uses, which is what makes
+                # chunked replay bit-exact against per-step decode)
+                qkv, sc = quant_mod.quantize_kv(kv)
+                new_pay = lax.dynamic_update_slice(
+                    new_pay, qkv[None], (li, 0, 0, 0, pos0, 0))
+                new_sc = lax.dynamic_update_slice(
+                    new_sc, sc[None], (li, 0, 0, pos0))
+                kv_all = quant_mod.dequantize_kv(
+                    lax.dynamic_index_in_dim(new_pay, li, 0,
+                                             keepdims=False),
+                    lax.dynamic_index_in_dim(new_sc, li, 0,
+                                             keepdims=False))
+                k_all, v_all = kv_all[0], kv_all[1]
+            else:
+                new_cache = lax.dynamic_update_slice(
+                    new_cache, kv[None], (li, 0, 0, 0, pos0, 0))
+                k_all = lax.dynamic_index_in_dim(new_cache, li, 0,
+                                                 keepdims=False)[0]
+                v_all = lax.dynamic_index_in_dim(new_cache, li, 0,
+                                                 keepdims=False)[1]
             s = jnp.einsum("bhqd,bhkd->bhqk", q, k_all,
                            precision=prec) * scale
             s = jnp.where(mask[None, None], s, neg)
@@ -240,8 +326,8 @@ class TransformerLM(model.Model):
         else:
             last = lax.dynamic_index_in_dim(h, last_index, 1,
                                             keepdims=False)
-        return (jnp.matmul(last, params["head"], precision=prec),
-                new_cache)
+        return (self._head_matmul(last, params["head"], prec),
+                (new_pay, new_sc) if qcache else new_cache)
 
     def _program_cache(self):
         """`_gen_cache`: the model's compiled decode-program cache —
@@ -363,8 +449,14 @@ class TransformerLM(model.Model):
 
         H = self.blocks._seq[0].attn.num_heads
         B = tok.shape[0]
-        maxT = cache[0].shape[-2]
-        h = params["embed"][tok[:, None]] + params["pos"][pos][:, None]
+        # quantized slab (ISSUE 19): per-layer (payload int8
+        # [2,B,H,T,D], scale f32 [2,B,T]) tuples instead of plain
+        # fp32 arrays — the update copy that dominates the step's
+        # byte traffic shrinks 4x
+        qcache = isinstance(cache[0], tuple)
+        maxT = (cache[0][0] if qcache else cache[0]).shape[-2]
+        h = self._table(params["embed"], tok[:, None]) \
+            + self._table(params["pos"], pos)[:, None]
         E = h.shape[-1]
         D = E // H
         scale = 1.0 / float(np.sqrt(D))
@@ -376,8 +468,14 @@ class TransformerLM(model.Model):
         prec = tensor.get_matmul_precision()
 
         def lin(x, wb):
-            w, b = wb
-            y = jnp.matmul(x, w, precision=prec)
+            if len(wb) == 3:  # (payload, scale, bias): dequant-at-
+                # use, fp32 accumulation — see _stack_step
+                qw, ws, b = wb
+                y = jnp.matmul(x, qw.astype(x.dtype),
+                               precision=prec) * ws
+            else:
+                w, b = wb
+                y = jnp.matmul(x, w, precision=prec)
             return y if b is None else y + b
 
         for li, blk in enumerate(params["blocks"]):
@@ -396,11 +494,31 @@ class TransformerLM(model.Model):
                 return lax.dynamic_update_slice(c_row, kv_row,
                                                 (0, 0, p, 0))
 
-            new_li = jax.vmap(upd, in_axes=(1, 1, 0), out_axes=1)(
-                cache[li], kv, pos)
-            new_cache.append(new_li)
-            k_all = new_li[0]
-            v_all = new_li[1]
+            if qcache:
+                # same per-position quantization as the chunked
+                # prefill form (reduce over H, D) — the replay
+                # bit-exactness lever
+                qkv, sc = quant_mod.quantize_kv(kv)   # sc [2,B,1]
+                payload, scp = cache[li]
+                new_pay = jax.vmap(upd, in_axes=(1, 1, 0),
+                                   out_axes=1)(payload, qkv, pos)
+
+                def upds(s_row, sc_row, p):
+                    # s_row [2,T], sc_row [2,1]: write at slot p
+                    return lax.dynamic_update_slice(s_row, sc_row,
+                                                    (0, p))
+
+                new_sc = jax.vmap(upds, in_axes=(1, 1, 0),
+                                  out_axes=1)(scp, sc, pos)
+                new_cache.append((new_pay, new_sc))
+                kv_all = quant_mod.dequantize_kv(new_pay, new_sc)
+                k_all, v_all = kv_all[0], kv_all[1]
+            else:
+                new_li = jax.vmap(upd, in_axes=(1, 1, 0), out_axes=1)(
+                    cache[li], kv, pos)
+                new_cache.append(new_li)
+                k_all = new_li[0]
+                v_all = new_li[1]
             s = jnp.einsum("bhqd,bhkd->bhqk", q, k_all,
                            precision=prec) * scale
             s = jnp.where(mask[:, None, None], s, neg)
@@ -412,7 +530,7 @@ class TransformerLM(model.Model):
             h = h + lin(jax.nn.gelu(lin(x, blk["fc1"]),
                                     approximate=False), blk["fc2"])
         h = self._ln(h, params["ln_f"])
-        return (jnp.matmul(h[:, -1], params["head"], precision=prec),
+        return (self._head_matmul(h[:, -1], params["head"], prec),
                 new_cache)
 
     def _aot_step(self, kind, jitted, args, extras):
@@ -444,11 +562,8 @@ class TransformerLM(model.Model):
         Compiled once per slab shape — the one warm executable
         continuous batching dispatches every step — and AOT-exported
         through export_cache when the store is armed."""
-        import jax.numpy as jnp
-
         cache_dict = self._program_cache()
-        key_ = ("slot_step", tuple(c.shape for c in cache),
-                jnp.asarray(cache[0]).dtype.name,
+        key_ = ("slot_step", quant_mod.cache_sig(cache),
                 autograd._policy_key())
         fn = cache_dict.get(key_)
         if fn is None:
@@ -459,10 +574,38 @@ class TransformerLM(model.Model):
             args = (params, list(cache), tok, pos)
             fn = self._aot_step(
                 "decode_step", jitted, args,
-                extras={"slab": [list(c.shape) for c in cache],
+                extras={"slab": self._slab_extra(cache),
                         "policy": autograd._policy_key()})
             cache_dict[key_] = fn
         return fn(params, list(cache), tok, pos)
+
+    def decode_step_hlo(self, params, cache, tok, pos,
+                        optimized: bool = True) -> str:
+        """HLO text of the fused decode step at this exact slab
+        geometry — input to `hlo_profile.bytes_accessed`, the byte
+        meter the int8 KV/weight diet is gated on (ISSUE 19): the
+        quantized step must access STRICTLY fewer bytes than the
+        fp32 step at the same geometry, post-XLA-optimization (so a
+        convert that materializes the fp32 copy would fail the gate,
+        not hide inside it)."""
+        import jax
+
+        jitted = jax.jit(lambda p, c, t, po: self._slot_step(p, c, t, po))
+        lowered = jitted.lower(params, list(cache), tok, pos)
+        return (lowered.compile().as_text() if optimized
+                else lowered.as_text())
+
+    @staticmethod
+    def _slab_extra(cache):
+        """Export-key extras fragment for a decode slab: shapes for
+        the plain form, shapes + quant marker for the packed form —
+        an int8 slab artifact must never be loaded for an fp32 slab
+        (or vice versa)."""
+        if quant_mod.is_quant_cache(cache):
+            return {"quant": "int8",
+                    "payload": [list(p.shape) for p, _ in cache],
+                    "scale": [list(s.shape) for _, s in cache]}
+        return [list(c.shape) for c in cache]
 
     def decode_scan(self, params, cache, tok, pos, k):
         """`k` GREEDY fused decode steps in ONE program (`lax.scan`
@@ -481,8 +624,7 @@ class TransformerLM(model.Model):
         import jax.numpy as jnp
 
         cache_dict = self._program_cache()
-        key_ = ("slot_scan", int(k), tuple(c.shape for c in cache),
-                jnp.asarray(cache[0]).dtype.name,
+        key_ = ("slot_scan", int(k), quant_mod.cache_sig(cache),
                 autograd._policy_key())
         fn = cache_dict.get(key_)
         if fn is None:
@@ -503,7 +645,7 @@ class TransformerLM(model.Model):
             args = (params, list(cache), tok, pos)
             fn = self._aot_step(
                 "decode_scan", jitted, args,
-                extras={"slab": [list(c.shape) for c in cache],
+                extras={"slab": self._slab_extra(cache),
                         "block": int(k),
                         "policy": autograd._policy_key()})
             cache_dict[key_] = fn
@@ -557,32 +699,52 @@ class TransformerLM(model.Model):
 
         cache_dict = self._program_cache()
         key_ = ("prefill_slab", ids.shape,
-                tuple(c.shape for c in slab),
-                jnp.asarray(slab[0]).dtype.name,
+                quant_mod.cache_sig(slab),
                 autograd._policy_key())
         fn = cache_dict.get(key_)
         if fn is None:
             import jax
 
             L = len(slab)
-            H = int(slab[0].shape[2])
-            D = int(slab[0].shape[4])
+            qslab = quant_mod.is_quant_cache(slab)
+            c0 = slab[0][0] if qslab else slab[0]
+            H = int(c0.shape[2])
+            D = int(c0.shape[4])
 
-            def pf(p, sl, i, n, s):
-                Bp, Pb = i.shape
-                c1 = jnp.zeros((L, 2, Bp, H, Pb, D), sl[0].dtype)
-                logits, c1 = self._stack_step(p, i, c1, 0,
-                                              last_index=n - 1)
-                new = [sl[li].at[:, s, :, :Pb, :].set(c1[li])
-                       for li in range(L)]
-                return logits, new
+            if qslab:
+                def pf(p, sl, i, n, s):
+                    # fresh Pb-wide QUANTIZED cache in-graph: the
+                    # chunked _stack_step writes the same payload +
+                    # scale planes the per-step chain would (see
+                    # quantize_kv), then both planes scatter into
+                    # the slab rows in one program
+                    Bp, Pb = i.shape
+                    c1 = (jnp.zeros((L, 2, Bp, H, Pb, D), jnp.int8),
+                          jnp.zeros((L, 2, Bp, Pb), jnp.float32))
+                    logits, c1 = self._stack_step(p, i, c1, 0,
+                                                  last_index=n - 1)
+                    pay, sc = c1
+                    new = [(sl[li][0].at[:, s, :, :Pb, :]
+                            .set(pay[li]),
+                            sl[li][1].at[:, s, :Pb].set(sc[li]))
+                           for li in range(L)]
+                    return logits, new
+            else:
+                def pf(p, sl, i, n, s):
+                    Bp, Pb = i.shape
+                    c1 = jnp.zeros((L, 2, Bp, H, Pb, D), sl[0].dtype)
+                    logits, c1 = self._stack_step(p, i, c1, 0,
+                                                  last_index=n - 1)
+                    new = [sl[li].at[:, s, :, :Pb, :].set(c1[li])
+                           for li in range(L)]
+                    return logits, new
 
             jitted = jax.jit(pf)
             args = (params, list(slab), ids, n_real, slots)
             fn = self._aot_step(
                 "prefill_slab", jitted, args,
                 extras={"prompt_bucket": list(ids.shape),
-                        "slab": [list(c.shape) for c in slab],
+                        "slab": self._slab_extra(slab),
                         "policy": autograd._policy_key()})
             cache_dict[key_] = fn
         return fn(params, list(slab), ids, n_real, slots)
@@ -594,7 +756,12 @@ class TransformerLM(model.Model):
         are device arrays, `np.asarray` forces the transfer, and only
         the first `pos` sequence rows are real (the tail past `pos` is
         stale garbage decode would overwrite anyway, so it never
-        crosses the wire)."""
+        crosses the wire). A QUANTIZED slab exports the PACKED form —
+        (payload int8 [L, 2, H, pos, D], scale f32 [L, 2, pos]) — so
+        live migration ships ~4x fewer bytes (ISSUE 19)."""
+        if quant_mod.is_quant_cache(slab):
+            quant_mod.stats_counters()["packed_kv_exports"] += 1
+            return quant_mod.pack_slab_rows(slab, slot, pos)
         return np.stack(
             [np.asarray(c[:, slot, :, :pos, :]) for c in slab])
 
@@ -607,27 +774,54 @@ class TransformerLM(model.Model):
         `prefill_slab` makes the zero padding exact: decode overwrites
         position p before any query attends it. Requires the target
         rung to cover `pos` (serve sizes the rung from the session's
-        own prompt+budget, which migration preserves)."""
+        own prompt+budget, which migration preserves). A QUANTIZED
+        slab takes the PACKED pair `export_slab_rows` produced —
+        (payload, scale) — and transplants both planes; mixing forms
+        (packed rows into an fp32 slab or vice versa) raises."""
         import jax
         import jax.numpy as jnp
 
         L = len(slab)
-        H, Ts, D = (int(slab[0].shape[2]), int(slab[0].shape[3]),
-                    int(slab[0].shape[4]))
-        t = int(rows.shape[3])
-        if rows.shape[0] != L or rows.shape[2] != H \
-                or rows.shape[4] != D or t > Ts:
+        qslab = quant_mod.is_quant_cache(slab)
+        qrows = isinstance(rows, tuple)
+        if qslab != qrows:
             raise ValueError(
-                f"KV rows {tuple(rows.shape)} do not fit slab "
+                f"KV form mismatch: slab is "
+                f"{'int8-packed' if qslab else 'fp32'} but rows are "
+                f"{'int8-packed' if qrows else 'fp32'} — the quant "
+                "mode must match across a migration (it rides the "
+                "fleet spec and knob_fingerprint)")
+        c0 = slab[0][0] if qslab else slab[0]
+        H, Ts, D = (int(c0.shape[2]), int(c0.shape[3]),
+                    int(c0.shape[4]))
+        pay = rows[0] if qslab else rows
+        t = int(pay.shape[3])
+        if pay.shape[0] != L or pay.shape[2] != H \
+                or pay.shape[4] != D or t > Ts:
+            raise ValueError(
+                f"KV rows {tuple(pay.shape)} do not fit slab "
                 f"[L={L}, H={H}, T={Ts}, D={D}]")
         cache_dict = self._program_cache()
-        key_ = ("import_slab", tuple(c.shape for c in slab),
-                jnp.asarray(slab[0]).dtype.name)
+        key_ = ("import_slab", quant_mod.cache_sig(slab))
         fn = cache_dict.get(key_)
         if fn is None:
-            fn = jax.jit(lambda sl, r, s: [
-                sl[li].at[:, s, :, :, :].set(r[li]) for li in range(L)])
+            if qslab:
+                fn = jax.jit(lambda sl, r, s: [
+                    (sl[li][0].at[:, s, :, :, :].set(r[0][li]),
+                     sl[li][1].at[:, s, :].set(r[1][li]))
+                    for li in range(L)])
+            else:
+                fn = jax.jit(lambda sl, r, s: [
+                    sl[li].at[:, s, :, :, :].set(r[li])
+                    for li in range(L)])
             cache_dict[key_] = fn
+        if qslab:
+            sc = rows[1]
+            ppay = np.zeros((L, 2, H, Ts, D), np.int8)
+            ppay[:, :, :, :t, :] = pay
+            psc = np.zeros((L, 2, Ts), np.float32)
+            psc[:, :, :t] = sc
+            return fn(list(slab), (ppay, psc), np.int32(slot))
         dt = np.asarray(slab[0]).dtype
         padded = np.zeros((L, 2, H, Ts, D), dt)
         padded[:, :, :, :t, :] = rows

@@ -113,7 +113,8 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-from . import export_cache, stats as stats_mod, trace as trace_mod
+from . import export_cache, quant as quant_mod, stats as stats_mod, \
+    trace as trace_mod
 
 __all__ = [
     "ServingEngine",
@@ -1003,6 +1004,7 @@ class ServingEngine:
         self._slab = None               # pooled KV cache, built lazily
         self._slab_free: List[int] = []  # free slab row indices
         self._decode_params = None
+        self._decode_quant = quant_mod.mode()  # frozen at slab build
         self._decode_step_idx = 0       # fused-step ordinal (chaos key)
         self._prefill_idx = 0           # admission ordinal (chaos key)
         self._decode_session_idx = 0
@@ -1101,7 +1103,7 @@ class ServingEngine:
             self._decode_live.clear()
             if self._slab is not None:
                 self._slab_free = list(range(
-                    int(self._slab[0].shape[1])))
+                    int(self._slab_dims()[1])))
             self._decode_reserved = 0
         dst = stats_mod.decode_stats()
         for s in waiting + live:
@@ -1433,13 +1435,13 @@ class ServingEngine:
         with self._decode_lock:
             if self._slab is None:
                 geom = self._build_slab(need_t)
-            elif need_t > int(self._slab[0].shape[3]):
+            elif need_t > int(self._slab_dims()[3]):
                 geom = self._grow_slab(need_t)
             else:
                 geom = self._decode_geom()
         params = geom[0]
         model = self.model
-        Sb = int(self._slab[0].shape[1])
+        Sb = int(self._slab_dims()[1])
         warmed = 0
         tok = jnp.zeros(Sb, jnp.int32)
         pos = jnp.zeros(Sb, jnp.int32)
@@ -1525,7 +1527,7 @@ class ServingEngine:
             self._decode_live.clear()
             slab = self._slab
             if slab is not None:
-                self._slab_free = list(range(int(slab[0].shape[1])))
+                self._slab_free = list(range(int(self._slab_dims()[1])))
             self._decode_reserved = 0
             dst.slots_in_use = 0
         out: List[Dict] = []
@@ -1563,6 +1565,12 @@ class ServingEngine:
                 "deadline_ms_left": rem,
                 "kv": kv,
             }
+            if isinstance(kv, tuple):
+                # int8 slab (ISSUE 19): ship the PACKED pair as two
+                # plain numpy leaves — "kv" keeps its shape[3]==pos
+                # accessor (now int8, ~4x fewer bytes on the wire)
+                # and "kv_scale" carries the [L, 2, pos] scale plane
+                ckpt["kv"], ckpt["kv_scale"] = kv[0], kv[1]
             if sess.reply._fail(ServeMigratedError(
                     f"decode session migrated mid-stream "
                     f"({len(toks)} of {sess.n_new} tokens produced); "
@@ -1602,6 +1610,12 @@ class ServingEngine:
         seed = int(np.asarray(ckpt.get("seed", 0)))
         rem = ckpt.get("deadline_ms_left")
         kv = ckpt.get("kv")
+        kv_scale = ckpt.get("kv_scale")
+        if kv is not None and kv_scale is not None:
+            # packed int8 checkpoint: rebuild the (payload, scale)
+            # pair import_slab_rows transplants
+            kv = (np.asarray(kv, np.int8),
+                  np.asarray(kv_scale, np.float32))
         P = int(prompt.shape[1])
         k0 = len(toks)
         if P < 1 or n_new < 1 or k0 > n_new:
@@ -1666,7 +1680,8 @@ class ServingEngine:
                 sess.tok = toks[-1]
                 sess.key = key
                 if kv is not None:
-                    sess.resume_kv = np.asarray(kv)
+                    sess.resume_kv = (kv if isinstance(kv, tuple)
+                                      else np.asarray(kv))
             dst.resumed += 1
             self._dqueue.append(sess)
             need_thread = self._decode_thread is None
@@ -1694,12 +1709,17 @@ class ServingEngine:
             return min(pol.bucket_seq(need_t), cap)
         return min(_pow2_ceil(max(1, int(need_t))), cap)
 
+    def _slab_dims(self):
+        """[2, Sb, H, Tslab, D] geometry of the live slab — works for
+        both the plain fp32 form and the int8 (payload, scale) form
+        (ISSUE 19), so every shape accessor below is quant-agnostic."""
+        return quant_mod.slab_shape(self._slab)
+
     def _decode_geom(self):
         """(params, L, H, D, Sb, Tslab) read off the live slab."""
-        s0 = self._slab[0]
+        s0 = self._slab_dims()
         return (self._decode_params, len(self._slab),
-                int(s0.shape[2]), int(s0.shape[4]),
-                int(s0.shape[1]), int(s0.shape[3]))
+                int(s0[2]), int(s0[4]), int(s0[1]), int(s0[3]))
 
     def _build_slab(self, need_t: int):
         """Allocate the pooled KV cache + the decode-tier executables'
@@ -1714,17 +1734,34 @@ class ServingEngine:
         import jax.numpy as jnp
 
         model = self.model
-        params = model._decode_params()
+        # int8 decode tier (ISSUE 19): the quant mode is FROZEN at
+        # slab build — params, slab form, and every warmed executable
+        # must agree for the session's whole life (a mid-stream flip
+        # would orphan the slab); flip the knob, drain, rebuild.
+        self._decode_quant = (
+            "int8" if quant_mod.enabled()
+            and hasattr(model, "_decode_params_quant") else "off")
+        if self._decode_quant == "int8":
+            params = model._decode_params_quant()
+            embed = params["embed"][0]
+        else:
+            params = model._decode_params()
+            embed = params["embed"]
         L = len(params["blocks"])
         H = model.blocks._seq[0].attn.num_heads
-        D = int(params["embed"].shape[-1]) // H
+        D = int(embed.shape[-1]) // H
         Sb = (self.policy.bucket_batch(self.max_sessions)
               if self.max_sessions <= self.policy.max_batch
               else _pow2_ceil(self.max_sessions))
         Tslab = self._slab_seq_bucket(need_t)
-        self._slab = [jnp.zeros((2, Sb, H, Tslab, D),
-                                params["embed"].dtype)
-                      for _ in range(L)]
+        if self._decode_quant == "int8":
+            self._slab = [(jnp.zeros((2, Sb, H, Tslab, D), jnp.int8),
+                           jnp.zeros((2, Sb, Tslab), jnp.float32))
+                          for _ in range(L)]
+        else:
+            self._slab = [jnp.zeros((2, Sb, H, Tslab, D),
+                                    embed.dtype)
+                          for _ in range(L)]
         self._slab_free = list(range(Sb))
         self._decode_params = params
         return params, L, H, D, Sb, Tslab
@@ -1736,13 +1773,10 @@ class ServingEngine:
         pow2 their remaining tokens still decode bit-identically to
         `generate()` — growth is invisible to in-flight streams.
         Returns the refreshed geometry."""
-        import jax.numpy as jnp
-
-        old_t = int(self._slab[0].shape[3])
+        old_t = int(self._slab_dims()[3])
         new_t = self._slab_seq_bucket(need_t)
         if new_t > old_t:
-            pad = ((0, 0), (0, 0), (0, 0), (0, new_t - old_t), (0, 0))
-            self._slab = [jnp.pad(c, pad) for c in self._slab]
+            self._slab = quant_mod.pad_slab_seq(self._slab, new_t)
         return self._decode_geom()
 
     def _decode_free_slot(self, sess: "_DecodeSession") -> None:
@@ -1886,7 +1920,7 @@ class ServingEngine:
                         int(head.prompt.shape[1]) + head.n_new, Pb_h)
                     if self._slab is None:
                         geom = self._build_slab(need_t)
-                    elif need_t > int(self._slab[0].shape[3]):
+                    elif need_t > int(self._slab_dims()[3]):
                         geom = self._grow_slab(need_t)
                     if not self._slab_free:
                         break
@@ -1932,12 +1966,14 @@ class ServingEngine:
                         or self._dqueue[0].resume_kv is None):
                     break
                 head = self._dqueue[0]
+                rk = head.resume_kv  # packed (payload, scale) or fp32
+                kv_pos = int((rk[0] if isinstance(rk, tuple)
+                              else rk).shape[3])
                 need_t = max(
-                    int(head.prompt.shape[1]) + head.n_new,
-                    int(head.resume_kv.shape[3]))
+                    int(head.prompt.shape[1]) + head.n_new, kv_pos)
                 if self._slab is None:
                     self._build_slab(need_t)
-                elif need_t > int(self._slab[0].shape[3]):
+                elif need_t > int(self._slab_dims()[3]):
                     self._grow_slab(need_t)
                 if not self._slab_free:
                     break
@@ -2042,7 +2078,7 @@ class ServingEngine:
         Bp = len(members)
         Bb = (pol.bucket_batch(Bp) if Bp <= pol.max_batch
               else _pow2_ceil(Bp))
-        n_slots = int(self._slab[0].shape[1])
+        n_slots = int(self._slab_dims()[1])
         ids = np.zeros((Bb, Pb), np.int32)
         nvec = np.ones(Bb, np.int32)
         slotv = np.full(Bb, n_slots, np.int32)  # OOB => dropped
@@ -2173,7 +2209,7 @@ class ServingEngine:
 
         model = self.model
         params = geom[0]
-        Sb = int(self._slab[0].shape[1])
+        Sb = int(self._slab_dims()[1])
         tokv = np.zeros(Sb, np.int32)
         posv = np.zeros(Sb, np.int32)
         for slot, sess in live:
@@ -2265,17 +2301,19 @@ class ServingEngine:
             dst.slots_in_use = nlive
         if self.metrics is not None:
             try:
+                extra = ({"quant": self._decode_quant}
+                         if self._decode_quant != "off" else {})
                 self.metrics.log_step(
                     self._decode_step_idx,
                     examples=len(live) * k,
                     step_s=block_s, tier="decode",
                     sessions=len(live), slots=Sb, block=k,
-                    slab_seq=int(self._slab[0].shape[3]),
+                    slab_seq=int(self._slab_dims()[3]),
                     occupancy=round(len(live) / Sb, 4),
                     queue_depth=qdepth,
                     tokens_streamed=dst.tokens_streamed,
                     completed=dst.completed, expired=dst.expired,
-                    shed=dst.shed, failed=dst.failed)
+                    shed=dst.shed, failed=dst.failed, **extra)
             except Exception:
                 _STATS.errors += 1  # metrics stream closed mid-serve
 
@@ -2766,6 +2804,10 @@ class ServingEngine:
                 "active_sessions": decode_active,
                 "free_slots": decode_free,
                 "tokens_per_s": round(self._decode_tokens_ema, 3),
+                # quant mode (ISSUE 19) rides every heartbeat — the
+                # fleet router can see a replica serving int8 without
+                # extra wire traffic (MIGRATE targets must match)
+                "quant": self._decode_quant,
             },
         }
         with self._health_lock:

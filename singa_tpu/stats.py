@@ -142,6 +142,14 @@ _CONFIG: Dict = {
     # device.set_grad_accum; Model.compile(grad_accum=n) overrides
     # per-model.
     "grad_accum": 1,
+    # Post-training quantization for the INFERENCE stack (ISSUE 19):
+    # "off" = fp32 decode/forward (default), "int8" = symmetric
+    # per-channel int8 weights + per-slot-scaled int8 KV slab with
+    # dequant-at-use / fp32 accumulation (singa_tpu.quant). Read at
+    # decode-program build time and part of the export-cache
+    # fingerprint — flip ⇒ AOT miss, never a stale load. Training
+    # paths ignore it. Setter: device.set_inference_quant.
+    "inference_quant": "off",
 }
 
 _LOSS_SCALING_DEFAULTS = {
@@ -199,6 +207,11 @@ def configure(**kw) -> Dict:
                         "moe_capacity_factor must be None or > 0")
         elif k == "remat_policy":
             v = _normalize_remat_policy(v)
+        elif k == "inference_quant":
+            v = str(v)
+            if v not in ("off", "int8"):
+                raise ValueError(
+                    "inference_quant must be 'off' or 'int8'")
         elif k == "loss_scaling":
             if v is not None:
                 if not isinstance(v, dict):
@@ -290,6 +303,11 @@ def donation_enabled() -> bool:
 def bn_stats_dtype():
     """BN statistics precision floor (None = at-least-fp32)."""
     return _CONFIG["bn_stats_dtype"]
+
+
+def inference_quant() -> str:
+    """Inference quantization mode: "off" or "int8" (see configure)."""
+    return _CONFIG["inference_quant"]
 
 
 def dag_auto_flops_per_op() -> float:

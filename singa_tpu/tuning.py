@@ -124,6 +124,12 @@ KNOBS: Dict[str, tuple] = {
     # (None = the layer/plan setting); higher drops fewer tokens but
     # pads more expert compute.
     "moe_capacity_factor": (None, 1.0, 1.25, 1.5, 2.0),
+    # Int8 quantized inference (ISSUE 19; device.set_inference_quant):
+    # the byte-diet on the decode/forward path — int8 param payloads
+    # + packed KV slab with dequant-at-use. Inference-only (training
+    # steps ignore it); the serving score path + measured records are
+    # how it earns trust (the TVM lesson), not the analytic model.
+    "inference_quant": ("off", "int8"),
     # Pallas kernel block shapes (env-overridable at
     # ops/pallas_kernels import; benchmarks/pallas_tune.py sweeps
     # them). Cost-model-neutral on CPU — they join the search through
@@ -140,7 +146,8 @@ KNOBS: Dict[str, tuple] = {
 # the HLO meter, so configs differing only there share a measurement).
 HLO_KNOBS = ("compute_dtype", "slot_dtype", "bn_stats_dtype",
              "grad_accum", "remat_policy", "mesh_geometry",
-             "pipeline_microbatches", "moe_capacity_factor")
+             "pipeline_microbatches", "moe_capacity_factor",
+             "inference_quant")
 
 # Pallas knob -> the env var pallas_kernels reads at import, and the
 # module global it reads into (apply_config pokes the live module too
@@ -960,6 +967,11 @@ def apply_config(cfg: Dict, optimizer=None, apply_xla: bool = False,
     if cfg["bn_stats_dtype"] is not None:
         device.set_bn_stats_dtype(cfg["bn_stats_dtype"])
         applied["bn_stats_dtype"] = cfg["bn_stats_dtype"]
+    # inference-only knob: forward-safe by construction (training
+    # steps never read it), so it applies in BOTH modes
+    if cfg["inference_quant"] != "off":
+        device.set_inference_quant(cfg["inference_quant"])
+        applied["inference_quant"] = cfg["inference_quant"]
     import sys as _sys
 
     pk = _sys.modules.get("singa_tpu.ops.pallas_kernels")

@@ -517,6 +517,9 @@ def test_tpu_watch_decode_flavor():
                 "tokens_streamed", "completed", "expired", "shed",
                 "failed"):
         assert key in block, f"decode watch block missing {key}"
+    # ISSUE 19: the quant column renders only when the record has it
+    # (pre-19 and fp32 streams render byte-identically)
+    assert 'x.get("quant")' in block
 
 
 def test_fleet_decode_stage_contract_pins():
@@ -645,6 +648,8 @@ def test_tpu_watch_fleet_decode_flavor():
                 "decode_migrations", "decode_replays",
                 "replica_decode", "ttft", "tpot"):
         assert key in block, f"fleet-decode watch block missing {key}"
+    # ISSUE 19: per-replica quant bit renders only when armed
+    assert 'd.get("quant")' in block
     dec_block = sh[dec:dec + 600]
     assert "grep -v fleet" in dec_block, (
         "decode flavor glob must exclude fleet_decode router streams")
@@ -1192,3 +1197,41 @@ def test_fold_onchip_renders_fleet_trace_blocks(tmp_path, capsys,
     assert fold.main() == 0
     out = capsys.readouterr().out
     assert "segs" not in out and "spans" not in out
+
+
+def test_committed_bench_fixtures_stay_one_run():
+    """ISSUE 19 fixture diet: the COMMITTED bench metrics fixtures
+    hold exactly one canonical run each — one writer pid, bounded
+    line count. Tier-1 runs append fresh runs to the working files
+    (the contract tests above do exactly that), so this guard reads
+    the INDEX blob (`git show :path` — falls back to HEAD when the
+    path isn't staged): committing a re-bloated multi-run fixture
+    fails here, a dirty unstaged working copy does not. Seed sizes
+    were 442/723/561 lines of stacked runs; one run is well under
+    250."""
+    fixtures = [
+        "metrics/bench_serve_decode.jsonl",
+        "metrics/bench_fleet_decode_w0.worker.jsonl",
+        "metrics/bench_fleet_decode_w1.worker.jsonl",
+    ]
+    for rel in fixtures:
+        proc = subprocess.run(
+            ["git", "show", f":{rel}"],
+            capture_output=True, text=True, cwd=_ROOT)
+        if proc.returncode != 0:
+            proc = subprocess.run(
+                ["git", "show", f"HEAD:{rel}"],
+                capture_output=True, text=True, cwd=_ROOT)
+        if proc.returncode != 0:
+            pytest.skip("not a git checkout — nothing committed "
+                        "to guard")
+        lines = proc.stdout.splitlines()
+        assert lines, f"{rel}: committed fixture is empty"
+        assert len(lines) <= 250, (
+            f"{rel}: {len(lines)} committed lines — fixture has "
+            f"re-bloated past one canonical run; prune to the last "
+            f"pid's records before committing")
+        pids = {json.loads(ln).get("pid") for ln in lines}
+        assert len(pids) == 1, (
+            f"{rel}: {len(pids)} writer pids in the committed "
+            f"fixture — multiple stacked runs; keep one")

@@ -332,3 +332,32 @@ def test_tcp_transport_row_and_readme_section_present():
     assert "max_frame_bytes" in readme
     assert "ChaosProxy" in readme
     assert "--net-faults" in readme
+
+
+def test_quant_row_and_readme_section_present():
+    """ISSUE 19 doc contract: the P27 quantized-inference row and
+    the README "Quantized inference" section exist (the knob, the
+    calibration recipe, the error taxonomy including the
+    weight-dequant materialization regime, what is and is not
+    bit-exact, the packed migration form, the bench arms)."""
+    cov = open(os.path.join(_ROOT, "COVERAGE.md")).read()
+    assert "| P27 |" in cov
+    assert "singa_tpu/quant.py" in cov
+    assert "set_inference_quant" in cov
+    assert "export_slab_rows" in cov
+    assert "decode_step_hlo" in cov
+    assert "weights_quantized" in cov
+    assert "--quant int8" in cov
+    assert "tests/test_quant.py" in cov
+    assert "tests/test_serve_conformance.py" in cov
+    readme = open(os.path.join(_ROOT, "README.md")).read()
+    assert "## Quantized inference" in readme
+    assert 'set_inference_quant("int8")' in readme
+    assert "knob_fingerprint" in readme
+    assert "quant.calibrate" in readme
+    assert "fp8-ready" in readme
+    assert "What is and is not bit-exact" in readme
+    assert "Error taxonomy" in readme
+    assert "bytes_accessed" in readme
+    assert "--quant int8" in readme
+    assert "--stage fleet-decode --quant int8" in readme

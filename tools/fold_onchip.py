@@ -231,6 +231,10 @@ def main():
                    if r.get("migrations") else "")
             rp = (f", {r['replays']} replays"
                   if r.get("replays") else "")
+            # quant column (ISSUE 19): rendered only when the record
+            # carries an armed mode — old logs fold byte-identically
+            quant = (f", quant={r['quant']}"
+                     if r.get("quant", "off") != "off" else "")
             ch = ""
             if isinstance(r.get("chaos"), dict):
                 c = r["chaos"]
@@ -249,7 +253,7 @@ def main():
                          f"{r.get('transport', 'proc')} "
                          f"replicas, ttft p99 {r.get('ttft_p99_ms')} "
                          f"ms, tpot p99 {r.get('tpot_p99_ms')} ms"
-                         f"{mig}{rp}{bad}{ch}"
+                         f"{mig}{rp}{quant}{bad}{ch}"
                          + _stage_breakdown(r) + ")" + mark))
         elif "serve_requests_per_sec" in r:
             # serving tier (ISSUE 7): throughput + SLO percentiles +
@@ -280,10 +284,33 @@ def main():
             # granularity serving throughput vs sequential generate()
             # + TTFT/TPOT SLOs; loud MISMATCH on a bit-identity or
             # reconciliation break. Old logs (no key) fold unchanged.
+            # int8 arm (ISSUE 19): the quant column, the
+            # bytes_accessed delta, and the migration-bytes probe
+            # render only when the record carries them — every pre-19
+            # (and --quant off) log folds byte-identically. The
+            # PARITY gates fold into the SAME loud MISMATCH: a
+            # quantized run whose streams or migrated continuations
+            # diverged must not fold quietly. The byte ratio is
+            # REPORTED, not gated, here: it is geometry-dependent
+            # (weight-bound steps pay the dequant materialization on
+            # backends without native int8 GEMM) and the strict
+            # lower-bytes gate lives in tier-1 at the KV-bound
+            # serving geometry.
+            qb = r.get("decode_step_bytes")
+            mg = r.get("migration")
             bad = ("" if r.get("streams_match", True)
                    and r.get("counters_reconcile", True)
                    and r.get("tokens_exact", True)
+                   and (not isinstance(mg, dict)
+                        or mg.get("resumed_match", True))
                    else " MISMATCH")
+            quant = (f", quant={r['quant']}"
+                     if r.get("quant", "off") != "off" else "")
+            if isinstance(qb, dict) and qb.get("ratio") is not None:
+                quant += f", bytes {qb['ratio']}x fp32"
+            if isinstance(mg, dict) and mg.get("sessions"):
+                per = mg["bytes_total"] // max(mg["sessions"], 1)
+                quant += f", mig {per} B/sess"
             occ = (f", occ {r['occupancy_mean']}"
                    if "occupancy_mean" in r else "")
             ch = ""
@@ -300,7 +327,8 @@ def main():
                          f"(x{r.get('speedup_vs_sequential')} vs seq, "
                          f"ttft p50 {r.get('ttft_p50_ms')} ms/p99 "
                          f"{r.get('ttft_p99_ms')} ms, tpot p99 "
-                         f"{r.get('tpot_p99_ms')} ms{occ}{bad}{ch}"
+                         f"{r.get('tpot_p99_ms')} ms{occ}{quant}{bad}"
+                         f"{ch}"
                          + _stage_breakdown(r) + ")" + mark))
         elif "pipeline_images_per_sec" in r:
             # multi-axis parallel stage (ISSUE 10): pipeline img/s +

@@ -22,6 +22,12 @@ DESERIALIZE-only — the request path never traces (ISSUE 7 satellite;
     python tools/prewarm.py --onnx model.onnx --max-batch 64 \
         --verify-store
 
+    # int8 quantized serving (ISSUE 19): the quant knob joins
+    # knob_fingerprint(), so quantized executables live under their
+    # OWN keys — prewarm and verify with the mode the fleet will run
+    python tools/prewarm.py --onnx model.onnx --max-batch 64 \
+        --quant int8 --verify-store
+
 `--dir` points at the artifact store (default `.export_cache/`, the
 same default `bench.py` and `SINGA_TPU_EXPORT_CACHE` use). Exit code:
 0 when every bucket is present/built, 1 when `--dry-run` /
@@ -120,6 +126,12 @@ def main(argv=None):
                     "every (model, bucket) artifact key resolves in "
                     "the store; exit 1 listing each miss in full "
                     "(traces nothing, writes nothing)")
+    ap.add_argument("--quant", choices=["off", "int8"], default="off",
+                    help="arm int8 quantized inference before "
+                    "building/verifying: keys carry the knob via "
+                    "knob_fingerprint, so a store provisioned for "
+                    "fp32 does NOT satisfy an int8 fleet (and vice "
+                    "versa)")
     ap.add_argument("--cpu", action="store_true",
                     help="force the XLA CPU backend")
     a = ap.parse_args(argv)
@@ -135,6 +147,8 @@ def main(argv=None):
     from singa_tpu import device, serve
 
     device.set_export_cache(os.path.abspath(a.dir))
+    if a.quant != "off":
+        device.set_inference_quant(a.quant)
     m, spec = _build_model(a)
     rows = serve.prewarm_forward(
         m, spec, max_batch=a.max_batch,

@@ -84,9 +84,13 @@ def probe(path: str, max_age_s: float = 0.0):
     # snapshots have no "decode" key and render byte-identically.
     dec = snap.get("decode")
     if isinstance(dec, dict):
+        # quant mode (ISSUE 19) renders only when armed — "off" and
+        # pre-19 snapshots stay byte-identical
+        q = dec.get("quant")
+        quant = f" quant={q}" if q and q != "off" else ""
         line += (f"  decode[sessions={dec.get('active_sessions', 0)} "
                  f"free_slots={dec.get('free_slots', 0)} "
-                 f"tok/s={dec.get('tokens_per_s', 0.0)}]")
+                 f"tok/s={dec.get('tokens_per_s', 0.0)}{quant}]")
     return _EXIT[state], line
 
 

@@ -154,9 +154,14 @@ for line in sys.stdin:
     rd = x.get("replica_decode") or {}
     for name in sorted(rd):
         d = rd[name] or {}
+        # quant mode (ISSUE 19) rides the same heartbeat block; the
+        # column renders only when a record carries an armed mode, so
+        # pre-19 (and fp32) streams render byte-identically
+        q = d.get("quant")
+        q = " " + str(q) if q and q != "off" else ""
         bits.append(f"{name} {d.get('active_sessions', 0)}a/"
                     f"{d.get('free_slots', 0)}f "
-                    f"{round(d.get('tokens_per_s', 0.0))}tok/s")
+                    f"{round(d.get('tokens_per_s', 0.0))}tok/s{q}")
     segs = x.get("segments") or {}
     for name in ("ttft", "tpot"):
         s = segs.get(name)
@@ -320,6 +325,10 @@ for line in sys.stdin:
     for k in ("completed", "expired", "shed", "failed"):
         if k in x:
             bits.append(k + " " + fmt(x.get(k), 0))
+    # quant column (ISSUE 19): log_step stamps it only when armed,
+    # so pre-19 and fp32 streams render byte-identically
+    if x.get("quant"):
+        bits.append("quant " + str(x["quant"]))
     print("  ".join(bits))
 '
   exit $?
