@@ -2088,20 +2088,37 @@ def stage_fleet(requests, deadline_s, rate=0.0, replicas=3,
     import glob as glob_mod
 
     mpath = os.path.join(HERE, "metrics", "bench_fleet.jsonl")
+    apath = os.path.join(HERE, "metrics", "bench_fleet_alerts.jsonl")
     # this stage OWNS the fleet telemetry files: start them fresh —
     # aggregate_fleet takes max-over-file counters and per-dispatch
     # sums, so a previous run's appended records would silently
     # pollute this run's availability/worker blocks
-    for stale in [mpath] + glob_mod.glob(os.path.join(
+    for stale in [mpath, apath] + glob_mod.glob(os.path.join(
             HERE, "metrics", "bench_fleet_w*.worker.jsonl")):
         try:
             os.remove(stale)
         except OSError:
             pass
     mlog = trace_mod.MetricsLogger(mpath)
+    # Online SLO engine ON (ISSUE 20): the fleet computes its own
+    # quantiles while serving; after the run the sketch p99 is GATED
+    # against the post-hoc sorted-sample p99 from the very same trace
+    # spans — the online path is cross-validated, never trusted.
+    # window_scale shrinks the canonical SRE burn windows (1h/5m,
+    # 3d/6h) to bench seconds; the clean arm writes no alerts file.
+    from singa_tpu import slo as slo_mod
+    SLO_REL_ERR = 0.02
+    # 7e-5 puts the slow-rule short window at ~1.5 s: wide enough
+    # that chaos-arm breaches survive a supervisor stalled in
+    # restarts, narrow enough to resolve inside the 10 s cooldown
+    SLO_WINDOW_SCALE = 7e-5
+    device.set_slo(True, rel_err=SLO_REL_ERR,
+                   window_scale=SLO_WINDOW_SCALE,
+                   spec={"availability": 0.999})
     s0 = stats.cache_stats()
     wspec = dict(base_spec,
-                 metrics_dir=os.path.join(HERE, "metrics"))
+                 metrics_dir=os.path.join(HERE, "metrics"),
+                 slo=slo_mod.config())
     reps = fleet.make_replicas(replicas, wspec,
                                transport=transport,
                                name_prefix="bench_fleet_w")
@@ -2163,6 +2180,45 @@ def stage_fleet(requests, deadline_s, rate=0.0, replicas=3,
     latency_breakdown = {
         k: v for k, v in agg["segments"].items()
         if k in ("queue_wait", "ipc", "dispatch", "reply", "route")}
+    # -- online-SLO cross-validation (ISSUE 20) ------------------------
+    # The fleet-merged sketch (router-local + heartbeat-shipped
+    # worker sketches) against the post-hoc sorted samples from the
+    # merged Chrome trace, segment by segment, under the sketch's OWN
+    # rank convention.  Only segments whose sample counts agree
+    # exactly are gated (span ship-drop under proc transport can thin
+    # the post-hoc side); at least one segment must be gated, and
+    # every gated p99 must sit within 2x the sketch's documented
+    # relative-error bound.
+    posthoc = trace_mod.fleet_segment_samples_ms(chrome_trace=tpath)
+    srep = slo_mod.report() or {"segments": {}}
+    slo_checks = {}
+    for seg, ssnap in sorted(srep["segments"].items()):
+        samp = posthoc.get(seg)
+        if not samp or ssnap["count"] != len(samp):
+            continue
+        post99 = slo_mod.rank_quantile(samp, 0.99)
+        rel = (abs(ssnap["p99_ms"] - post99) / post99
+               if post99 > 0 else 0.0)
+        slo_checks[seg] = {
+            "count": ssnap["count"],
+            "sketch_p99_ms": ssnap["p99_ms"],
+            "posthoc_p99_ms": round(post99, 3),
+            "rel_err": round(rel, 5),
+            "ok": bool(rel <= 2.0 * SLO_REL_ERR),
+        }
+    slo_crosscheck_ok = bool(slo_checks) and all(
+        c["ok"] for c in slo_checks.values())
+    slo_block = {
+        "rel_err": SLO_REL_ERR,
+        "window_scale": SLO_WINDOW_SCALE,
+        "crosscheck": slo_checks,
+        "crosscheck_ok": slo_crosscheck_ok,
+        "collapsed": sum(s["collapsed"]
+                         for s in srep["segments"].values()),
+        "alerts_clean": slo_mod.alert_counts() or {},
+    }
+    log(f"slo crosscheck: {len(slo_checks)} segment(s) gated, "
+        f"ok={slo_crosscheck_ok}")
     device.set_tracing(False)
     steady_s = time.time() - t_steady0
     lat = np.asarray(lats) * 1e3
@@ -2180,6 +2236,16 @@ def stage_fleet(requests, deadline_s, rate=0.0, replicas=3,
             device.set_tracing(True, ring_capacity=1 << 15)
             trace_mod.clear()
         c0 = stats.cache_stats()
+        # re-arm the SLO engine FRESH for the chaos arm (documented
+        # reset semantics of set_slo): chaos alerts must come from
+        # chaos traffic alone, and this arm writes the alerts JSONL
+        # the acceptance pins on — an availability burn-rate alert
+        # and a replica anomaly alert, each walking the exact
+        # pending -> firing -> resolved lifecycle
+        device.set_slo(True, rel_err=SLO_REL_ERR,
+                       window_scale=SLO_WINDOW_SCALE,
+                       spec={"availability": 0.999},
+                       alerts_path=apath)
         engine_inj = {"dispatch_fail": 0.04,
                       "dispatch_hang": 0.02,
                       "poison_request": 0.01,
@@ -2198,6 +2264,7 @@ def stage_fleet(requests, deadline_s, rate=0.0, replicas=3,
                 s["injector"] = {"seed": 3 + i,
                                  "schedule": engine_inj,
                                  "hang_s": 0.002}
+                s["slo"] = slo_mod.config()  # worker-side sketches
                 from singa_tpu.fleet_proc import ProcReplica
 
                 pk = {}
@@ -2284,6 +2351,27 @@ def stage_fleet(requests, deadline_s, rate=0.0, replicas=3,
                                        "arm"}), flush=True)
             return
         cdelivered, cfailed, cmatch, clats, _ = cres
+        # SLO cooldown BEFORE the router stops: alert resolution
+        # needs live supervisor ticks (and, over proc transport, live
+        # heartbeats) — the burn windows drain, the detectors see the
+        # recovery, and every episode closes its
+        # pending -> firing -> resolved lifecycle while the fleet is
+        # still standing to observe it
+        # the supervisor ticks too, but it can be stalled mid-restart
+        # for longer than the short burn window when both replicas die
+        # at once — so the cooldown drives ticks of its own (the
+        # engine is lock-protected; concurrent tickers are fine).
+        # cool_min keeps the loop alive long enough for pending ->
+        # firing to develop before the no-active-alerts early exit
+        cool_deadline = time.time() + 10.0
+        cool_min = time.time() + 1.5
+        while time.time() < cool_deadline:
+            slo_mod.tick()
+            counts = slo_mod.alert_counts() or {}
+            if (time.time() >= cool_min and not counts.get("firing")
+                    and not counts.get("pending")):
+                break
+            time.sleep(0.02)
         crouter.stop()
         if transport == "tcp":
             device.set_tracing(False)
@@ -2315,6 +2403,32 @@ def stage_fleet(requests, deadline_s, rate=0.0, replicas=3,
             "kills": cd["kills_injected"],
             "counters_reconcile": bool(crec["ok"]),
             "seconds": round(time.time() - t_chaos0, 2),
+        }
+        # alert evidence is DISCOVERED from the alerts JSONL, never
+        # trusted from in-memory state: the stream is the contract
+        arecs = []
+        try:
+            with open(apath, "r", encoding="utf-8") as f:
+                arecs = [json.loads(ln) for ln in f if ln.strip()]
+        except OSError:
+            pass
+        eps = {}
+        for r in arecs:
+            eps.setdefault((r["alert"], r["rule"], r["replica"],
+                            r["episode"]), []).append(r["state"])
+        full = {k for k, v in eps.items()
+                if v == ["pending", "firing", "resolved"]}
+        chaos_out["slo_alerts"] = {
+            "alerts_jsonl": os.path.relpath(apath, HERE),
+            "records": len(arecs),
+            "episodes": len(eps),
+            "full_lifecycles": len(full),
+            "availability_fired_resolved": bool(any(
+                k[0] == "availability" for k in full)),
+            "anomaly_fired_resolved": bool(any(
+                k[0].startswith("anomaly:") for k in full)),
+            "anomaly_replicas": sorted({
+                k[2] for k in full if k[0].startswith("anomaly:")}),
         }
         if transport in ("proc", "tcp"):
             chaos_out["transport_reconcile"] = bool(
@@ -2410,6 +2524,7 @@ def stage_fleet(requests, deadline_s, rate=0.0, replicas=3,
         **({"transport_reconcile": bool(rec.get("transport", True))}
            if transport in ("proc", "tcp") else {}),
         "latency_breakdown": latency_breakdown,
+        "slo": slo_block,
         "trace": trace_block,
         "max_batch": max_batch,
         "max_wait_ms": max_wait_ms,
@@ -2419,6 +2534,7 @@ def stage_fleet(requests, deadline_s, rate=0.0, replicas=3,
     }
     if chaos_out is not None:
         out["chaos"] = chaos_out
+    device.set_slo(False)
     log(f"RESULT {out}")
     print(json.dumps(out), flush=True)
 
@@ -2692,9 +2808,20 @@ def stage_fleet_decode(sessions, deadline_s, replicas=2, chaos=False,
         except OSError:
             pass
     mlog = trace_mod.MetricsLogger(mpath)
+    # Online SLO engine ON for the fleet arm only (ISSUE 20): ttft /
+    # tpot sketches are built WORKER-side, ship home on heartbeats and
+    # the shutdown BYE, and the merged fleet sketch is gated against
+    # the post-hoc sorted-sample percentile from the same trace spans.
+    # Armed after the baseline so local-engine sessions don't pollute
+    # the fleet sketches (baseline and fleet share this process).
+    from singa_tpu import slo as slo_mod
+    SLO_REL_ERR = 0.02
+    device.set_slo(True, rel_err=SLO_REL_ERR, window_scale=7e-5,
+                   spec={"availability": 0.999})
     s0 = stats.cache_stats()
     f0 = stats.decode_stats().snapshot()
-    wspec = dict(base_spec, metrics_dir=os.path.join(HERE, "metrics"))
+    wspec = dict(base_spec, metrics_dir=os.path.join(HERE, "metrics"),
+                 slo=slo_mod.config())
     if transport == "engine":
         transport = "proc"  # decode tier is proc/tcp only
     reps = fleet.make_replicas(replicas, wspec, transport=transport,
@@ -2744,6 +2871,40 @@ def stage_fleet_decode(sessions, deadline_s, replicas=2, chaos=False,
                   span_count=agg["span_count"])
     mlog.close()
     seg = agg["segments"]
+    # online-vs-post-hoc cross-validation over the decode SLO
+    # segments: the fleet-merged worker sketches (heartbeat + BYE
+    # shipped) against the sorted cross-process trace samples.  Gated
+    # on exact count parity — a dropped span or a lost final payload
+    # disqualifies the segment rather than shading the comparison
+    posthoc = trace_mod.fleet_segment_samples_ms(chrome_trace=tpath)
+    srep = slo_mod.report() or {"segments": {}}
+    slo_checks = {}
+    for segname in ("ttft", "tpot"):
+        samp = posthoc.get(segname) or []
+        ssnap = srep["segments"].get(segname)
+        if not samp or not ssnap or ssnap["count"] != len(samp):
+            continue
+        post99 = slo_mod.rank_quantile(samp, 0.99)
+        rel = (abs(ssnap["p99_ms"] - post99) / post99
+               if post99 > 0 else 0.0)
+        slo_checks[segname] = {
+            "count": ssnap["count"],
+            "sketch_p99_ms": round(ssnap["p99_ms"], 3),
+            "posthoc_p99_ms": round(post99, 3),
+            "rel_err": round(rel, 5),
+            "ok": bool(rel <= 2.0 * SLO_REL_ERR),
+        }
+    slo_crosscheck_ok = bool(slo_checks) and all(
+        c["ok"] for c in slo_checks.values())
+    slo_block = {
+        "rel_err": SLO_REL_ERR,
+        "crosscheck": slo_checks,
+        "crosscheck_ok": slo_crosscheck_ok,
+        "replicas_reporting": srep.get("replicas", []),
+    }
+    log(f"slo crosscheck (decode): {len(slo_checks)} segment(s) "
+        f"gated, ok={slo_crosscheck_ok}")
+    device.set_slo(False)
     device.set_tracing(False)
     steady_s = time.time() - t_steady0
 
@@ -2889,6 +3050,7 @@ def stage_fleet_decode(sessions, deadline_s, replicas=2, chaos=False,
         "tpot_p99_ms": seg.get("tpot", {}).get("p99_ms"),
         "slo_segments": {k: v for k, v in seg.items()
                          if k in ("ttft", "tpot", "ipc", "route")},
+        "slo": slo_block,
         "counters_reconcile": bool(rec["ok"] and base_rec),
         "transport_reconcile": bool(rec.get("transport", True)),
         "trace": {

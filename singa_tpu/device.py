@@ -880,6 +880,55 @@ def set_fleet(**kw) -> None:
         fleet.configure(**kw)
 
 
+def set_slo(enabled: bool = True, **kw) -> None:
+    """Arm (or disarm) the online SLO engine (`singa_tpu.slo`;
+    ISSUE 20): mergeable streaming quantile sketches over the serving
+    segments (queue_wait/ipc/dispatch/reply/ttft/tpot), multi-window
+    burn-rate alerting over a declarative `SLOSpec`, and per-replica
+    anomaly detection.  `set_slo(True, ...)` builds a FRESH engine —
+    sketches, windows, and alert state start empty (documented reset
+    semantics).  When disabled, every feed site is a strict no-op
+    (zero allocation) and worker heartbeats carry no `slo` key at
+    all.  Keys:
+
+      rel_err            sketch relative-error bound (default 0.02):
+                         any reported quantile is within this
+                         relative distance of the true sample
+                         quantile. Smaller = more buckets used.
+      max_buckets        live-bucket budget per sketch (default 512);
+                         overflow collapses the LOW tail upward,
+                         counted loudly (`collapsed`), never the high
+                         quantiles operators page on.
+      window_scale       multiplies the canonical Google-SRE burn
+                         windows (fast 1h/5m at burn 14.4 => page;
+                         slow 3d/6h at burn 1.0 => ticket) down to
+                         bench timescales. 1.0 = production windows.
+      spec               {"availability": target,
+                          "latency": {segment: {"threshold_ms": ...,
+                                      "target": ...}}} — the SLO
+                         itself. Latency objectives are request-based
+                         (fraction of samples under the threshold).
+      alerts_path        JSONL stream for alert state transitions
+                         (schema-stable records; every transition of
+                         pending -> firing -> resolved is one line).
+      hb_gap_mult /      heartbeat-gap anomaly: breach when the gap
+      hb_gap_min_s       exceeds max(min_s, mult * EWMA baseline).
+      clock_mult /       clock anomaly: |offset_us| beyond the
+      clock_slack_us     transport estimator's own uncertainty_us *
+                         mult + slack.
+      spike_window_s /   counter-rate anomaly: windowed counter delta
+      spike_mult         vs max(per-counter floor, mult * EWMA).
+      anomaly_pending_s/ holds before an anomaly fires / resolves
+      anomaly_resolve_s  (flap suppression).
+
+    Reads: `fleet.FleetRouter.slo_report()` (fleet-merged),
+    `serve` health snapshots gain an `alerts` block, and
+    `cache_stats()["slo"]` counts feeds/ingests/ticks/alerts."""
+    from . import slo
+
+    slo.configure(enabled, **kw)
+
+
 def set_dag_auto_flops_per_op(v: float) -> None:
     """Recorded-backward auto-routing threshold (FLOPs/op): under
     `autograd.set_dag_backward("auto")` (the default), DAGs whose

@@ -101,7 +101,8 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-from . import export_cache, stats as stats_mod, trace as trace_mod
+from . import export_cache, slo as slo_mod, stats as stats_mod, \
+    trace as trace_mod
 from .serve import (
     ServeClosedError,
     ServeDeadlineError,
@@ -906,6 +907,9 @@ class FleetReply:
             _STATS.replies += 1
         else:
             _STATS.failed += 1
+        # ISSUE 20: one availability event per router terminal —
+        # the same ledger the reconciliation equations count
+        slo_mod.observe_outcome(err is None)
 
     def result(self, timeout: Optional[float] = None):
         t_end = (None if timeout is None
@@ -1441,6 +1445,9 @@ class FleetRouter:
                         failover=False)
         except BaseException:
             _STATS.rejected += 1
+            # ISSUE 20: a router refusal is a bad availability event
+            # too — the error budget doesn't care which side said no
+            slo_mod.observe_outcome(False)
             raise
         self._chaos_route(idx, self._slots[name])
         if (self.metrics_every
@@ -1900,7 +1907,38 @@ class FleetRouter:
                     self.events.append((round(time.time(), 3),
                                         "supervisor_error", slot.name,
                                         repr(e)))
+            try:
+                self._slo_tick()
+            except Exception as e:  # same contract as above
+                self.events.append((round(time.time(), 3),
+                                    "supervisor_error", "slo",
+                                    repr(e)))
             self._stop_ev.wait(self.supervise_interval_s)
+
+    def _slo_tick(self) -> None:
+        """ISSUE 20: per-sweep anomaly feed + burn-rate evaluation.
+        Strict no-op while the SLO engine is disarmed.  Every signal
+        here already exists — slot counters the router keeps, the
+        proc transport's heartbeat age and clock estimate — the tick
+        only hands them to the detectors."""
+        if not slo_mod.enabled():
+            return
+        for slot in list(self._slots.values()):
+            probe_fn = getattr(slot.handle, "slo_probe", None)
+            probe = probe_fn() if probe_fn is not None else {}
+            slo_mod.note_replica(
+                slot.name,
+                hb_gap_s=probe.get("hb_gap_s"),
+                clock_offset_us=probe.get("clock_offset_us"),
+                clock_uncertainty_us=probe.get("clock_uncertainty_us"),
+                counters={"refusals": slot.refusals,
+                          "failures": slot.failures,
+                          "restarts": slot.restarts})
+        slo_mod.note_replica(
+            "router", counters={"failovers": _STATS.failovers,
+                                "rejected": _STATS.rejected,
+                                "shed_retries": _STATS.shed_retries})
+        slo_mod.tick()
 
     def _supervise_dead(self, slot: _ReplicaSlot, now: float) -> None:
         if slot.restarts >= self.max_restarts:
@@ -1974,6 +2012,13 @@ class FleetRouter:
             if fn is not None:
                 sources.extend(fn() or [])
         return trace_mod.merge_chrome_traces(path, sources)
+
+    def slo_report(self) -> Optional[Dict]:
+        """Fleet-merged SLO report (ISSUE 20): the router's own
+        sketches exactly merged with every worker's heartbeat-shipped
+        cumulative sketches, plus burn rates and live alert states.
+        None while the SLO engine is disarmed."""
+        return slo_mod.report()
 
     def replica_snapshot(self) -> Dict[str, Dict]:
         out = {}

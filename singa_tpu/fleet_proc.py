@@ -85,6 +85,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from . import export_cache
+from . import slo as slo_mod
 from . import trace as trace_mod
 from .serve import (
     ServeClosedError,
@@ -1919,6 +1920,23 @@ class ProcReplica:
         with self._plock:
             return len(self._pending)
 
+    def slo_probe(self) -> Dict:
+        """Anomaly-detector inputs for the router's SLO tick (ISSUE
+        20): heartbeat age and the current generation's clock offset
+        next to the transport's OWN uncertainty estimate — the
+        detector thresholds on what the estimator admits it doesn't
+        know, not on a magic constant."""
+        out: Dict = {"hb_gap_s": None, "clock_offset_us": None,
+                     "clock_uncertainty_us": None}
+        if self._hb_rx:  # 0.0 == no heartbeat yet: nothing to gap
+            out["hb_gap_s"] = time.perf_counter() - self._hb_rx
+        with self._plock:
+            g = self._gens.get(self._gen)
+            if g is not None and g.clock_offset_us is not None:
+                out["clock_offset_us"] = g.clock_offset_us
+                out["clock_uncertainty_us"] = g.clock.uncertainty_us()
+        return out
+
     def device_token(self):
         """Two workers pinned to one device id would contend for the
         same chip under load — surface it at fleet construction (the
@@ -2092,6 +2110,7 @@ class ProcReplica:
                     trace_mod.record_span(
                         "ipc", ent.t_send, t_recv, trace=ent.trace,
                         replica=self.name)
+                    slo_mod.observe("ipc", t_recv - ent.t_send)
             ent.ack_ev.set()
         elif ftype == REP:
             with self._plock:
@@ -2222,6 +2241,13 @@ class ProcReplica:
             spans = hb.pop("spans", None)
             if spans:
                 self._note_shipped(gen, spans)
+            s_payload = hb.pop("slo", None)
+            if s_payload is not None:
+                # ISSUE 20: cumulative sketch payload, last-writer-
+                # wins keyed by (replica, generation) — a stale
+                # generation's heartbeat can never clobber the
+                # respawn's fresh sketches
+                slo_mod.ingest_wire(self.name, s_payload, gen=gen)
             clock = hb.get("clock")
             if clock and g.clock_wall_us is None:
                 # wall-clock fallback offset (same host, so the wall
@@ -2244,6 +2270,11 @@ class ProcReplica:
             spans = bye.pop("spans", None)
             if spans:
                 self._note_shipped(gen, spans)
+            s_payload = bye.pop("slo", None)
+            if s_payload is not None:
+                # final cumulative state at clean shutdown — nothing
+                # sampled after the last heartbeat is lost
+                slo_mod.ingest_wire(self.name, s_payload, gen=gen)
             g.handshake = bye
             g.clean = True
 

@@ -127,6 +127,7 @@ def main(argv=None) -> int:
 
     from singa_tpu import device, resilience, serve, stats
     from singa_tpu import fleet_proc as wire
+    from singa_tpu import slo as slo_mod
     from singa_tpu import trace as trace_mod
 
     import socket
@@ -379,6 +380,13 @@ def main(argv=None) -> int:
     if tr_spec.get("enabled"):
         arm_tracing(tr_spec.get("ship_capacity", 2048),
                     tr_spec.get("ring_capacity"))
+    slo_spec = spec.get("slo") or {}
+    if slo_spec.get("enabled"):
+        # ISSUE 20: arm the worker's local SLO sketches from the
+        # router's spec so the whole fleet samples under ONE spec;
+        # workers never write alerts (the router holds the merged
+        # view and the alerting state) — alerts_path stays None here
+        slo_mod.configure(**dict(slo_spec, alerts_path=None))
 
     factory = wire.resolve_factory(spec)
     t0 = time.perf_counter()
@@ -459,6 +467,13 @@ def main(argv=None) -> int:
             out["trace"] = {"spans": t["spans"],
                             "shipped": t["shipped"],
                             "ship_dropped": t["ship_dropped"]}
+        # ISSUE 20: cumulative sketch payload — the key exists ONLY
+        # while the SLO engine is armed (byte-absence, PR 15
+        # discipline); cumulative-replace makes ingest idempotent
+        # under heartbeat loss, duplication, and reconnect
+        s_payload = slo_mod.wire_payload()
+        if s_payload is not None:
+            out["slo"] = s_payload
         return out
 
     def send_hb():

@@ -113,8 +113,8 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-from . import export_cache, quant as quant_mod, stats as stats_mod, \
-    trace as trace_mod
+from . import export_cache, quant as quant_mod, slo as slo_mod, \
+    stats as stats_mod, trace as trace_mod
 
 __all__ = [
     "ServingEngine",
@@ -2156,6 +2156,7 @@ class ServingEngine:
             sess.t_last_tok = now
             trace_mod.record_span("ttft", sess.reply.t_submit, now,
                                   trace=sess.trace, prompt=P)
+            slo_mod.observe("ttft", now - sess.reply.t_submit)
             dst.prefills += 1
             dst.joins += 1
             dst.tokens_streamed += 1
@@ -2288,6 +2289,7 @@ class ServingEngine:
                 sess.reply._push_token(tok)
                 trace_mod.record_span("tpot", sess.t_last_tok, now,
                                       trace=sess.trace)
+                slo_mod.observe("tpot", now - sess.t_last_tok)
                 sess.t_last_tok = now
                 dst.tokens_streamed += 1
             sess.tok = seq[-1]
@@ -2502,6 +2504,9 @@ class ServingEngine:
             live.append(r)
             trace_mod.record_span("queue_wait", r.t_enqueue, t_deq,
                                   trace=r.trace, rows=r.n)
+            # ISSUE 20: the online sketch sees EXACTLY the samples
+            # the trace span records — bench cross-validates the two
+            slo_mod.observe("queue_wait", t_deq - r.t_enqueue)
         if not live:
             return
         with self._lock:
@@ -2636,9 +2641,15 @@ class ServingEngine:
             t0 = time.perf_counter()
             with trace_mod.span("dispatch", bucket=n_bucket):
                 out = self.model._ensure_forward_exec()(*tensors)
+            t_r0 = time.perf_counter()
             with trace_mod.span("reply", requests=len(group)):
                 host = self._to_host(out, info)
                 delivered = self._scatter(group, host, rows)
+        if slo_mod.enabled():
+            # ISSUE 20: same segment boundaries as the spans above
+            t_r1 = time.perf_counter()
+            slo_mod.observe("dispatch", t_r0 - t0)
+            slo_mod.observe("reply", t_r1 - t_r0)
         dispatch_s = time.perf_counter() - t0
         self._dispatch_idx += 1
         # Rolling dispatch time (attempt start -> replies out) feeds
@@ -2810,6 +2821,12 @@ class ServingEngine:
                 "quant": self._decode_quant,
             },
         }
+        # ISSUE 20: alert counts ride health ONLY while the SLO
+        # engine is armed — older snapshots (and every disabled run)
+        # stay byte-identical
+        counts = slo_mod.alert_counts()
+        if counts is not None:
+            snap["alerts"] = counts
         with self._health_lock:
             if state != self._health_state:
                 self._health_state = state

@@ -731,7 +731,12 @@ def cache_stats() -> Dict:
 
     Keys (per cache): hits / negative_hits / misses / evictions
     (+ negative/positive split) / retraces / trace_time_s, plus
-    size/capacity/policy for bounded caches. `train_steps` counts
+    size/capacity/policy for bounded caches. Subsystem registrants
+    ship their own counter sets — e.g. the `"slo"` entry (ISSUE 20)
+    carries observed/outcomes/ticks/ingests/ingests_stale/
+    alerts_emitted/collapse_events for the online SLO engine, all
+    zeros-and-disabled when `device.set_slo(False)`. `train_steps`
+    counts
     `Model.train_one_batch` invocations since process start (or the
     last `reset_cache_stats`), so `retraces / train_steps` after
     warmup ≈ 0 is the healthy steady state.

@@ -1015,6 +1015,45 @@ def _segment_stats(spans) -> Dict[str, Dict]:
     return out
 
 
+def _load_chrome_events(chrome_trace: Optional[str]) -> List:
+    """Events from a `merge_chrome_traces` output file (tolerant:
+    unreadable/garbled files contribute nothing, matching
+    `aggregate_fleet`'s behaviour)."""
+    if not chrome_trace:
+        return []
+    try:
+        with open(chrome_trace, "r", encoding="utf-8") as f:
+            data = json.load(f)
+        return list(data.get("traceEvents", [])
+                    if isinstance(data, dict) else data)
+    except (OSError, ValueError):
+        return []
+
+
+def fleet_segment_samples_ms(spans=None,
+                             chrome_trace: Optional[str] = None
+                             ) -> Dict[str, List[float]]:
+    """Raw per-segment latency samples in ms, SORTED ascending — the
+    post-hoc side of the ISSUE 20 online-SLO cross-validation.  Same
+    span selection as `_segment_stats` (names in `FLEET_SEGMENTS`,
+    `dur` present), but returning the samples themselves so a caller
+    can apply the *sketch's* rank convention — ``rank = q*(n-1)``,
+    value = first sample whose cumulative count exceeds ``rank``,
+    i.e. ``sorted[floor(rank)]`` — instead of `np.percentile`'s
+    interpolation, which disagrees at small n by more than the
+    sketch's relative-error bound and would fail the gate spuriously."""
+    all_spans = list(spans or [])
+    all_spans.extend(_load_chrome_events(chrome_trace))
+    out: Dict[str, List[float]] = {}
+    for r in all_spans:
+        name = r.get("name")
+        if name in FLEET_SEGMENTS and r.get("dur") is not None:
+            out.setdefault(name, []).append(float(r["dur"]) / 1e3)
+    for v in out.values():
+        v.sort()
+    return out
+
+
 def aggregate_fleet(paths=None, spans=None,
                     chrome_trace: Optional[str] = None) -> Dict:
     """Roll fleet telemetry into ONE schema-stable record:
@@ -1094,14 +1133,7 @@ def aggregate_fleet(paths=None, spans=None,
                     if isinstance(v, (int, float)):
                         w[k] = max(w[k], int(v))  # cumulative in-stream
     all_spans = list(spans or [])
-    if chrome_trace:
-        try:
-            with open(chrome_trace, "r", encoding="utf-8") as f:
-                data = json.load(f)
-            all_spans.extend(data.get("traceEvents", [])
-                             if isinstance(data, dict) else data)
-        except (OSError, ValueError):
-            pass
+    all_spans.extend(_load_chrome_events(chrome_trace))
     trace_ids = set()
     for r in all_spans:
         t = r.get("trace") or (r.get("args") or {}).get("trace")

@@ -69,6 +69,20 @@ def pytest_collection_modifyitems(config, items):
 
 def pytest_sessionstart(session):
     session.config._t1_t0 = __import__("time").time()
+    session.config._t1_durations = {}
+
+
+_DURATIONS = {}
+
+
+def pytest_runtest_logreport(report):
+    """Accumulate per-test wall clock (setup + call + teardown) so the
+    session-end budget guard can NAME the heavy tests, not just warn
+    that the tier is slow."""
+    d = getattr(report, "duration", None)
+    if d:
+        _DURATIONS[report.nodeid] = _DURATIONS.get(report.nodeid,
+                                                   0.0) + d
 
 
 def pytest_sessionfinish(session, exitstatus):
@@ -85,6 +99,15 @@ def pytest_sessionfinish(session, exitstatus):
     if budget <= 0 or not hasattr(session.config, "_t1_t0"):
         return
     took = time.time() - session.config._t1_t0
+    # name the weight (ISSUE 20 satellite): the 10 slowest tests, so
+    # the session that pushed the tier toward the budget sees WHICH
+    # tests to shed to -m slow without a separate --durations run
+    slowest = sorted(_DURATIONS.items(), key=lambda kv: -kv[1])[:10]
+    if slowest:
+        print(f"\n[t1-budget] {took:.0f}s of {budget:.0f}s budget; "
+              "10 slowest tests:", flush=True)
+        for nodeid, dur in slowest:
+            print(f"  {dur:7.2f}s  {nodeid}", flush=True)
     if took > 0.9 * budget:
         import warnings
 

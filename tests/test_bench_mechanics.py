@@ -942,6 +942,23 @@ def test_fleet_stage_contract_and_acceptance():
     assert c["replies_match"] is True
     assert c["counters_reconcile"] is True
     assert 0.0 < c["availability_pct"] <= 100.0
+    # ISSUE 20: the online SLO engine rode both arms.  Clean arm:
+    # fleet-merged sketch p99s cross-validated against the post-hoc
+    # sorted trace samples (count parity gates each segment).  Chaos
+    # arm: at least one availability burn-rate alert AND one
+    # per-replica anomaly alert walked the EXACT pending -> firing ->
+    # resolved lifecycle, discovered from the alerts JSONL.
+    s = result["slo"]
+    assert s["crosscheck"], "no segments passed count-parity gating"
+    assert s["crosscheck_ok"] is True, s
+    sa = c["slo_alerts"]
+    assert sa["records"] > 0, "chaos arm wrote no alert records"
+    assert sa["full_lifecycles"] >= 1
+    assert sa["availability_fired_resolved"] is True, sa
+    assert sa["anomaly_fired_resolved"] is True, sa
+    assert sa["anomaly_replicas"], sa
+    apath = os.path.join(_ROOT, sa["alerts_jsonl"])
+    assert os.path.exists(apath)
 
 
 def test_fleet_row_rides_the_driver_ramp():
@@ -1235,3 +1252,230 @@ def test_committed_bench_fixtures_stay_one_run():
         assert len(pids) == 1, (
             f"{rel}: {len(pids)} writer pids in the committed "
             f"fixture — multiple stacked runs; keep one")
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 20: SLO tooling satellites — metrics_lint, fold/health/top renders
+# ---------------------------------------------------------------------------
+def test_metrics_lint_committed_fixtures_clean(tmp_path):
+    """tools/metrics_lint.py validates every COMMITTED telemetry
+    fixture against the schema-version registry (the same INDEX-blob
+    read as the fixture-diet guard: a dirty working copy must not
+    flake the lint)."""
+    lint = _load_module("metrics_lint_for_test",
+                        "tools/metrics_lint.py")
+    import subprocess
+    paths = []
+    for rel in ("metrics/bench_serve_decode.jsonl",
+                "metrics/bench_fleet_decode_w0.worker.jsonl",
+                "metrics/bench_fleet_decode_w1.worker.jsonl"):
+        proc = subprocess.run(["git", "show", f":{rel}"],
+                              capture_output=True, text=True,
+                              cwd=_ROOT)
+        if proc.returncode != 0:
+            proc = subprocess.run(["git", "show", f"HEAD:{rel}"],
+                                  capture_output=True, text=True,
+                                  cwd=_ROOT)
+        if proc.returncode != 0:
+            pytest.skip("not a git checkout")
+        p = tmp_path / os.path.basename(rel)
+        p.write_text(proc.stdout)
+        paths.append(str(p))
+    assert lint.main(paths) == 0, "committed fixtures must lint clean"
+
+
+def test_metrics_lint_catches_drift(tmp_path):
+    """The lint is not a rubber stamp: unknown keys (grown without a
+    schema bump), mixed writer vintages, and mid-stream garbage all
+    fail; the at-most-one torn TRAILING line a SIGKILL leaves is
+    tolerated by design, and non-telemetry JSONL is skipped, not
+    failed."""
+    lint = _load_module("metrics_lint_for_test2",
+                        "tools/metrics_lint.py")
+    v2 = {"schema": 2, "time": 1.0, "step": 1, "loss": 0.5,
+          "step_s": 0.1, "data_wait_s": None, "dispatch_s": None,
+          "device_sync_s": None, "examples_per_sec": 10.0,
+          "cache": {}, "resilience": {}, "accum": {}, "metrics": {},
+          "extra": {}, "pid": 1, "mono": 0.5}
+    alert = {"schema": 1, "kind": "slo_alert", "time": 1.0,
+             "mono": 0.5, "alert": "availability", "rule": "fast",
+             "severity": "page", "replica": "-", "state": "pending",
+             "episode": 1, "burn_long": 9.0, "burn_short": 9.0,
+             "value": 9.0, "threshold": 14.4}
+
+    clean = tmp_path / "clean.jsonl"
+    clean.write_text(json.dumps(v2) + "\n" + json.dumps(alert)[:20])
+    issues, n, family = lint.lint_file(str(clean))
+    assert issues == [] and n == 1 and family == "metrics", (
+        "torn trailing line must be tolerated")
+
+    grown = tmp_path / "grown.jsonl"
+    grown.write_text(json.dumps(dict(v2, surprise=1)) + "\n")
+    issues, _, _ = lint.lint_file(str(grown))
+    assert any("surprise" in i and "bump the version" in i
+               for i in issues)
+
+    mixed = tmp_path / "mixed.jsonl"
+    mixed.write_text(json.dumps(v2) + "\n"
+                     + json.dumps(dict(v2, schema=1)) + "\n")
+    issues, _, _ = lint.lint_file(str(mixed))
+    assert any("mixed schema" in i for i in issues)
+
+    torn = tmp_path / "torn.jsonl"
+    torn.write_text('{"garbage\n' + json.dumps(v2) + "\n")
+    issues, _, _ = lint.lint_file(str(torn))
+    assert any("torn mid-stream" in i for i in issues)
+
+    alerts = tmp_path / "alerts.jsonl"
+    alerts.write_text(json.dumps(alert) + "\n")
+    issues, n, family = lint.lint_file(str(alerts))
+    assert issues == [] and family == "alerts"
+    missing = tmp_path / "missing.jsonl"
+    missing.write_text(json.dumps(
+        {k: v for k, v in alert.items() if k != "burn_long"}) + "\n")
+    issues, _, _ = lint.lint_file(str(missing))
+    assert any("missing key" in i and "burn_long" in i
+               for i in issues)
+
+    other = tmp_path / "other.jsonl"
+    other.write_text(json.dumps({"fingerprint": "abc"}) + "\n")
+    issues, n, family = lint.lint_file(str(other))
+    assert issues == [] and family is None  # skipped, not failed
+
+
+def test_fold_onchip_renders_slo_columns(tmp_path, capsys,
+                                         monkeypatch):
+    """ISSUE 20: fold_onchip renders the fleet row's SLO evidence —
+    crosscheck segment count (MISMATCH when the sketch p99 drifted
+    from post-hoc), and the chaos arm's alert-lifecycle counts
+    (MISMATCH when a required alert class never fired+resolved). A
+    pre-20 row without the slo block renders byte-identically."""
+    fold = _load_module("fold_onchip_slo_test",
+                        "tools/fold_onchip.py")
+    logs = tmp_path / "onchip_logs"
+    logs.mkdir()
+    old_row = {"ok": True, "metric": "fleet_requests_per_sec",
+               "fleet_requests_per_sec": 5271.8, "replicas": 3,
+               "p50_ms": 11.5, "p99_ms": 17.1, "failovers": 0,
+               "restarts": 0, "replies_match": True,
+               "counters_reconcile": True}
+    (logs / "fleet.out").write_text(json.dumps(old_row) + "\n")
+    monkeypatch.setattr(fold, "LOGS", str(logs))
+    assert fold.main() == 0
+    base_out = capsys.readouterr().out
+    assert "slo xcheck" not in base_out and "MISMATCH" not in base_out
+
+    row = dict(old_row,
+               slo={"rel_err": 0.02,
+                    "crosscheck": {"reply": {"ok": True},
+                                   "ipc": {"ok": True}},
+                    "crosscheck_ok": True},
+               chaos={"availability_pct": 98.0, "p99_ms": 591.4,
+                      "kills": 2, "failovers": 5, "restarts": 2,
+                      "replies_match": True,
+                      "counters_reconcile": True,
+                      "slo_alerts": {"records": 12,
+                                     "full_lifecycles": 4,
+                                     "availability_fired_resolved":
+                                         True,
+                                     "anomaly_fired_resolved": True}})
+    (logs / "fleet.out").write_text(json.dumps(row) + "\n")
+    assert fold.main() == 0
+    out = capsys.readouterr().out
+    assert "slo xcheck 2 segs" in out
+    assert "alerts 12 rec/4 full" in out
+    assert "MISMATCH" not in out
+    # a drifted sketch OR a missing alert class is loud
+    row["slo"]["crosscheck_ok"] = False
+    (logs / "fleet.out").write_text(json.dumps(row) + "\n")
+    assert fold.main() == 0
+    assert "MISMATCH" in capsys.readouterr().out
+    row["slo"]["crosscheck_ok"] = True
+    row["chaos"]["slo_alerts"]["anomaly_fired_resolved"] = False
+    (logs / "fleet.out").write_text(json.dumps(row) + "\n")
+    assert fold.main() == 0
+    assert "MISMATCH" in capsys.readouterr().out
+
+
+def test_serve_health_folds_alert_severity(tmp_path):
+    """ISSUE 20: a health snapshot carrying the SLO alert-counts
+    block renders `alerts[...]` and the WORST firing severity folds
+    into the exit code (page => 2/unhealthy, ticket => 1/degraded);
+    a snapshot WITHOUT the block renders byte-identically to pre-20
+    (append-only probe contract, same discipline as decode[...])."""
+    import importlib.util
+
+    spec_ = importlib.util.spec_from_file_location(
+        "serve_health_for_slo_test",
+        os.path.join(_ROOT, "tools", "serve_health.py"))
+    sh = importlib.util.module_from_spec(spec_)
+    spec_.loader.exec_module(sh)
+    base = {"state": "ready", "pid": 123, "queue_depth": 0, "shed": 2}
+    old = tmp_path / "old.health.json"
+    old.write_text(json.dumps(base))
+    code_old, line_old = sh.probe(str(old))
+    assert code_old == 0 and "alerts[" not in line_old
+    quiet = tmp_path / "quiet.health.json"
+    quiet.write_text(json.dumps(dict(base, alerts={
+        "pending": 0, "firing": 0, "page": 0, "ticket": 0})))
+    code, line = sh.probe(str(quiet))
+    assert code == 0 and "alerts[firing=0 pending=0]" in line
+    assert line.startswith(line_old)  # append-only
+    ticket = tmp_path / "ticket.health.json"
+    ticket.write_text(json.dumps(dict(base, alerts={
+        "pending": 0, "firing": 1, "page": 0, "ticket": 1})))
+    assert sh.probe(str(ticket))[0] == 1
+    page = tmp_path / "page.health.json"
+    page.write_text(json.dumps(dict(base, alerts={
+        "pending": 1, "firing": 2, "page": 1, "ticket": 1})))
+    assert sh.probe(str(page))[0] == 2
+
+
+def test_fleet_top_alert_panel_and_follow(tmp_path, capsys):
+    """ISSUE 20: fleet_top grows an alert panel (state replayed from
+    the alerts JSONL, active alerts listed firing-first) and a
+    --follow mode; --iterations 1 bounds a follow pass for CI."""
+    ft = _load_module("fleet_top_slo_test", "tools/fleet_top.py")
+    with open(tmp_path / "bench_fleet.jsonl", "w") as f:
+        f.write(json.dumps({"time": 1.0, "step": 1, "extra": {
+            "event": "route", "fleet_requests": 4,
+            "fleet_replies": 4, "routed": 4}}) + "\n")
+    rec = {"schema": 1, "kind": "slo_alert", "time": 1.0, "mono": 0.5,
+           "alert": "availability", "rule": "fast",
+           "severity": "page", "replica": "-", "state": "pending",
+           "episode": 1, "burn_long": 99.0, "burn_short": 99.0,
+           "value": 99.0, "threshold": 14.4}
+    with open(tmp_path / "bench_fleet_alerts.jsonl", "w") as f:
+        f.write(json.dumps(rec) + "\n")
+        f.write(json.dumps(dict(rec, time=2.0, state="firing"))
+                + "\n")
+        f.write(json.dumps(dict(
+            rec, time=2.5, alert="anomaly:hb_gap", rule="-",
+            replica="w1", state="firing")) + "\n")
+    rc = ft.main(["--dir", str(tmp_path), "--follow",
+                  "--iterations", "1"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "alerts: firing 2" in out
+    assert "availability" in out and "anomaly:hb_gap" in out
+    assert "w1" in out
+    # structured counts ride --json for scrapers
+    rc = ft.main(["--dir", str(tmp_path), "--json"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    j = json.loads(out)
+    assert j["alerts"]["firing"] == 2
+    assert j["alerts"]["transitions"] == 3
+
+
+def test_tpu_watch_slo_flavor():
+    """ISSUE 20: tools/tpu_watch.sh grows an `slo` flavor that tails
+    the newest alerts JSONL and renders state transitions."""
+    src = open(os.path.join(_ROOT, "tools", "tpu_watch.sh")).read()
+    slo_i = src.index('"$1" = "slo"')
+    tune_i = src.index('"$1" = "tune"')
+    assert slo_i < tune_i
+    block = src[slo_i:tune_i]
+    for key in ("*alerts*.jsonl", "slo_alert", "pending", "firing",
+                "resolved", "episode"):
+        assert key in block, f"slo watch block missing {key}"

@@ -361,3 +361,33 @@ def test_quant_row_and_readme_section_present():
     assert "bytes_accessed" in readme
     assert "--quant int8" in readme
     assert "--stage fleet-decode --quant int8" in readme
+
+
+def test_slo_row_and_readme_section_present():
+    """ISSUE 20 doc contract: the P28 online-SLO-engine row and the
+    README "SLO monitoring" section exist (mergeable sketches with
+    the bit-identical-merge claim, burn-rate windows + flap
+    suppression, per-replica anomaly detectors, the knob, byte
+    absence when disabled, the bench crosscheck + chaos alert gate,
+    the tools)."""
+    cov = open(os.path.join(_ROOT, "COVERAGE.md")).read()
+    assert "| P28 |" in cov
+    assert "singa_tpu/slo.py" in cov
+    assert "QuantileSketch" in cov
+    assert "set_slo" in cov
+    assert "slo_report" in cov
+    assert "ALERTS_SCHEMA" in cov
+    assert "tools/metrics_lint.py" in cov
+    assert "tests/test_slo.py" in cov
+    readme = open(os.path.join(_ROOT, "README.md")).read()
+    assert "## SLO monitoring" in readme
+    assert "device.set_slo" in readme
+    assert "bit-identical" in readme
+    assert "pending → firing → resolved" in readme
+    assert "flap suppression" in readme
+    assert "note_replica" in readme
+    assert "uncertainty_us" in readme
+    assert "fleet_segment_samples_ms" in readme
+    assert "metrics_lint.py" in readme
+    assert "tpu_watch.sh slo" in readme
+    assert "alerts JSONL" in readme

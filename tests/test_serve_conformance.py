@@ -203,3 +203,51 @@ def test_conformance_full_corpus_serves():
         except Exception as e:  # collect, report all at once
             failures.append(f"{case}: {type(e).__name__}: {e}")
     assert not failures, "\n".join(failures[:20])
+
+
+def test_conformance_cases_serve_through_fleet_router():
+    """ROADMAP 5(b) fleet-path nibble (ISSUE 20 satellite): the
+    conformance corpus has only ever ridden a single ServingEngine;
+    this smoke drives 3 row-separable families through a
+    `FleetRouter` over 2 in-process `EngineReplica`s — routing,
+    per-replica dispatch, and the reply scatter — and still meets
+    the SAME spec-derived goldens under the SAME manifest
+    tolerances. Replies must also agree across replicas: each case
+    is served twice and the two (possibly differently-routed)
+    replies must be bit-identical."""
+    from singa_tpu import fleet
+
+    preferred = [c for c in _serve_corpus()
+                 if MANIFEST[c]["op"] in ("Conv", "Relu", "Add")]
+    cases = (preferred or _subset())[:3]
+    assert len(cases) == 3, cases
+    for case in cases:
+        meta = MANIFEST[case]
+        data = np.load(os.path.join(CORPUS, f"{case}.npz"))
+        inputs = [data[f"in_{i}"] for i in range(meta["n_in"])]
+        expected = data["out_0"]
+        onnx_path = os.path.join(CORPUS, f"{case}.onnx")
+
+        def factory(p=onnx_path):
+            sm = sonnx.SONNXModel(p)
+            sm.eval()
+            return sm
+
+        reps = [fleet.EngineReplica(f"cf{i}", factory,
+                                    {"max_batch": 8,
+                                     "max_wait_ms": 0.5})
+                for i in range(2)]
+        with fleet.FleetRouter(reps) as router:
+            got = np.asarray(router.infer(*inputs, timeout=120))
+            again = np.asarray(router.infer(*inputs, timeout=120))
+        assert got.shape == expected.shape, case
+        np.testing.assert_array_equal(got, again, err_msg=(
+            f"{case}: replies differ across fleet submits"))
+        if np.issubdtype(expected.dtype, np.integer):
+            np.testing.assert_array_equal(got, expected,
+                                          err_msg=case)
+        else:
+            np.testing.assert_allclose(got, expected,
+                                       rtol=meta["rtol"],
+                                       atol=meta["atol"],
+                                       err_msg=case)

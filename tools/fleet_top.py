@@ -18,13 +18,22 @@ fleet` writes — into ONE aggregated view via
     and per-replica KV-slot occupancy (absent fields render as
     before)
 
+An alert panel (ISSUE 20) rides along when SLO alert streams are
+present: every `*alerts*.jsonl` under --dir (or --alerts paths) is
+replayed — last state per (alert, rule, replica) wins — and the
+currently pending/firing alerts render as a table with burn rates.
+
 Usage:
   tools/fleet_top.py [--dir metrics] [--trace metrics/bench_fleet_trace.json]
                      [--files a.jsonl b.jsonl ...] [--events N] [--json]
+                     [--follow] [--interval S] [--iterations N]
 
 With --dir (default ./metrics) every `*fleet*.jsonl` under it joins
 the roll-up; --files names streams explicitly; --json emits the raw
-schema-stable aggregate record instead of the table.
+schema-stable aggregate record instead of the table.  --follow
+re-renders every --interval seconds (--iterations bounds the loop;
+0 = until interrupted), re-reading every stream each pass so a live
+fleet's tail shows up.
 
 Exit codes: 0 = aggregated, 1 = no input records found.
 """
@@ -33,6 +42,7 @@ import glob
 import json
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.abspath(
     os.path.join(os.path.dirname(__file__), "..")))
@@ -40,6 +50,60 @@ sys.path.insert(0, os.path.abspath(
 
 def _fmt(v, suffix=""):
     return "-" if v is None else f"{v}{suffix}"
+
+
+def load_alerts(paths):
+    """Parse SLO alert JSONL streams; a partial trailing line (writer
+    mid-append) is skipped, not fatal."""
+    recs = []
+    for p in paths:
+        try:
+            with open(p, "r", encoding="utf-8") as f:
+                for ln in f:
+                    ln = ln.strip()
+                    if not ln:
+                        continue
+                    try:
+                        rec = json.loads(ln)
+                    except ValueError:
+                        continue
+                    if rec.get("kind") == "slo_alert":
+                        recs.append(rec)
+        except OSError:
+            continue
+    recs.sort(key=lambda r: r.get("time", 0.0))
+    return recs
+
+
+def alert_panel(recs):
+    """Replay transitions; render the CURRENT alert surface (last
+    state per (alert, rule, replica) wins — the stream is an event
+    log, not a state table)."""
+    cur = {}
+    for r in recs:
+        cur[(r.get("alert"), r.get("rule"), r.get("replica"))] = r
+    active = sorted(
+        (r for r in cur.values()
+         if r.get("state") in ("pending", "firing")),
+        key=lambda r: (r["state"] != "firing",
+                       r.get("severity") != "page",
+                       r.get("alert") or ""))
+    firing = sum(1 for r in active if r["state"] == "firing")
+    lines = [f"alerts: firing {firing}  pending "
+             f"{len(active) - firing}  transitions {len(recs)}"]
+    if active:
+        lines.append(f"  {'alert':<24} {'rule':<6} {'replica':<14} "
+                     f"{'state':<8} {'sev':<7} {'burn_s':>8} "
+                     f"{'burn_l':>8}")
+        for r in active:
+            lines.append(
+                f"  {str(r.get('alert')):<24} "
+                f"{str(r.get('rule')):<6} "
+                f"{str(r.get('replica')):<14} {r['state']:<8} "
+                f"{str(r.get('severity')):<7} "
+                f"{r.get('burn_short', 0.0):>8.3f} "
+                f"{r.get('burn_long', 0.0):>8.3f}")
+    return lines
 
 
 def render(agg, events_n):
@@ -127,28 +191,81 @@ def main(argv=None):
                     help="how many tail events to show")
     ap.add_argument("--json", action="store_true",
                     help="emit the raw aggregate record")
+    ap.add_argument("--alerts", nargs="*", default=None,
+                    help="explicit SLO alert JSONL paths (default: "
+                         "every *alerts*.jsonl under --dir)")
+    ap.add_argument("--follow", action="store_true",
+                    help="re-render every --interval seconds")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="--follow refresh period (default 2s)")
+    ap.add_argument("--iterations", type=int, default=0,
+                    help="--follow passes before exiting "
+                         "(0 = until interrupted)")
     a = ap.parse_args(argv)
 
     from singa_tpu import trace
 
-    if a.files is not None:
-        paths = list(a.files)
-    else:
-        paths = sorted(glob.glob(os.path.join(a.dir,
-                                              "*fleet*.jsonl")))
-    agg = trace.aggregate_fleet(paths=paths, chrome_trace=a.trace)
-    have_input = bool(agg["requests"] or agg["workers"]
-                      or agg["span_count"])
-    if a.json:
-        print(json.dumps(agg, sort_keys=True))
-    else:
-        if not have_input:
-            print(f"fleet_top: no fleet records under "
-                  f"{a.files or a.dir!r} (and no --trace spans)",
-                  file=sys.stderr)
-            return 1
-        print(render(agg, a.events))
-    return 0 if have_input else 1
+    def one_pass():
+        # re-glob each pass: a live fleet creates streams mid-follow
+        if a.files is not None:
+            paths = list(a.files)
+        else:
+            paths = sorted(glob.glob(os.path.join(a.dir,
+                                                  "*fleet*.jsonl")))
+        if a.alerts is not None:
+            apaths = list(a.alerts)
+        else:
+            apaths = sorted(glob.glob(os.path.join(a.dir,
+                                                   "*alerts*.jsonl")))
+        agg = trace.aggregate_fleet(paths=paths, chrome_trace=a.trace)
+        arecs = load_alerts(apaths)
+        have_input = bool(agg["requests"] or agg["workers"]
+                          or agg["span_count"] or arecs)
+        if a.json:
+            out = dict(agg)
+            if arecs:
+                cur = {}
+                for r in arecs:
+                    cur[(r.get("alert"), r.get("rule"),
+                         r.get("replica"))] = r
+                act = [r for r in cur.values()
+                       if r.get("state") in ("pending", "firing")]
+                out["alerts"] = {
+                    "transitions": len(arecs),
+                    "firing": sum(1 for r in act
+                                  if r["state"] == "firing"),
+                    "pending": sum(1 for r in act
+                                   if r["state"] == "pending"),
+                }
+            print(json.dumps(out, sort_keys=True))
+        else:
+            if not have_input:
+                print(f"fleet_top: no fleet records under "
+                      f"{a.files or a.dir!r} (and no --trace spans)",
+                      file=sys.stderr)
+                return 1
+            body = render(agg, a.events)
+            if arecs:
+                body += "\n" + "\n".join(alert_panel(arecs))
+            print(body)
+        return 0 if have_input else 1
+
+    if not a.follow:
+        return one_pass()
+    it = 0
+    rc = 1
+    try:
+        while True:
+            if sys.stdout.isatty():
+                print("\x1b[2J\x1b[H", end="")
+            rc = one_pass()
+            it += 1
+            if a.iterations and it >= a.iterations:
+                break
+            time.sleep(a.interval)
+    except KeyboardInterrupt:
+        pass
+    return rc
 
 
 if __name__ == "__main__":

@@ -209,6 +209,29 @@ def main():
             if isinstance(tr_, dict):
                 seg += (f", trace: {tr_.get('span_count')} spans/"
                         f"{tr_.get('pids')} pids")
+            # online SLO engine (ISSUE 20): the sketch-vs-post-hoc
+            # crosscheck and the chaos arm's alert-lifecycle evidence
+            # fold into the SAME loud MISMATCH — an online quantile
+            # that drifts from the trace, or a chaos arm whose alerts
+            # never fired-and-resolved, is a broken observability
+            # claim, not a footnote.  Old logs (no "slo" key) fold
+            # byte-identically.
+            slo_r = r.get("slo")
+            if isinstance(slo_r, dict):
+                seg += (f", slo xcheck "
+                        f"{len(slo_r.get('crosscheck', {}))} segs")
+                if not slo_r.get("crosscheck_ok", True):
+                    seg += " MISMATCH"
+            if isinstance(r.get("chaos"), dict):
+                sa = r["chaos"].get("slo_alerts")
+                if isinstance(sa, dict):
+                    ch += (f", alerts {sa.get('records', 0)} rec/"
+                           f"{sa.get('full_lifecycles', 0)} full")
+                    if not (sa.get("availability_fired_resolved",
+                                   True)
+                            and sa.get("anomaly_fired_resolved",
+                                       True)):
+                        ch += " MISMATCH"
             rows.append((stage,
                          f"{r['fleet_requests_per_sec']:.1f} req/s  "
                          f"({r.get('replicas')} replicas{tp}, p50 "
@@ -245,6 +268,16 @@ def main():
                 ch = (f", chaos: {c.get('availability_pct')}% avail, "
                       f"{c.get('sigkills', 0)} SIGKILLs/"
                       f"{c.get('replays', 0)} replays{cbad}")
+            # online SLO crosscheck (ISSUE 20) over ttft/tpot: folds
+            # into MISMATCH when the fleet-merged sketch drifts from
+            # the post-hoc trace percentile; old logs fold unchanged
+            slo_r = r.get("slo")
+            slo_col = ""
+            if isinstance(slo_r, dict):
+                slo_col = (f", slo xcheck "
+                           f"{len(slo_r.get('crosscheck', {}))} segs")
+                if not slo_r.get("crosscheck_ok", True):
+                    slo_col += " MISMATCH"
             rows.append((stage,
                          f"{r['fleet_decode_tokens_per_sec']:.0f} "
                          f"tok/s  "
@@ -253,7 +286,7 @@ def main():
                          f"{r.get('transport', 'proc')} "
                          f"replicas, ttft p99 {r.get('ttft_p99_ms')} "
                          f"ms, tpot p99 {r.get('tpot_p99_ms')} ms"
-                         f"{mig}{rp}{quant}{bad}{ch}"
+                         f"{mig}{rp}{quant}{slo_col}{bad}{ch}"
                          + _stage_breakdown(r) + ")" + mark))
         elif "serve_requests_per_sec" in r:
             # serving tier (ISSUE 7): throughput + SLO percentiles +

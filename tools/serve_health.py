@@ -36,7 +36,9 @@ Engines with a KV-cached decode tier (ISSUE 17) add a
 `decode[sessions=.. free_slots=.. tok/s=..]` block per replica — the
 same occupancy numbers the fleet router's admission-aware placement
 reads from heartbeats — so `--all` doubles as a decode-saturation
-view.
+view.  Engines with the online SLO engine armed (ISSUE 20) add an
+`alerts[firing=.. pending=..]` block, and firing alert severity folds
+into the exit code: page => unhealthy, ticket => degraded.
 """
 import argparse
 import glob
@@ -91,7 +93,23 @@ def probe(path: str, max_age_s: float = 0.0):
         line += (f"  decode[sessions={dec.get('active_sessions', 0)} "
                  f"free_slots={dec.get('free_slots', 0)} "
                  f"tok/s={dec.get('tokens_per_s', 0.0)}{quant}]")
-    return _EXIT[state], line
+    # SLO alert surface (ISSUE 20): engines with the online SLO
+    # engine armed ship live alert counts in every snapshot.  Alert
+    # severity folds into the exit contract — a firing page-severity
+    # alert is unhealthy, a firing ticket-severity alert is degraded
+    # — so the same probe loop that watches engine state also pages
+    # on burn-rate/anomaly alerts.  Pre-20 (and disabled-SLO)
+    # snapshots have no "alerts" key and render byte-identically.
+    code = _EXIT[state]
+    al = snap.get("alerts")
+    if isinstance(al, dict):
+        line += (f"  alerts[firing={al.get('firing', 0)} "
+                 f"pending={al.get('pending', 0)}]")
+        if al.get("page"):
+            code = max(code, 2)
+        elif al.get("ticket"):
+            code = max(code, 1)
+    return code, line
 
 
 def probe_all(dirpath: str, max_age_s: float = 0.0):

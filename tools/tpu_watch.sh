@@ -56,6 +56,57 @@
 #                                      pretty line per scored config —
 #                                      live search telemetry.
 
+#   tools/tpu_watch.sh slo [DIR]       tail the NEWEST SLO alert JSONL
+#                                      (*alerts*.jsonl) under DIR and
+#                                      print one line per alert state
+#                                      transition (pending/firing/
+#                                      resolved with burn rates) — the
+#                                      fleet's live alert feed.
+
+if [ "$1" = "slo" ]; then
+  dir=${2:-metrics}
+  f=$(ls -t "$dir"/*alerts*.jsonl 2>/dev/null | head -1)
+  if [ -z "$f" ]; then
+    echo "tpu_watch: no SLO alert JSONL under $dir/ yet" >&2
+    exit 1
+  fi
+  echo "tpu_watch: tailing $f" >&2
+  tail -n +1 -F "$f" | python3 -u -c '
+import json, sys
+
+for line in sys.stdin:
+    line = line.strip()
+    if not line:
+        continue
+    try:
+        r = json.loads(line)
+    except ValueError:
+        continue  # partial trailing line from a killed writer
+    if not isinstance(r, dict) or r.get("kind") != "slo_alert":
+        continue
+    state = str(r.get("state", "?"))
+    mark = {"pending": "...", "firing": "!!!",
+            "resolved": " ok"}.get(state, "  ?")
+    bits = [
+        mark,
+        str(r.get("alert", "?")).ljust(24),
+        ("rule " + str(r.get("rule"))).ljust(11),
+        str(r.get("severity", "?")).ljust(6),
+        "rep " + str(r.get("replica", "-")).ljust(14),
+        state.ljust(8),
+        "ep " + str(r.get("episode", "?")),
+    ]
+    if r.get("burn_short") or r.get("burn_long"):
+        bits.append("burn " + str(r.get("burn_short")) + "/"
+                    + str(r.get("burn_long")))
+    if r.get("value") is not None:
+        bits.append("v=" + str(r.get("value"))
+                    + " thr=" + str(r.get("threshold")))
+    print("  ".join(bits))
+'
+  exit $?
+fi
+
 if [ "$1" = "tune" ]; then
   dir=${2:-metrics}
   f=$(ls -t "$dir"/*autotune*.jsonl 2>/dev/null | head -1)
