@@ -335,7 +335,12 @@ _NULL = _NullSpan()
 
 
 class _Span:
-    __slots__ = ("name", "args", "id", "parent", "depth", "t0")
+    # dur_s: the span's own measured duration, readable after exit —
+    # a caller double-timing the same work (the online-SLO sketch
+    # cross-validated against this very span) must feed the IDENTICAL
+    # value, not a second clock read that diverges under load.
+    __slots__ = ("name", "args", "id", "parent", "depth", "t0",
+                 "dur_s")
 
     def __init__(self, name: str, args: Optional[Dict]):
         self.name = name
@@ -352,6 +357,7 @@ class _Span:
 
     def __exit__(self, *exc):
         t1 = time.perf_counter()
+        self.dur_s = t1 - self.t0
         st = _stack()
         if st and st[-1] is self:
             st.pop()
@@ -819,7 +825,12 @@ class MetricsLogger:
     examples_per_sec, step_s, data_wait_s, dispatch_s,
     device_sync_s (from the tracer's last closed step span when
     tracing is on), cache (per-cache COUNTER DELTAS since the previous
-    record — retraces/step after warmup ≈ 0 is the healthy signal),
+    record — retraces/step after warmup ≈ 0 is the healthy signal;
+    live-state gauges, high-water marks, ratios and config knobs —
+    the `_GAUGE_KEYS` set: slots_in_use, queue_depth, ring_size,
+    size, occupancy, … — are passed through ABSOLUTE, since the
+    delta of a gauge is signed noise: occupancy dropping between
+    records would render as a negative "counter"),
     resilience + accum (absolute counters from `cache_stats()`),
     metrics (registered eval metrics — `Metric.register(logger)`),
     extra (caller keyword passthrough).
@@ -849,10 +860,29 @@ class MetricsLogger:
         the loss."""
         self._metrics[str(name)] = metric
 
+    # Cache-snapshot fields that are NOT monotone counters: live-state
+    # gauges (a shrinking gauge would delta negative), high-water
+    # marks (reset() restarts them), derived ratios and config knobs
+    # (whose deltas are meaningless). These pass through the delta
+    # transform absolute.
+    _GAUGE_KEYS = frozenset({
+        # decode slot pool / LRU cache occupancy
+        "slots", "slots_in_use", "size", "negative_size", "capacity",
+        # serve queue live state, watermarks, derived ratios
+        "queue_depth", "max_queue_depth", "effective_wait_ms",
+        "coalesce_mean", "occupancy", "max_coalesce",
+        # trace ring occupancy / config
+        "ring_size", "ring_capacity", "ship_pending",
+        # dag_route config knob
+        "flops_per_op_threshold",
+    })
+
     # -- record construction ----------------------------------------------
     def _cache_delta(self, snap: Dict) -> Dict:
         """Per-cache numeric-counter deltas vs the previous record
-        (resilience/accum are reported absolute elsewhere)."""
+        (resilience/accum are reported absolute elsewhere; the
+        `_GAUGE_KEYS` gauge/watermark/ratio fields are absolute
+        too)."""
         cur: Dict = {}
         for name, s in snap.items():
             if name in ("resilience", "accum"):
@@ -872,7 +902,8 @@ class MetricsLogger:
                 if not isinstance(p, dict):
                     p = {}
                 out[name] = {
-                    k: (round(v - p.get(k, 0), 6)
+                    k: (v if k in self._GAUGE_KEYS
+                        else round(v - p.get(k, 0), 6)
                         if isinstance(v, float) else v - p.get(k, 0))
                     for k, v in s.items()}
             else:

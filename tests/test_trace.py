@@ -346,6 +346,33 @@ def test_metrics_cache_deltas_are_deltas(tmp_path):
     assert c2["hits"] + c2["misses"] == 1
 
 
+def test_metrics_cache_gauges_are_absolute(tmp_path):
+    """Live-state gauges (slots_in_use, queue_depth, ring_size, LRU
+    size, …) are NOT counters: occupancy dropping between records
+    must not render as a negative delta. `_GAUGE_KEYS` fields pass
+    through the cache-delta transform absolute."""
+    d = stats.decode_stats()
+    saved = (d.slots, d.slots_in_use)
+    log_path = str(tmp_path / "m.jsonl")
+    try:
+        with trace.MetricsLogger(log_path) as ml:
+            d.slots, d.slots_in_use = 8, 6
+            r1 = ml.log_step(1, loss=0.0, step_s=0.1)
+            d.slots_in_use = 2  # drained: a delta would read -4
+            r2 = ml.log_step(2, loss=0.0, step_s=0.1)
+    finally:
+        d.slots, d.slots_in_use = saved
+    assert r1["cache"]["decode"]["slots_in_use"] == 6
+    assert r2["cache"]["decode"]["slots_in_use"] == 2
+    assert r1["cache"]["decode"]["slots"] == 8
+    assert r2["cache"]["decode"]["slots"] == 8
+    # the trace ring rides the same rule: capacity is config, not a
+    # one-record pulse that deltas to zero afterwards
+    assert (r2["cache"]["trace"]["ring_capacity"]
+            == r1["cache"]["trace"]["ring_capacity"] > 0)
+    assert r2["cache"]["trace"]["ring_size"] >= 0
+
+
 def test_metric_registers_into_metrics_logger(tmp_path):
     log_path = str(tmp_path / "m.jsonl")
     ml = trace.MetricsLogger(log_path)
